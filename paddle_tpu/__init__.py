@@ -1,0 +1,42 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference: baobrian/Paddle, see SURVEY.md).
+
+Define-then-run Program IR built by a fluid-compatible Python frontend, lowered
+whole-block to XLA via JAX instead of per-op kernel dispatch; SPMD data
+parallelism via jax.sharding instead of NCCL; Pallas kernels where XLA fusion
+isn't enough.
+
+`import paddle_tpu.fluid as fluid` is a drop-in for `import paddle.fluid`.
+"""
+
+from . import (
+    backward,
+    clip,
+    framework,
+    initializer,
+    layers,
+    optimizer,
+    param_attr,
+    regularizer,
+    unique_name,
+)
+from .backward import append_backward
+from .executor import Executor, Scope, global_scope, scope_guard
+from .framework import (
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    name_scope,
+    program_guard,
+)
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .place import (
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    TPUPlace,
+    is_compiled_with_cuda,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,254 @@
+"""Executor: lowers a whole block to ONE jitted XLA computation and runs it.
+
+Reference analog: paddle/fluid/framework/executor.cc:158 — but where the
+reference interprets the block op-by-op (executor.cc:389-396, each op a
+separate kernel launch), this executor generalizes the reference's nGraph seam
+(executor.cc:91-107, its only "compile a region" precedent) to the WHOLE block:
+every op's JAX lowering is stitched into a single traced function, jitted once
+per (program version, feed shapes) and cached like the reference's Python
+program cache (reference executor.py:285).
+
+Mutability model: reference ops mutate named Variables in a Scope. Here the
+trace threads an immutable name->array environment; an op "writes" a var by
+rebinding the name. Persistable vars written by the block (params, optimizer
+state, batch-norm running stats) come in as a donated pytree argument and go
+out as updated state — giving in-place buffer semantics on TPU without mutable
+aliasing inside XLA.
+
+Scope (reference framework/scope.h) holds name -> jax.Array plus the PRNG key
+that stochastic ops consume.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import framework
+from .framework import Program, Variable, convert_np_dtype
+from .ops import registry
+
+EMPTY_VAR_NAME = "@EMPTY@"  # reference core.kEmptyVarName
+
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
+
+
+class Scope:
+    """name -> device array store (reference scope.h:134, flat not hierarchical
+    — per-iteration locals are SSA temporaries inside the jitted function, so
+    child scopes are unnecessary)."""
+
+    def __init__(self, seed=0):
+        self.vars = {}
+        self.rng_key = jax.random.key(seed)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def set_var(self, name, value):
+        self.vars[name] = value
+
+    def drop_kids(self):  # compat no-op
+        pass
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self.scope)
+
+    def __exit__(self, *args):
+        _scope_stack.pop()
+
+
+def _as_feed_array(value, var):
+    arr = np.asarray(value)
+    if var is not None and var.dtype is not None:
+        arr = arr.astype(np.dtype(var.dtype) if var.dtype != "bfloat16" else jnp.bfloat16)
+    return arr
+
+
+class _CompiledBlock:
+    """A lowered + jitted block: knows its state split (read-only vs mutated
+    persistables) and fetch names."""
+
+    def __init__(self, program, block, feed_names, fetch_names, scope):
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        ops = [
+            op
+            for op in block.ops
+            if not registry.get(op.type).skip_exec
+        ] if all(registry.is_registered(op.type) for op in block.ops) else None
+        if ops is None:
+            unknown = [op.type for op in block.ops if not registry.is_registered(op.type)]
+            raise NotImplementedError("ops without lowering: %s" % sorted(set(unknown)))
+        self.ops = ops
+
+        # classify external inputs: fed names are args; persistable names found
+        # in the scope are state; anything else must be produced by the block.
+        produced = set()
+        state_names = []
+        fed = set(self.feed_names)
+        for op in self.ops:
+            for name in op.input_arg_names:
+                if name == EMPTY_VAR_NAME:
+                    continue
+                if name in fed or name in produced or name in state_names:
+                    continue
+                if scope.find_var(name) is not None:
+                    state_names.append(name)
+                else:
+                    v = block.has_var_recursive(name) and block._var_recursive(name)
+                    raise RuntimeError(
+                        "variable %r used by op %s is neither fed, in scope, nor "
+                        "produced earlier in the block (var=%s)" % (name, op, v)
+                    )
+            produced.update(n for n in op.output_arg_names if n != EMPTY_VAR_NAME)
+        # fetches may be state too (e.g. fetch a param without running ops on it)
+        for name in self.fetch_names:
+            if name not in fed and name not in produced and name not in state_names:
+                if scope.find_var(name) is not None:
+                    state_names.append(name)
+                else:
+                    raise RuntimeError("fetch var %r has no value" % name)
+
+        persistable = {
+            name
+            for name in state_names + list(produced)
+            if block.has_var_recursive(name) and block._var_recursive(name).persistable
+        }
+        written = set()
+        for op in self.ops:
+            written.update(n for n in op.output_arg_names if n != EMPTY_VAR_NAME)
+        # state already in scope and rewritten by the block → donated + returned
+        self.mut_names = sorted(set(state_names) & written)
+        self.ro_names = sorted(set(state_names) - written)
+        # persistables created inside the block (e.g. startup initializers)
+        self.created_persistables = sorted((persistable & produced) - set(state_names) - fed)
+
+        ops_ = self.ops
+
+        def run(feeds, ro_state, mut_state, rng_key):
+            env = {}
+            env.update(ro_state)
+            env.update(mut_state)
+            env.update(feeds)
+            ctx = registry.LowerCtx(rng_key)
+            for op in ops_:
+                opdef = registry.get(op.type)
+                ins = {}
+                for slot, names in op.inputs.items():
+                    if names:
+                        ins[slot] = [
+                            env[n] if n != EMPTY_VAR_NAME else None for n in names
+                        ]
+                outs = opdef.lower(ctx, ins, op.attrs)
+                for slot, names in op.outputs.items():
+                    vals = outs.get(slot)
+                    if vals is None:
+                        continue
+                    for name, val in zip(names, vals):
+                        if val is not None and name != EMPTY_VAR_NAME:
+                            env[name] = val
+            fetches = [env[n] for n in self.fetch_names]
+            new_mut = {n: env[n] for n in self.mut_names}
+            created = {n: env[n] for n in self.created_persistables if n in env}
+            return fetches, new_mut, created, ctx.key
+
+        # donate the mutated-state pytree: params update in place on device
+        self.jitted = jax.jit(run, donate_argnums=(2,))
+
+    def __call__(self, scope, feed_arrays):
+        ro = {n: scope.vars[n] for n in self.ro_names}
+        mut = {n: scope.vars[n] for n in self.mut_names}
+        fetches, new_mut, created, new_key = self.jitted(
+            feed_arrays, ro, mut, scope.rng_key
+        )
+        scope.vars.update(new_mut)
+        scope.vars.update(created)
+        scope.rng_key = new_key
+        return fetches
+
+
+class Executor:
+    """Drop-in for fluid.Executor (reference python/paddle/fluid/executor.py:256).
+
+    `place` is accepted for API compatibility (fluid.CPUPlace()/CUDAPlace(0)/
+    TPUPlace()); actual placement follows jax's default device unless the place
+    pins one.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def close(self):  # compat (reference Executor::Close notifies pservers)
+        self._cache.clear()
+
+    def run(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        feed_var_name="feed",
+        fetch_var_name="fetch",
+        scope=None,
+        return_numpy=True,
+        use_program_cache=True,
+    ):
+        if program is None:
+            program = framework.default_main_program()
+        if feed is None:
+            feed = {}
+        if fetch_list is None:
+            fetch_list = []
+        scope = scope or global_scope()
+        if scope.rng_key is None or (
+            program.random_seed and not getattr(scope, "_seeded", False)
+        ):
+            scope.rng_key = jax.random.key(program.random_seed)
+            scope._seeded = True
+
+        block = program.global_block()
+        fetch_names = [
+            f.name if isinstance(f, Variable) else str(f) for f in fetch_list
+        ]
+
+        feed_arrays = {}
+        for name, value in feed.items():
+            var = block.vars.get(name)
+            feed_arrays[name] = _as_feed_array(value, var)
+
+        key = (
+            id(program),
+            program._version,
+            tuple(sorted((n, a.shape, str(a.dtype)) for n, a in feed_arrays.items())),
+            tuple(fetch_names),
+            id(scope),
+        )
+        compiled = self._cache.get(key) if use_program_cache else None
+        if compiled is None:
+            compiled = _CompiledBlock(
+                program, block, list(feed_arrays.keys()), fetch_names, scope
+            )
+            if use_program_cache:
+                self._cache[key] = compiled
+
+        fetches = compiled(scope, feed_arrays)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches

@@ -1,0 +1,16 @@
+"""Drop-in alias: `import paddle_tpu.fluid as fluid` mirrors `paddle.fluid`
+(the reference's python/paddle/fluid/__init__.py public surface)."""
+
+from .. import *  # noqa: F401,F403
+from .. import (  # noqa: F401
+    backward,
+    clip,
+    framework,
+    initializer,
+    layers,
+    optimizer,
+    param_attr,
+    regularizer,
+    unique_name,
+)
+from ..executor import Executor, Scope, global_scope, scope_guard  # noqa: F401

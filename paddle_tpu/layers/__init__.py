@@ -1,0 +1,23 @@
+"""fluid-compatible layers namespace (reference python/paddle/fluid/layers/).
+
+All public layer functions are re-exported flat, so user code written as
+`fluid.layers.fc(...)` works unchanged against `paddle_tpu.layers`.
+"""
+
+from . import io, metric_op, nn, ops, tensor
+from .io import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .learning_rate_scheduler import *  # noqa: F401,F403
+from . import learning_rate_scheduler
+
+__all__ = (
+    io.__all__
+    + metric_op.__all__
+    + nn.__all__
+    + ops.__all__
+    + tensor.__all__
+    + learning_rate_scheduler.__all__
+)

@@ -1,0 +1,5 @@
+"""Op registry + lowerings. Importing this package registers all ops."""
+
+from . import registry
+from . import core_ops  # noqa: F401 — registration side effects
+from .registry import OPS, get, is_registered, register

@@ -1,0 +1,1286 @@
+"""Core operator lowerings (dense math, NN, tensor manipulation, optimizers).
+
+Reference analog: paddle/fluid/operators/*.cc/.cu (336 registered ops, §2.5 of
+SURVEY.md). Each lowering is a pure JAX function over slot-keyed arrays; the
+executor stitches a whole block of them into ONE jitted XLA computation, so
+elementwise chains fuse into the adjacent matmuls/convs on the MXU instead of
+being separate kernel launches as in the reference's per-op dispatch loop
+(reference framework/executor.cc:389-396).
+
+Gradients: nearly all ops rely on the registry's generic jax.vjp grad
+(registry._make_generic_grad). Custom grads exist only where vjp-replay is
+wrong (dropout must reuse its sampled Mask).
+
+Dtype policy (TPU-first): float64→float32 and int64→int32 are canonicalized at
+the framework boundary (TPUs have no fast f64/i64 path), mirroring JAX's own
+default dtype canonicalization.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import framework
+from .registry import (
+    LowerCtx,
+    bcast_y,
+    register,
+    register_no_lower,
+)
+
+# ---------------------------------------------------------------------------
+# executor-handled markers
+# ---------------------------------------------------------------------------
+
+register_no_lower("feed")
+register_no_lower("fetch")
+
+
+def _dtype(attr_dtype):
+    return jnp.dtype(framework.convert_np_dtype(attr_dtype))
+
+
+def _rng(ctx, attrs):
+    seed = int(attrs.get("seed", 0) or 0)
+    if seed:
+        return jax.random.key(seed)
+    return ctx.next_rng()
+
+
+# ---------------------------------------------------------------------------
+# creation / random ops (reference: fill_constant_op.cc, uniform_random_op.cc,
+# gaussian_random_op.cc, truncated_gaussian_random_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("fill_constant", no_grad=True)
+def _fill_constant(ctx, ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    dt = _dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dt)]}
+
+
+@register("fill_constant_batch_size_like", no_grad=True)
+def _fill_constant_bsl(ctx, ins, attrs):
+    (ref,) = ins["Input"]
+    shape = [int(s) for s in attrs["shape"]]
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = ref.shape[in_idx]
+    dt = _dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dt)]}
+
+
+@register("fill_zeros_like", no_grad=True)
+def _fill_zeros_like(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [jnp.zeros_like(x)]}
+
+
+@register("uniform_random", no_grad=True, stochastic=True)
+def _uniform_random(ctx, ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    dt = _dtype(attrs.get("dtype", "float32"))
+    out = jax.random.uniform(
+        _rng(ctx, attrs),
+        shape,
+        dtype=jnp.float32,
+        minval=attrs.get("min", -1.0),
+        maxval=attrs.get("max", 1.0),
+    )
+    return {"Out": [out.astype(dt)]}
+
+
+@register("gaussian_random", no_grad=True, stochastic=True)
+def _gaussian_random(ctx, ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    dt = _dtype(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.normal(
+        _rng(ctx, attrs), shape, dtype=jnp.float32
+    )
+    return {"Out": [out.astype(dt)]}
+
+
+@register("truncated_gaussian_random", no_grad=True, stochastic=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    dt = _dtype(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.truncated_normal(
+        _rng(ctx, attrs), -2.0, 2.0, shape, dtype=jnp.float32
+    )
+    return {"Out": [out.astype(dt)]}
+
+
+@register("assign_value", no_grad=True)
+def _assign_value(ctx, ins, attrs):
+    dt = _dtype(attrs.get("dtype", "float32"))
+    vals = np.asarray(attrs["values"]).reshape([int(s) for s in attrs["shape"]])
+    return {"Out": [jnp.asarray(vals, dtype=dt)]}
+
+
+@register("assign")
+def _assign(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [x]}
+
+
+@register("cast")
+def _cast(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [x.astype(_dtype(attrs["out_dtype"]))]}
+
+
+@register("shape", no_grad=True)
+def _shape(ctx, ins, attrs):
+    (x,) = ins["Input"]
+    return {"Out": [jnp.asarray(x.shape, dtype=jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# dense math (reference: mul_op.cc, matmul_op.cc, operators/math/blas.h — on
+# TPU these land on the MXU via XLA dot_general)
+# ---------------------------------------------------------------------------
+
+
+@register("mul")
+def _mul(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    xnc = int(attrs.get("x_num_col_dims", 1))
+    ync = int(attrs.get("y_num_col_dims", 1))
+    x2 = x.reshape((int(np.prod(x.shape[:xnc])), -1))
+    y2 = y.reshape((int(np.prod(y.shape[:ync])), -1))
+    out = x2 @ y2
+    out_shape = tuple(x.shape[:xnc]) + tuple(y.shape[ync:])
+    return {"Out": [out.reshape(out_shape)]}
+
+
+@register("matmul")
+def _matmul(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    tx, ty = attrs.get("transpose_X", False), attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary with paddle axis-broadcast
+# (reference: operators/elementwise/elementwise_op_function.h)
+# ---------------------------------------------------------------------------
+
+
+def _register_elementwise(name, fn):
+    @register(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        (x,) = ins["X"]
+        (y,) = ins["Y"]
+        y = bcast_y(x, y, int(attrs.get("axis", -1)))
+        return {"Out": [_fn(x, y)]}
+
+
+_register_elementwise("elementwise_add", jnp.add)
+_register_elementwise("elementwise_sub", jnp.subtract)
+_register_elementwise("elementwise_mul", jnp.multiply)
+_register_elementwise("elementwise_div", jnp.divide)
+_register_elementwise("elementwise_min", jnp.minimum)
+_register_elementwise("elementwise_max", jnp.maximum)
+_register_elementwise("elementwise_pow", jnp.power)
+_register_elementwise("elementwise_mod", jnp.mod)
+_register_elementwise("elementwise_floordiv", jnp.floor_divide)
+
+
+@register("sum")
+def _sum(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register("scale")
+def _scale(ctx, ins, attrs):
+    (x,) = ins["X"]
+    s = jnp.asarray(attrs.get("scale", 1.0), x.dtype)
+    b = jnp.asarray(attrs.get("bias", 0.0), x.dtype)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * s + b]}
+    return {"Out": [(x + b) * s]}
+
+
+@register("increment")
+def _increment(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
+
+
+@register("clip")
+def _clip(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [jnp.clip(x, attrs["min"], attrs["max"])]}
+
+
+@register("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    (x,) = ins["X"]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [(x.astype(jnp.float32) * scale).astype(x.dtype)]}
+
+
+@register("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [jnp.sum(x.astype(jnp.float32) ** 2).reshape((1,)).astype(x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# activations (reference: activation_op.cc — ~20 activations)
+# ---------------------------------------------------------------------------
+
+
+def _register_act(name, fn):
+    @register(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        (x,) = ins["X"]
+        return {"Out": [_fn(x, attrs)]}
+
+
+_register_act("relu", lambda x, a: jnp.maximum(x, 0))
+_register_act("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_register_act("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_register_act("tanh", lambda x, a: jnp.tanh(x))
+_register_act("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_register_act("sqrt", lambda x, a: jnp.sqrt(x))
+_register_act("abs", lambda x, a: jnp.abs(x))
+_register_act("ceil", lambda x, a: jnp.ceil(x))
+_register_act("floor", lambda x, a: jnp.floor(x))
+_register_act("cos", lambda x, a: jnp.cos(x))
+_register_act("sin", lambda x, a: jnp.sin(x))
+_register_act("round", lambda x, a: jnp.round(x))
+_register_act("reciprocal", lambda x, a: 1.0 / x)
+_register_act("exp", lambda x, a: jnp.exp(x))
+_register_act("log", lambda x, a: jnp.log(x))
+_register_act("square", lambda x, a: jnp.square(x))
+_register_act("softplus", lambda x, a: jax.nn.softplus(x))
+_register_act("softsign", lambda x, a: jax.nn.soft_sign(x))
+_register_act("softshrink", lambda x, a: jnp.sign(x) * jnp.maximum(jnp.abs(x) - a.get("lambda", 0.5), 0))
+_register_act("hard_shrink", lambda x, a: jnp.where(jnp.abs(x) > a.get("threshold", 0.5), x, 0))
+_register_act("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)))
+_register_act("leaky_relu", lambda x, a: jnp.where(x >= 0, x, x * a.get("alpha", 0.02)))
+_register_act(
+    "soft_relu",
+    lambda x, a: jnp.log1p(jnp.exp(jnp.clip(x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))),
+)
+_register_act("elu", lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 1.0) * (jnp.exp(x) - 1)))
+_register_act("relu6", lambda x, a: jnp.clip(x, 0, a.get("threshold", 6.0)))
+_register_act("pow", lambda x, a: jnp.power(x, a.get("factor", 1.0)))
+_register_act(
+    "stanh",
+    lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(a.get("scale_a", 0.67) * x),
+)
+_register_act(
+    "hard_sigmoid",
+    lambda x, a: jnp.clip(a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+)
+_register_act("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_register_act("gelu", lambda x, a: jax.nn.gelu(x, approximate=False))
+_register_act(
+    "thresholded_relu", lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0)
+)
+_register_act("rsqrt", lambda x, a: lax.rsqrt(x))
+_register_act("sign", lambda x, a: jnp.sign(x))
+
+
+@register("prelu")
+def _prelu(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (alpha,) = ins["Alpha"]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "all":
+        alpha = alpha.reshape(())
+    return {"Out": [jnp.where(x >= 0, x, x * alpha)]}
+
+
+# ---------------------------------------------------------------------------
+# softmax / losses (reference: softmax_op.cc, softmax_with_cross_entropy_op.cc,
+# cross_entropy_op.cc, mean_op.cc, huber/smooth-l1/log/hinge losses)
+# ---------------------------------------------------------------------------
+
+
+@register("softmax")
+def _softmax(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [jax.nn.softmax(x, axis=-1)]}
+
+
+@register("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [jax.nn.log_softmax(x, axis=int(attrs.get("axis", -1)))]}
+
+
+@register("softmax_with_cross_entropy")
+def _softmax_with_ce(ctx, ins, attrs):
+    (logits,) = ins["Logits"]
+    (label,) = ins["Label"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    softmax = jnp.exp(logp)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lbl = label.reshape(label.shape[:-1]).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, lbl[..., None], axis=-1)
+        loss = -picked
+        ignore = int(attrs.get("ignore_index", -100))
+        loss = jnp.where(lbl[..., None] == ignore, 0.0, loss)
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+@register("cross_entropy")
+def _cross_entropy(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (label,) = ins["Label"]
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), axis=-1, keepdims=True)
+    else:
+        lbl = label.reshape(label.shape[:-1]).astype(jnp.int32)
+        picked = jnp.take_along_axis(x, lbl[..., None], axis=-1)
+        loss = -jnp.log(jnp.maximum(picked, 1e-20))
+    return {"Y": [loss]}
+
+
+@register("mean")
+def _mean(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [jnp.mean(x).reshape((1,))]}
+
+
+@register("square_error_cost")
+def _square_error_cost(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    if "InsideWeight" in ins:
+        diff = diff * ins["InsideWeight"][0]
+    ad = jnp.abs(diff)
+    val = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    if "OutsideWeight" in ins:
+        val = val * ins["OutsideWeight"][0]
+    out = jnp.sum(val.reshape(val.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [out], "Diff": [diff]}
+
+
+@register("log_loss")
+def _log_loss(ctx, ins, attrs):
+    (p,) = ins["Predicted"]
+    (l,) = ins["Labels"]
+    eps = attrs.get("epsilon", 1e-4)
+    out = -l * jnp.log(p + eps) - (1 - l) * jnp.log(1 - p + eps)
+    return {"Loss": [out]}
+
+
+@register("huber_loss")
+def _huber_loss(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    out = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Out": [out], "Residual": [r]}
+
+
+@register("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    (logits,) = ins["Logits"]
+    (labels,) = ins["Labels"]
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)]}
+
+
+@register("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (label,) = ins["Label"]
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    return {"Out": [loss]}
+
+
+# ---------------------------------------------------------------------------
+# reductions / argmax / comparisons (reference: reduce_ops/, compare_op.cc,
+# logical_op.cc, arg_max_op.cc, top_k_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _register_reduce(name, fn):
+    @register(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        (x,) = ins["X"]
+        dims = attrs.get("dim", [0])
+        if isinstance(dims, int):
+            dims = [dims]
+        keep = bool(attrs.get("keep_dim", False))
+        if attrs.get("reduce_all", False):
+            out = _fn(x, axis=None, keepdims=False).reshape((1,))
+        else:
+            axes = tuple(d % x.ndim for d in dims)
+            out = _fn(x, axis=axes, keepdims=keep)
+            if out.ndim == 0:
+                out = out.reshape((1,))
+        return {"Out": [out]}
+
+
+_register_reduce("reduce_sum", jnp.sum)
+_register_reduce("reduce_mean", jnp.mean)
+_register_reduce("reduce_max", jnp.max)
+_register_reduce("reduce_min", jnp.min)
+_register_reduce("reduce_prod", jnp.prod)
+
+
+def _register_compare(name, fn):
+    @register(name, no_grad=True)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        (x,) = ins["X"]
+        (y,) = ins["Y"]
+        y = bcast_y(x, y, int(attrs.get("axis", -1)))
+        return {"Out": [_fn(x, y)]}
+
+
+_register_compare("less_than", jnp.less)
+_register_compare("less_equal", jnp.less_equal)
+_register_compare("greater_than", jnp.greater)
+_register_compare("greater_equal", jnp.greater_equal)
+_register_compare("equal", jnp.equal)
+_register_compare("not_equal", jnp.not_equal)
+
+
+def _register_logical(name, fn, unary=False):
+    @register(name, no_grad=True)
+    def _lower(ctx, ins, attrs, _fn=fn, _unary=unary):
+        (x,) = ins["X"]
+        if _unary:
+            return {"Out": [_fn(x)]}
+        (y,) = ins["Y"]
+        return {"Out": [_fn(x, y)]}
+
+
+_register_logical("logical_and", jnp.logical_and)
+_register_logical("logical_or", jnp.logical_or)
+_register_logical("logical_xor", jnp.logical_xor)
+_register_logical("logical_not", jnp.logical_not, unary=True)
+
+
+@register("arg_max", no_grad=True)
+def _arg_max(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [jnp.argmax(x, axis=int(attrs.get("axis", -1))).astype(jnp.int32)]}
+
+
+@register("arg_min", no_grad=True)
+def _arg_min(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [jnp.argmin(x, axis=int(attrs.get("axis", -1))).astype(jnp.int32)]}
+
+
+@register("top_k", no_grad=True)
+def _top_k(ctx, ins, attrs):
+    (x,) = ins["X"]
+    k = int(attrs["k"])
+    vals, idx = lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int32)]}
+
+
+@register("argsort", no_grad=True)
+def _argsort(ctx, ins, attrs):
+    (x,) = ins["X"]
+    axis = int(attrs.get("axis", -1))
+    idx = jnp.argsort(x, axis=axis).astype(jnp.int32)
+    out = jnp.sort(x, axis=axis)
+    return {"Out": [out], "Indices": [idx]}
+
+
+@register("cumsum")
+def _cumsum(ctx, ins, attrs):
+    (x,) = ins["X"]
+    axis = int(attrs.get("axis", -1))
+    out = jnp.cumsum(jnp.flip(x, axis) if attrs.get("reverse", False) else x, axis=axis)
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis)
+    if attrs.get("exclusive", False):
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        out = jnp.pad(out, pad)[
+            tuple(slice(0, x.shape[i]) if i == axis % x.ndim else slice(None) for i in range(x.ndim))
+        ]
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# metrics (reference: metrics/accuracy_op.cc, metrics/auc_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("accuracy", no_grad=True)
+def _accuracy(ctx, ins, attrs):
+    (indices,) = ins["Indices"]
+    (label,) = ins["Label"]
+    correct = jnp.any(indices == label.astype(indices.dtype), axis=-1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = indices.shape[0]
+    return {
+        "Accuracy": [(num_correct / total).reshape((1,))],
+        "Correct": [num_correct.astype(jnp.int32).reshape((1,))],
+        "Total": [jnp.asarray([total], dtype=jnp.int32)],
+    }
+
+
+@register("auc", no_grad=True)
+def _auc(ctx, ins, attrs):
+    """Streaming AUC (reference metrics/auc_op.cc): histogram positives and
+    negatives into threshold buckets, accumulate into StatPos/StatNeg, compute
+    AUC by trapezoidal rule over the cumulative counts."""
+    (predict,) = ins["Predict"]
+    (label,) = ins["Label"]
+    stat_pos, stat_neg = ins["StatPos"][0], ins["StatNeg"][0]
+    n = int(attrs.get("num_thresholds", 4095))
+    pos_prob = predict[:, -1]
+    bucket = jnp.clip((pos_prob * n).astype(jnp.int32), 0, n)
+    is_pos = (label.reshape(-1) > 0).astype(jnp.float32)
+    pos_hist = jnp.zeros(n + 1, jnp.float32).at[bucket].add(is_pos)
+    neg_hist = jnp.zeros(n + 1, jnp.float32).at[bucket].add(1.0 - is_pos)
+    sp = stat_pos + pos_hist
+    sn = stat_neg + neg_hist
+    # descending threshold cumulative TP/FP
+    tp = jnp.cumsum(sp[::-1])
+    fp = jnp.cumsum(sn[::-1])
+    tot_pos, tot_neg = tp[-1], fp[-1]
+    tp0 = jnp.concatenate([jnp.zeros(1), tp[:-1]])
+    fp0 = jnp.concatenate([jnp.zeros(1), fp[:-1]])
+    area = jnp.sum((fp - fp0) * (tp + tp0) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg + 1e-12), 0.0)
+    return {
+        "AUC": [auc.reshape((1,))],
+        "StatPosOut": [sp],
+        "StatNegOut": [sn],
+    }
+
+
+# ---------------------------------------------------------------------------
+# tensor manipulation (reference: reshape_op.cc, transpose_op.cc, concat_op.cc,
+# split_op.cc, stack_op.cc, squeeze/unsqueeze, flatten, slice, gather, scatter,
+# pad, expand, one_hot, lod_reset)
+# ---------------------------------------------------------------------------
+
+
+def _reshape_shape(x, shape_attr):
+    shape = list(int(s) for s in shape_attr)
+    # paddle semantics: 0 means copy input dim at that position
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return shape
+
+
+@register("reshape")
+def _reshape(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [x.reshape(_reshape_shape(x, attrs["shape"]))]}
+
+
+@register("reshape2")
+def _reshape2(ctx, ins, attrs):
+    (x,) = ins["X"]
+    out = x.reshape(_reshape_shape(x, attrs["shape"]))
+    xshape = jnp.zeros((0,) + x.shape, dtype=x.dtype)
+    return {"Out": [out], "XShape": [xshape]}
+
+
+@register("transpose")
+def _transpose(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [jnp.transpose(x, attrs["axis"])]}
+
+
+@register("transpose2")
+def _transpose2(ctx, ins, attrs):
+    (x,) = ins["X"]
+    out = jnp.transpose(x, attrs["axis"])
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register("concat")
+def _concat(ctx, ins, attrs):
+    xs = ins["X"]
+    return {"Out": [jnp.concatenate(xs, axis=int(attrs.get("axis", 0)))]}
+
+
+@register("split")
+def _split(ctx, ins, attrs):
+    (x,) = ins["X"]
+    axis = int(attrs.get("axis", 0))
+    sections = attrs.get("sections", [])
+    num = int(attrs.get("num", 0))
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register("stack")
+def _stack(ctx, ins, attrs):
+    xs = ins["X"]
+    return {"Y": [jnp.stack(xs, axis=int(attrs.get("axis", 0)))]}
+
+
+@register("unstack")
+def _unstack(ctx, ins, attrs):
+    (x,) = ins["X"]
+    axis = int(attrs.get("axis", 0))
+    n = x.shape[axis]
+    outs = [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]
+    return {"Y": outs}
+
+
+def _squeeze_axes(x, axes):
+    if axes:
+        return tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return tuple(i for i, d in enumerate(x.shape) if d == 1)
+
+
+@register("squeeze")
+def _squeeze(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [jnp.squeeze(x, axis=_squeeze_axes(x, attrs.get("axes", [])))]}
+
+
+@register("squeeze2")
+def _squeeze2(ctx, ins, attrs):
+    (x,) = ins["X"]
+    out = jnp.squeeze(x, axis=_squeeze_axes(x, attrs.get("axes", [])))
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register("unsqueeze")
+def _unsqueeze(ctx, ins, attrs):
+    (x,) = ins["X"]
+    out = x
+    for a in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, a)
+    return {"Out": [out]}
+
+
+@register("unsqueeze2")
+def _unsqueeze2(ctx, ins, attrs):
+    (x,) = ins["X"]
+    out = x
+    for a in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, a)
+    return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register("flatten")
+def _flatten(ctx, ins, attrs):
+    (x,) = ins["X"]
+    axis = int(attrs.get("axis", 1))
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": [x.reshape((lead, -1))]}
+
+
+@register("flatten2")
+def _flatten2(ctx, ins, attrs):
+    out = _flatten(ctx, ins, attrs)["Out"]
+    (x,) = ins["X"]
+    return {"Out": out, "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register("slice")
+def _slice(ctx, ins, attrs):
+    (x,) = ins["Input"]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register("gather")
+def _gather(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (idx,) = ins["Index"]
+    return {"Out": [jnp.take(x, idx.reshape(-1).astype(jnp.int32), axis=0)]}
+
+
+@register("scatter")
+def _scatter(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (ids,) = ins["Ids"]
+    (updates,) = ins["Updates"]
+    ids = ids.reshape(-1).astype(jnp.int32)
+    if attrs.get("overwrite", True):
+        out = x.at[ids].set(updates)
+    else:
+        out = x.at[ids].add(updates)
+    return {"Out": [out]}
+
+
+@register("pad")
+def _pad(ctx, ins, attrs):
+    (x,) = ins["X"]
+    p = attrs["paddings"]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {
+        "Out": [jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))]
+    }
+
+
+@register("pad2d")
+def _pad2d(ctx, ins, attrs):
+    (x,) = ins["X"]
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        out = jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))
+    elif mode == "reflect":
+        out = jnp.pad(x, pairs, mode="reflect")
+    else:
+        out = jnp.pad(x, pairs, mode="edge")
+    return {"Out": [out]}
+
+
+@register("expand")
+def _expand(ctx, ins, attrs):
+    (x,) = ins["X"]
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register("one_hot", no_grad=True)
+def _one_hot(ctx, ins, attrs):
+    (x,) = ins["X"]
+    depth = int(attrs["depth"])
+    flat = x.reshape(x.shape[:-1]) if x.shape[-1] == 1 else x
+    return {"Out": [jax.nn.one_hot(flat.astype(jnp.int32), depth, dtype=jnp.float32)]}
+
+
+@register("lookup_table")
+def _lookup_table(ctx, ins, attrs):
+    (w,) = ins["W"]
+    (ids,) = ins["Ids"]
+    padding_idx = int(attrs.get("padding_idx", -1))
+    flat = ids.reshape(-1).astype(jnp.int32)
+    out = jnp.take(w, flat, axis=0)
+    if padding_idx != -1:
+        pad = padding_idx if padding_idx >= 0 else padding_idx + w.shape[0]
+        out = jnp.where((flat == pad)[:, None], 0.0, out)
+    out_shape = tuple(ids.shape[:-1]) + (w.shape[1],)
+    if ids.shape[-1] != 1:
+        out_shape = tuple(ids.shape) + (w.shape[1],)
+    return {"Out": [out.reshape(out_shape)]}
+
+
+@register("embedding")
+def _embedding(ctx, ins, attrs):
+    return _lookup_table(ctx, ins, attrs)
+
+
+@register("reverse")
+def _reverse(ctx, ins, attrs):
+    (x,) = ins["X"]
+    axes = attrs["axis"]
+    if isinstance(axes, int):
+        axes = [axes]
+    out = x
+    for a in axes:
+        out = jnp.flip(out, axis=a)
+    return {"Out": [out]}
+
+
+@register("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    (x,) = ins["X"]
+    eps = attrs.get("epsilon", 0.1)
+    k = x.shape[-1]
+    if "PriorDist" in ins:
+        prior = ins["PriorDist"][0].reshape(-1)
+        out = (1 - eps) * x + eps * prior
+    else:
+        out = (1 - eps) * x + eps / k
+    return {"Out": [out]}
+
+
+@register("norm")
+def _norm(ctx, ins, attrs):
+    (x,) = ins["X"]
+    axis = int(attrs.get("axis", 1))
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+def _interp_shape(x, attrs):
+    return int(attrs["out_h"]), int(attrs["out_w"])
+
+
+@register("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    (x,) = ins["X"]
+    oh, ow = _interp_shape(x, attrs)
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="bilinear")
+    return {"Out": [out]}
+
+
+@register("nearest_interp")
+def _nearest_interp(ctx, ins, attrs):
+    (x,) = ins["X"]
+    oh, ow = _interp_shape(x, attrs)
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="nearest")
+    return {"Out": [out]}
+
+
+@register("lod_reset")
+def _lod_reset(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [x]}
+
+
+@register("where", no_grad=False)
+def _where(ctx, ins, attrs):
+    (cond,) = ins["Condition"]
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    return {"Out": [jnp.where(cond, x, y)]}
+
+
+# ---------------------------------------------------------------------------
+# convolution / pooling / normalization (reference: conv_op.cc +
+# conv_cudnn_op.cu.cc, pool_op.cc, batch_norm_op.cc, layer_norm_op.cc — these
+# are the MXU workhorses; lowered to XLA conv_general_dilated / reduce_window)
+# ---------------------------------------------------------------------------
+
+
+@register("conv2d")
+def _conv2d(ctx, ins, attrs):
+    (x,) = ins["Input"]
+    (w,) = ins["Filter"]
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    dilations = [int(d) for d in attrs.get("dilations", [1, 1])]
+    groups = int(attrs.get("groups", 1) or 1)
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+@register("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    return _conv2d(ctx, ins, attrs)
+
+
+@register("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    (x,) = ins["Input"]
+    (w,) = ins["Filter"]  # paddle layout: (in_c, out_c/groups, kh, kw)
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    dilations = [int(d) for d in attrs.get("dilations", [1, 1])]
+    groups = int(attrs.get("groups", 1) or 1)
+    out = lax.conv_transpose(
+        x,
+        w,
+        strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=False,
+    )
+    return {"Output": [out]}
+
+
+@register("pool2d")
+def _pool2d(ctx, ins, attrs):
+    (x,) = ins["X"]
+    ptype = attrs.get("pooling_type", "max")
+    ksize = [int(k) for k in attrs.get("ksize", [2, 2])]
+    strides = [int(s) for s in attrs.get("strides", ksize)]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    if attrs.get("global_pooling", False) or attrs.get("adaptive", False) and list(
+        attrs.get("ksize")
+    ) == [1, 1]:
+        ksize = [x.shape[2], x.shape[3]]
+        strides = ksize
+        paddings = [0, 0]
+    window = (1, 1, ksize[0], ksize[1])
+    strd = (1, 1, strides[0], strides[1])
+    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]), (paddings[1], paddings[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strd, pads)
+    else:
+        s = lax.reduce_window(x, 0.0, lax.add, window, strd, pads)
+        if attrs.get("exclusive", True) and (paddings[0] or paddings[1]):
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strd, pads)
+            out = s / cnt
+        else:
+            out = s / (ksize[0] * ksize[1])
+    return {"Out": [out]}
+
+
+@register("batch_norm")
+def _batch_norm(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (scale,) = ins["Scale"]
+    (bias,) = ins["Bias"]
+    (mean,) = ins["Mean"]
+    (var,) = ins["Variance"]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = bool(attrs.get("is_test", False)) or bool(
+        attrs.get("use_global_stats", False)
+    )
+    layout = attrs.get("data_layout", "NCHW")
+    axes = (
+        tuple(i for i in range(x.ndim) if i != 1)
+        if layout == "NCHW"
+        else tuple(range(x.ndim - 1))
+    )
+    cshape = [1] * x.ndim
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    cshape[c_axis] = x.shape[c_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        saved_mean, saved_var = mean, var
+        mean_out, var_out = mean, var
+    else:
+        xf = x.astype(jnp.float32)
+        bmean = jnp.mean(xf, axis=axes)
+        bvar = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(bmean)
+        use_mean, use_var = bmean, bvar
+        saved_mean = bmean
+        saved_var = 1.0 / jnp.sqrt(bvar + eps)  # reference saves inv-std
+        mean_out = mean * momentum + bmean * (1 - momentum)
+        var_out = var * momentum + bvar * (1 - momentum)
+
+    inv = lax.rsqrt(use_var.reshape(cshape) + eps)
+    y = (x - use_mean.reshape(cshape)) * inv * scale.reshape(cshape) + bias.reshape(
+        cshape
+    )
+    return {
+        "Y": [y.astype(x.dtype)],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_var],
+    }
+
+
+@register("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    (x,) = ins["X"]
+    eps = attrs.get("epsilon", 1e-5)
+    bna = int(attrs.get("begin_norm_axis", 1))
+    lead = int(np.prod(x.shape[:bna]))
+    x2 = x.reshape((lead, -1)).astype(jnp.float32)
+    mean = jnp.mean(x2, axis=1)
+    var = jnp.var(x2, axis=1)
+    y = (x2 - mean[:, None]) * lax.rsqrt(var[:, None] + eps)
+    if "Scale" in ins:
+        y = y * ins["Scale"][0].reshape(-1)[None, :]
+    if "Bias" in ins:
+        y = y + ins["Bias"][0].reshape(-1)[None, :]
+    return {
+        "Y": [y.reshape(x.shape).astype(x.dtype)],
+        "Mean": [mean],
+        "Variance": [var],
+    }
+
+
+@register("lrn")
+def _lrn(ctx, ins, attrs):
+    (x,) = ins["X"]
+    n = int(attrs.get("n", 5))
+    k = attrs.get("k", 1.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i : i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+# ---------------------------------------------------------------------------
+# dropout — custom grad: must reuse the forward-sampled mask, so the generic
+# vjp-replay grad does not apply (reference dropout_op.cc keeps Mask for grad)
+# ---------------------------------------------------------------------------
+
+
+def _dropout_grad_maker(op, block, grad_map):
+    return [
+        {
+            "type": "dropout_grad",
+            "inputs": {
+                "Out@GRAD": [grad_map[op.output("Out")[0]]],
+                "Mask": [op.output("Mask")[0]],
+            },
+            "outputs": {"X@GRAD": [grad_map[op.input("X")[0]]]},
+            "attrs": {k: v for k, v in op.attrs.items()},
+        }
+    ]
+
+
+@register("dropout", stochastic=True, grad=_dropout_grad_maker)
+def _dropout(ctx, ins, attrs):
+    (x,) = ins["X"]
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if attrs.get("is_test", False):
+        mask = jnp.ones_like(x)
+        out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
+        return {"Out": [out], "Mask": [mask]}
+    keep = jax.random.bernoulli(_rng(ctx, attrs), 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        mask = keep.astype(x.dtype) / (1.0 - p)
+    else:
+        mask = keep.astype(x.dtype)
+    return {"Out": [x * mask], "Mask": [mask]}
+
+
+@register("dropout_grad", no_grad=True)
+def _dropout_grad(ctx, ins, attrs):
+    (dout,) = ins["Out@GRAD"]
+    (mask,) = ins["Mask"]
+    return {"X@GRAD": [dout * mask]}
+
+
+# ---------------------------------------------------------------------------
+# optimizer ops (reference: operators/optimizers/*.cc — sgd, momentum, adam,
+# adagrad, rmsprop, adadelta, adamax, decayed_adagrad, ftrl, lars_momentum).
+# Each consumes Param (+state) and emits ParamOut (+state outs) under the SAME
+# variable names; the executor's env-update model gives in-place semantics and
+# the jit donates param buffers.
+# ---------------------------------------------------------------------------
+
+
+def _p(ins, slot):
+    return ins[slot][0]
+
+
+@register("sgd", no_grad=True)
+def _sgd(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    return {"ParamOut": [p - lr.reshape(()).astype(p.dtype) * g]}
+
+
+@register("momentum", no_grad=True)
+def _momentum(ctx, ins, attrs):
+    p, g, v, lr = (
+        _p(ins, "Param"),
+        _p(ins, "Grad"),
+        _p(ins, "Velocity"),
+        _p(ins, "LearningRate"),
+    )
+    mu = attrs["mu"]
+    lr = lr.reshape(()).astype(p.dtype)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register("lars_momentum", no_grad=True)
+def _lars_momentum(ctx, ins, attrs):
+    p, g, v, lr = (
+        _p(ins, "Param"),
+        _p(ins, "Grad"),
+        _p(ins, "Velocity"),
+        _p(ins, "LearningRate"),
+    )
+    mu = attrs["mu"]
+    lars_coeff = attrs.get("lars_coeff", 0.001)
+    lars_wd = attrs.get("lars_weight_decay", 0.0005)
+    lr = lr.reshape(()).astype(jnp.float32)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+    local_lr = jnp.where(
+        (pn > 0) & (gn > 0), lr * lars_coeff * pn / (gn + lars_wd * pn), lr
+    )
+    v_out = mu * v + local_lr * (g + lars_wd * p)
+    return {"ParamOut": [p - v_out], "VelocityOut": [v_out]}
+
+
+@register("adam", no_grad=True)
+def _adam(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    m1, m2 = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p, b2p = _p(ins, "Beta1Pow"), _p(ins, "Beta2Pow")
+    b1, b2, eps = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999), attrs.get(
+        "epsilon", 1e-8
+    )
+    lr = lr.reshape(()).astype(jnp.float32)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_out = p - lr_t * m1o / (jnp.sqrt(m2o) + eps)
+    return {"ParamOut": [p_out], "Moment1Out": [m1o], "Moment2Out": [m2o]}
+
+
+@register("adagrad", no_grad=True)
+def _adagrad(ctx, ins, attrs):
+    p, g, lr, mom = (
+        _p(ins, "Param"),
+        _p(ins, "Grad"),
+        _p(ins, "LearningRate"),
+        _p(ins, "Moment"),
+    )
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = mom + jnp.square(g)
+    p_out = p - lr.reshape(()) * g / (jnp.sqrt(mom_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [mom_out]}
+
+
+@register("decayed_adagrad", no_grad=True)
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, lr, mom = (
+        _p(ins, "Param"),
+        _p(ins, "Grad"),
+        _p(ins, "LearningRate"),
+        _p(ins, "Moment"),
+    )
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = decay * mom + (1 - decay) * jnp.square(g)
+    p_out = p - lr.reshape(()) * g / (jnp.sqrt(mom_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [mom_out]}
+
+
+@register("rmsprop", no_grad=True)
+def _rmsprop(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    ms, mom = _p(ins, "MeanSquare"), _p(ins, "Moment")
+    eps, decay, momentum = (
+        attrs.get("epsilon", 1e-10),
+        attrs.get("decay", 0.9),
+        attrs.get("momentum", 0.0),
+    )
+    lr = lr.reshape(())
+    if attrs.get("centered", False):
+        mg = _p(ins, "MeanGrad")
+        ms_out = decay * ms + (1 - decay) * jnp.square(g)
+        mg_out = decay * mg + (1 - decay) * g
+        mom_out = momentum * mom + lr * g / jnp.sqrt(
+            ms_out - jnp.square(mg_out) + eps
+        )
+        return {
+            "ParamOut": [p - mom_out],
+            "MeanSquareOut": [ms_out],
+            "MomentOut": [mom_out],
+            "MeanGradOut": [mg_out],
+        }
+    ms_out = decay * ms + (1 - decay) * jnp.square(g)
+    mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out + eps)
+    return {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out], "MomentOut": [mom_out]}
+
+
+@register("adadelta", no_grad=True)
+def _adadelta(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    avg_sq_g, avg_sq_u = _p(ins, "AvgSquaredGrad"), _p(ins, "AvgSquaredUpdate")
+    rho, eps = attrs.get("rho", 0.95), attrs.get("epsilon", 1e-6)
+    asg = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (asg + eps)) * g
+    asu = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    return {
+        "ParamOut": [p + update],
+        "AvgSquaredGradOut": [asg],
+        "AvgSquaredUpdateOut": [asu],
+    }
+
+
+@register("adamax", no_grad=True)
+def _adamax(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    mom, inf_norm, b1p = _p(ins, "Moment"), _p(ins, "InfNorm"), _p(ins, "Beta1Pow")
+    b1, b2, eps = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999), attrs.get(
+        "epsilon", 1e-8
+    )
+    mom_out = b1 * mom + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf_norm, jnp.abs(g))
+    lr_t = lr.reshape(()) / (1 - b1p.reshape(()))
+    p_out = p - lr_t * mom_out / (inf_out + eps)
+    return {"ParamOut": [p_out], "MomentOut": [mom_out], "InfNormOut": [inf_out]}
+
+
+@register("ftrl", no_grad=True)
+def _ftrl(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    sq_acc, lin_acc = _p(ins, "SquaredAccumulator"), _p(ins, "LinearAccumulator")
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    lr = lr.reshape(())
+    new_acc = sq_acc + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_acc) - jnp.sqrt(sq_acc)) / lr
+    else:
+        sigma = (jnp.power(new_acc, -lr_power) - jnp.power(sq_acc, -lr_power)) / lr
+    lin_out = lin_acc + g - sigma * p
+    if lr_power == -0.5:
+        x_den = l2 + jnp.sqrt(new_acc) / lr
+    else:
+        x_den = l2 + jnp.power(new_acc, -lr_power) / lr
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = pre / x_den
+    return {
+        "ParamOut": [p_out],
+        "SquaredAccumOut": [new_acc],
+        "LinearAccumOut": [lin_out],
+    }

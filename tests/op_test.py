@@ -1,0 +1,155 @@
+"""OpTest harness (reference python/paddle/fluid/tests/unittests/op_test.py:132).
+
+Subclasses declare `op_type`, `inputs`, `outputs`, `attrs` as numpy data;
+`check_output()` runs the single op through a real Program/Executor and
+compares against the declared numpy reference; `check_grad()` compares
+analytic gradients (via append_backward over the generic vjp grad ops) against
+central finite differences (reference op_test.py:43 get_numeric_gradient).
+"""
+
+import unittest
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Executor, Scope, scope_guard
+
+
+class OpTest(unittest.TestCase):
+    @classmethod
+    def setUpClass(cls):
+        cls._exe = Executor(fluid.CPUPlace())
+
+    def _build(self):
+        main = framework.Program()
+        startup = framework.Program()
+        self._feed = {}
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            op_inputs = {}
+            for slot, data in getattr(self, "inputs", {}).items():
+                entries = data if isinstance(data, list) else [(slot, data)]
+                names = []
+                for name, arr in entries:
+                    arr = np.asarray(arr)
+                    block.create_var(
+                        name=name,
+                        shape=arr.shape,
+                        dtype=framework.convert_np_dtype(arr.dtype),
+                        stop_gradient=False,
+                    )
+                    self._feed[name] = arr
+                    names.append(name)
+                op_inputs[slot] = names
+            op_outputs = {}
+            self._expect = {}
+            for slot, data in self.outputs.items():
+                entries = data if isinstance(data, list) else [(slot, data)]
+                names = []
+                for name, arr in entries:
+                    names.append(name)
+                    self._expect[name] = np.asarray(arr)
+                    block.create_var(name=name, shape=None, dtype=None)
+                op_outputs[slot] = names
+            block.append_op(
+                type=self.op_type,
+                inputs=op_inputs,
+                outputs=op_outputs,
+                attrs=getattr(self, "attrs", {}),
+            )
+        return main, startup
+
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=None):
+        main, _ = self._build()
+        fetch = [n for n in self._expect if n not in (no_check_set or [])]
+        with scope_guard(Scope()):
+            results = self._exe.run(main, feed=self._feed, fetch_list=fetch)
+        for name, got in zip(fetch, results):
+            want = self._expect[name]
+            np.testing.assert_allclose(
+                got.astype(np.float64) if got.dtype != bool else got,
+                want.astype(np.float64) if want.dtype != object and want.dtype != bool else want,
+                atol=atol,
+                rtol=rtol,
+                err_msg="output %r of op %s mismatch" % (name, self.op_type),
+            )
+
+    def _loss_program(self):
+        """Scalar loss = sum over outputs of mean(out * W_fixed). The fixed
+        random weighting avoids degenerate gradients (e.g. mean of softmax is
+        constant, making d(loss)/dX identically zero)."""
+        main, _ = self._build()
+        rng = np.random.RandomState(123)
+        with fluid.program_guard(main, framework.Program()):
+            block = main.global_block()
+            means = []
+            for name in self._expect:
+                v = block.var(name)
+                if not framework.is_float_dtype(v.dtype):
+                    continue
+                w_name = name + "@LOSS_W"
+                w = rng.uniform(0.1, 1.0, self._expect[name].shape).astype("float32")
+                block.create_var(
+                    name=w_name, shape=w.shape, dtype="float32", stop_gradient=True
+                )
+                self._feed[w_name] = w
+                weighted = block.create_var(dtype=v.dtype)
+                block.append_op(
+                    type="elementwise_mul",
+                    inputs={"X": [name], "Y": [w_name]},
+                    outputs={"Out": [weighted.name]},
+                    attrs={"axis": -1},
+                )
+                means.append(fluid.layers.mean(weighted))
+            loss = means[0]
+            for m in means[1:]:
+                loss = fluid.layers.elementwise_add(loss, m)
+        return main, loss
+
+    def check_grad(
+        self,
+        inputs_to_check,
+        output_names=None,
+        max_relative_error=0.005,
+        numeric_grad_delta=1e-3,
+        no_grad_set=None,
+    ):
+        main, loss = self._loss_program()
+        with fluid.program_guard(main, framework.Program()):
+            pg = fluid.append_backward(loss, no_grad_set=no_grad_set)
+        grad_names = [framework.grad_var_name(n) for n in inputs_to_check]
+        with scope_guard(Scope()):
+            analytic = self._exe.run(main, feed=self._feed, fetch_list=grad_names)
+
+        # numeric: central differences on the loss program
+        fwd_main, fwd_loss = self._loss_program()
+
+        def loss_at(feed):
+            with scope_guard(Scope()):
+                (val,) = self._exe.run(fwd_main, feed=feed, fetch_list=[fwd_loss.name])
+            return float(val.reshape(()))
+
+        for name, a_grad in zip(inputs_to_check, analytic):
+            base = self._feed[name].astype(np.float64)
+            num = np.zeros_like(base, dtype=np.float64)
+            flat = base.reshape(-1)
+            for i in range(flat.size):
+                orig = flat[i]
+                feed = dict(self._feed)
+                pert = base.copy().reshape(-1)
+                pert[i] = orig + numeric_grad_delta
+                feed[name] = pert.reshape(base.shape).astype(self._feed[name].dtype)
+                up = loss_at(feed)
+                pert[i] = orig - numeric_grad_delta
+                feed[name] = pert.reshape(base.shape).astype(self._feed[name].dtype)
+                down = loss_at(feed)
+                num.reshape(-1)[i] = (up - down) / (2 * numeric_grad_delta)
+            abs_max = max(np.abs(num).max(), np.abs(a_grad).max(), 1e-3)
+            diff = np.abs(num - a_grad.astype(np.float64)).max() / abs_max
+            self.assertLessEqual(
+                diff,
+                max_relative_error,
+                "gradient of %r for op %s: max rel err %.5f (analytic vs numeric)"
+                % (name, self.op_type, diff),
+            )

@@ -1,0 +1,118 @@
+"""Framework-core unit tests (reference unittests/test_program.py,
+test_operator_desc.py, test_protobuf_descs.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.framework import Program
+
+
+def test_program_block_structure():
+    p = Program()
+    assert p.num_blocks == 1
+    b = p._create_block()
+    assert b.idx == 1 and b.parent_idx == 0
+    p._rollback()
+    assert p.current_block().idx == 0
+
+
+def test_var_and_op_append():
+    p = Program()
+    blk = p.global_block()
+    x = blk.create_var(name="x", shape=[2, 3], dtype="float32")
+    y = blk.create_var(name="y", shape=[2, 3], dtype="float32")
+    out = blk.create_var(name="out")
+    op = blk.append_op(
+        type="elementwise_add",
+        inputs={"X": ["x"], "Y": ["y"]},
+        outputs={"Out": ["out"]},
+    )
+    assert op.type == "elementwise_add"
+    # infer_shape via eval_shape populated output metadata
+    assert blk.var("out").shape == (2, 3)
+    assert blk.var("out").dtype == "float32"
+
+
+def test_dynamic_batch_dim_inference():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[-1, 3], dtype="float32")
+    blk.create_var(name="out")
+    blk.append_op(type="relu", inputs={"X": ["x"]}, outputs={"Out": ["out"]})
+    assert blk.var("out").shape == (-1, 3)
+
+
+def test_clone_for_test_flips_is_test():
+    main = Program()
+    with fluid.program_guard(main, Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.dropout(x, dropout_prob=0.5)
+    clone = main.clone(for_test=True)
+    ops = [op for op in clone.global_block().ops if op.type == "dropout"]
+    assert ops and ops[0].attrs["is_test"] is True
+    # original untouched
+    ops0 = [op for op in main.global_block().ops if op.type == "dropout"]
+    assert ops0[0].attrs["is_test"] is False
+
+
+def test_serialization_roundtrip():
+    main = Program()
+    with fluid.program_guard(main, Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=3, act="relu")
+    d = main.to_dict()
+    restored = Program.from_dict(d)
+    assert [op.type for op in restored.global_block().ops] == [
+        op.type for op in main.global_block().ops
+    ]
+    assert restored.global_block().var(y.name).shape == y.shape
+    params = restored.global_block().all_parameters()
+    assert len(params) == 2  # weight + bias
+
+
+def test_prune_keeps_needed_ops_only():
+    main = Program()
+    with fluid.program_guard(main, Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=3)
+        unrelated = fluid.layers.fc(x, size=7)
+    pruned = main._prune([h])
+    kept_types = [op.type for op in pruned.global_block().ops]
+    # unrelated fc's mul must be gone
+    assert len(kept_types) < len(main.global_block().ops)
+
+
+def test_dtype_canonicalization():
+    assert framework.convert_np_dtype("float64") == "float32"
+    assert framework.convert_np_dtype("int64") == "int32"
+    assert framework.convert_np_dtype(np.float32) == "float32"
+    assert framework.convert_np_dtype(5) == "float32"  # proto enum FP32
+
+
+def test_operator_overloading_builds_ops():
+    main = Program()
+    with fluid.program_guard(main, Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = x * 2.0 + 1.0
+        z = x + y
+    types = [op.type for op in main.global_block().ops]
+    assert "scale" in types and "elementwise_add" in types
+
+
+def test_stop_gradient_blocks_backward():
+    main = Program()
+    with fluid.program_guard(main, Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h1 = fluid.layers.fc(x, size=4)
+        h1.stop_gradient = True
+        h2 = fluid.layers.fc(h1, size=1)
+        loss = fluid.layers.mean(h2)
+        pg = fluid.append_backward(loss)
+    # only the second fc's params get grads
+    grad_params = {p.name for p, g in pg}
+    first_fc_w = main.global_block().all_parameters()[0].name
+    assert all("fc_1" in n or "fc_0" not in n for n in grad_params) or (
+        first_fc_w not in grad_params
+    )

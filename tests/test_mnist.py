@@ -1,0 +1,104 @@
+"""End-to-end LeNet training test (reference
+python/paddle/fluid/tests/book/test_recognize_digits.py — train a few
+iterations, assert loss decreases, exercise clone(for_test) inference).
+Synthetic class-dependent data (zero-egress environment)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def make_batch(rng, batch_size, num_classes=10):
+    """Images whose top-left patch intensity encodes the label — linearly
+    separable so a few steps of SGD must learn it."""
+    labels = rng.randint(0, num_classes, (batch_size, 1)).astype("int64")
+    imgs = rng.randn(batch_size, 1, 28, 28).astype("float32") * 0.1
+    for i, l in enumerate(labels.flatten()):
+        imgs[i, 0, : 14, : 14] += l / float(num_classes)
+        imgs[i, 0, 14:, 14:] -= l / float(num_classes)
+    return imgs, labels
+
+
+def lenet(img, label):
+    conv1 = fluid.layers.conv2d(img, num_filters=6, filter_size=5, padding=2, act="relu")
+    pool1 = fluid.layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = fluid.layers.conv2d(pool1, num_filters=16, filter_size=5, act="relu")
+    pool2 = fluid.layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    fc1 = fluid.layers.fc(pool2, size=120, act="relu")
+    fc2 = fluid.layers.fc(fc1, size=84, act="relu")
+    logits = fluid.layers.fc(fc2, size=10)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+    return avg_loss, acc
+
+
+def test_mnist_lenet_converges():
+    main = framework.Program()
+    startup = framework.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        avg_loss, acc = lenet(img, label)
+        test_program = main.clone(for_test=True)
+        opt = fluid.optimizer.Adam(learning_rate=1e-3)
+        opt.minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(7)
+    with scope_guard(Scope(seed=7)):
+        exe.run(startup)
+        losses, accs = [], []
+        for step in range(60):
+            imgs, labels = make_batch(rng, 32)
+            loss_v, acc_v = exe.run(
+                main,
+                feed={"img": imgs, "label": labels},
+                fetch_list=[avg_loss.name, acc.name],
+            )
+            losses.append(float(loss_v[0]))
+            accs.append(float(acc_v[0]))
+
+        first5 = np.mean(losses[:5])
+        last5 = np.mean(losses[-5:])
+        assert last5 < first5 * 0.7, "loss did not decrease: %s -> %s" % (first5, last5)
+        assert np.mean(accs[-5:]) > 0.5, "accuracy too low: %s" % np.mean(accs[-5:])
+
+        # inference on the for_test clone (dropout/bn switch to eval); batch
+        # size differs from training to exercise the shape-keyed compile cache
+        imgs, labels = make_batch(rng, 16)
+        (test_loss,) = exe.run(
+            test_program,
+            feed={"img": imgs, "label": labels},
+            fetch_list=[avg_loss.name],
+        )
+        assert np.isfinite(test_loss).all()
+
+
+def test_sgd_and_momentum_also_train():
+    for make_opt in [
+        lambda: fluid.optimizer.SGD(learning_rate=0.1),
+        lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9),
+    ]:
+        main = framework.Program()
+        startup = framework.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            make_opt().minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        W = rng.randn(8, 1).astype("float32")
+        with scope_guard(Scope()):
+            exe.run(startup)
+            losses = []
+            for _ in range(40):
+                xs = rng.randn(16, 8).astype("float32")
+                ys = xs @ W
+                (l,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss.name])
+                losses.append(float(l[0]))
+        assert losses[-1] < losses[0] * 0.3

@@ -1,0 +1,439 @@
+"""Per-op unit tests via the OpTest harness (reference: the ~250
+test_*_op.py files under python/paddle/fluid/tests/unittests/)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_add"
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"])
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    """paddle-style axis broadcast: y aligned to x at axis=1"""
+
+    def setUp(self):
+        self.op_type = "elementwise_add"
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"])
+
+
+class TestMul(OpTest):
+    def setUp(self):
+        self.op_type = "mul"
+        x = np.random.rand(4, 2, 3).astype("float32")
+        y = np.random.rand(6, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": x.reshape(4, 6) @ y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"])
+
+
+class TestMatmulTranspose(OpTest):
+    def setUp(self):
+        self.op_type = "matmul"
+        x = np.random.rand(5, 3).astype("float32")
+        y = np.random.rand(5, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": False, "alpha": 2.0}
+        self.outputs = {"Out": 2.0 * x.T @ y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], max_relative_error=0.01)
+
+
+class TestBatchedMatmul(OpTest):
+    def setUp(self):
+        self.op_type = "matmul"
+        x = np.random.rand(2, 5, 3).astype("float32")
+        y = np.random.rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.matmul(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    def setUp(self):
+        self.op_type = "softmax"
+        x = np.random.rand(6, 10).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        # f32 finite differences on small softmax grads are noisy
+        self.check_grad(["X"], max_relative_error=0.02, numeric_grad_delta=5e-3)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    def setUp(self):
+        self.op_type = "softmax_with_cross_entropy"
+        logits = np.random.rand(5, 7).astype("float32")
+        label = np.random.randint(0, 7, (5, 1)).astype("int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        softmax = e / e.sum(-1, keepdims=True)
+        loss = -np.log(softmax[np.arange(5), label.flatten()]).reshape(5, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": softmax, "Loss": loss}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        # label is int → only Logits differentiable
+        self.check_grad(["Logits"])
+
+
+class TestCrossEntropy(OpTest):
+    def setUp(self):
+        self.op_type = "cross_entropy"
+        x = np.random.uniform(0.1, 1.0, (5, 7)).astype("float32")
+        x /= x.sum(-1, keepdims=True)
+        label = np.random.randint(0, 7, (5, 1)).astype("int64")
+        loss = -np.log(x[np.arange(5), label.flatten()]).reshape(5, 1)
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Y": loss}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReduceSum(OpTest):
+    def setUp(self):
+        self.op_type = "reduce_sum"
+        x = np.random.rand(3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False}
+        self.outputs = {"Out": x.sum(1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"])
+
+
+class TestReduceMeanAll(OpTest):
+    def setUp(self):
+        self.op_type = "reduce_mean"
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": x.mean().reshape(1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestConv2d(OpTest):
+    def setUp(self):
+        self.op_type = "conv2d"
+        x = np.random.rand(2, 3, 8, 8).astype("float32")
+        w = np.random.rand(4, 3, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1], "groups": 1}
+        # numpy reference conv
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        out = np.zeros((2, 4, 8, 8), dtype="float64")
+        for n in range(2):
+            for o in range(4):
+                for i in range(8):
+                    for j in range(8):
+                        out[n, o, i, j] = np.sum(xp[n, :, i : i + 3, j : j + 3] * w[o])
+        self.outputs = {"Output": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(
+            ["Input", "Filter"], max_relative_error=0.03, numeric_grad_delta=5e-3
+        )
+
+
+class TestPool2dMax(OpTest):
+    def setUp(self):
+        self.op_type = "pool2d"
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        out = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], max_relative_error=0.02)
+
+
+class TestPool2dAvg(OpTest):
+    def setUp(self):
+        self.op_type = "pool2d"
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        out = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLookupTable(OpTest):
+    def setUp(self):
+        self.op_type = "lookup_table"
+        w = np.random.rand(10, 4).astype("float32")
+        ids = np.random.randint(0, 10, (5, 1)).astype("int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids.flatten()]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["W"])
+
+
+class TestTopK(OpTest):
+    def setUp(self):
+        self.op_type = "top_k"
+        x = np.random.rand(4, 6).astype("float32")
+        k = 2
+        idx = np.argsort(-x, axis=1)[:, :k]
+        self.inputs = {"X": x}
+        self.attrs = {"k": k}
+        self.outputs = {
+            "Out": np.take_along_axis(x, idx, 1),
+            "Indices": idx.astype("int32"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestConcat(OpTest):
+    def setUp(self):
+        self.op_type = "concat"
+        a = np.random.rand(2, 3).astype("float32")
+        b = np.random.rand(2, 5).astype("float32")
+        self.inputs = {"X": [("x0", a), ("x1", b)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x0", "x1"])
+
+
+class TestSplit(OpTest):
+    def setUp(self):
+        self.op_type = "split"
+        x = np.random.rand(4, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"num": 2, "sections": [], "axis": 1}
+        self.outputs = {"Out": [("out0", x[:, :3]), ("out1", x[:, 3:])]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReshape2(OpTest):
+    def setUp(self):
+        self.op_type = "reshape2"
+        x = np.random.rand(2, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [3, 4]}
+        self.outputs = {"Out": x.reshape(3, 4)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"])
+
+
+class TestTranspose2(OpTest):
+    def setUp(self):
+        self.op_type = "transpose2"
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [1, 0, 2]}
+        self.outputs = {"Out": x.transpose(1, 0, 2)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBatchNormInference(OpTest):
+    def setUp(self):
+        self.op_type = "batch_norm"
+        n, c, h, w = 2, 3, 4, 4
+        x = np.random.rand(n, c, h, w).astype("float32")
+        scale = np.random.rand(c).astype("float32")
+        bias = np.random.rand(c).astype("float32")
+        mean = np.random.rand(c).astype("float32")
+        var = np.random.rand(c).astype("float32") + 0.5
+        eps = 1e-5
+        y = (x - mean.reshape(1, c, 1, 1)) / np.sqrt(
+            var.reshape(1, c, 1, 1) + eps
+        ) * scale.reshape(1, c, 1, 1) + bias.reshape(1, c, 1, 1)
+        self.inputs = {
+            "X": x,
+            "Scale": scale,
+            "Bias": bias,
+            "Mean": mean,
+            "Variance": var,
+        }
+        self.attrs = {"is_test": True, "epsilon": eps}
+        self.outputs = {"Y": y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, no_check_set=None)
+
+
+class TestLayerNorm(OpTest):
+    def setUp(self):
+        self.op_type = "layer_norm"
+        x = np.random.rand(3, 10).astype("float32")
+        scale = np.random.rand(10).astype("float32")
+        bias = np.random.rand(10).astype("float32")
+        eps = 1e-5
+        mean = x.mean(1, keepdims=True)
+        var = x.var(1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps, "begin_norm_axis": 1}
+        self.outputs = {"Y": y, "Mean": mean.flatten(), "Variance": var.flatten()}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], max_relative_error=0.02)
+
+
+class TestSigmoid(OpTest):
+    def setUp(self):
+        self.op_type = "sigmoid"
+        x = np.random.uniform(-3, 3, (4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": 1.0 / (1.0 + np.exp(-x))}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"])
+
+
+class TestTanh(OpTest):
+    def setUp(self):
+        self.op_type = "tanh"
+        x = np.random.uniform(-3, 3, (4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.tanh(x)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"])
+
+
+class TestGather(OpTest):
+    def setUp(self):
+        self.op_type = "gather"
+        x = np.random.rand(8, 3).astype("float32")
+        idx = np.array([1, 3, 5]).astype("int64")
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"])
+
+
+class TestScale(OpTest):
+    def setUp(self):
+        self.op_type = "scale"
+        x = np.random.rand(4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0}
+        self.outputs = {"Out": x * 2.5 + 1.0}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"])
+
+
+class TestDropoutInference(OpTest):
+    def setUp(self):
+        self.op_type = "dropout"
+        x = np.random.rand(4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True}
+        self.outputs = {"Out": x * 0.7, "Mask": np.ones_like(x)}
+
+    def test_output(self):
+        self.check_output(no_check_set=["Mask"])
+
+
+class TestOneHot(OpTest):
+    def setUp(self):
+        self.op_type = "one_hot"
+        x = np.array([[1], [0], [3]]).astype("int64")
+        out = np.zeros((3, 4), dtype="float32")
+        out[np.arange(3), x.flatten()] = 1.0
+        self.inputs = {"X": x}
+        self.attrs = {"depth": 4}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
