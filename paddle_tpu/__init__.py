@@ -30,6 +30,7 @@ from .framework import (
     name_scope,
     program_guard,
 )
+from .parallel_executor import BuildStrategy, ExecutionStrategy, ParallelExecutor
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .place import (
     CPUPlace,

@@ -75,6 +75,8 @@ class scope_guard:
 
 
 def _as_feed_array(value, var):
+    if isinstance(value, jax.Array):
+        return value  # device-resident feed: no host round-trip
     arr = np.asarray(value)
     if var is not None and var.dtype is not None:
         arr = arr.astype(np.dtype(var.dtype) if var.dtype != "bfloat16" else jnp.bfloat16)
@@ -83,9 +85,17 @@ def _as_feed_array(value, var):
 
 class _CompiledBlock:
     """A lowered + jitted block: knows its state split (read-only vs mutated
-    persistables) and fetch names."""
+    persistables) and fetch names.
 
-    def __init__(self, program, block, feed_names, fetch_names, scope):
+    With `mesh`, the same lowering compiles SPMD (the TPU-native replacement
+    for the reference's ParallelExecutor SSA graph + NCCL, SURVEY.md §2.2):
+    feeds are batch-sharded over the mesh's data axes, state is replicated,
+    and XLA's GSPMD partitioner inserts the gradient all-reduce over ICI at
+    the same seam where the reference's multi_devices_graph_pass inserted
+    ncclAllReduce ops."""
+
+    def __init__(self, program, block, feed_names, fetch_names, scope, mesh=None,
+                 data_axes=("dp",), feed_ranks=None):
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         ops = [
@@ -166,11 +176,44 @@ class _CompiledBlock:
                             env[name] = val
             fetches = [env[n] for n in self.fetch_names]
             new_mut = {n: env[n] for n in self.mut_names}
+            # an op may legally omit a declared output slot (lowering returns
+            # None) — only bind names that actually materialized
             created = {n: env[n] for n in self.created_persistables if n in env}
             return fetches, new_mut, created, ctx.key
 
+        self.fn = run  # un-jitted lowering, reusable by __graft_entry__ et al.
         # donate the mutated-state pytree: params update in place on device
-        self.jitted = jax.jit(run, donate_argnums=(2,))
+        if mesh is None:
+            self.jitted = jax.jit(run, donate_argnums=(2,))
+            self._feed_sharding = None
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            batch = NamedSharding(mesh, P(data_axes))
+            repl = NamedSharding(mesh, P())
+            self._feed_sharding = batch
+            # rank-0 feeds (scalars) cannot be batch-sharded — replicate them
+            feed_ranks = feed_ranks or {}
+            feed_sh = {
+                n: (batch if feed_ranks.get(n, 1) else repl)
+                for n in self.feed_names
+            }
+            ro_sh = {n: repl for n in self.ro_names}
+            mut_sh = {n: repl for n in self.mut_names}
+            # created dict's membership is only known at trace time (ops may
+            # omit declared outputs), so its sharding is left to XLA (None)
+            out_sh = (
+                [repl] * len(self.fetch_names),
+                {n: repl for n in self.mut_names},
+                None,
+                repl,
+            )
+            self.jitted = jax.jit(
+                run,
+                donate_argnums=(2,),
+                in_shardings=(feed_sh, ro_sh, mut_sh, repl),
+                out_shardings=out_sh,
+            )
 
     def __call__(self, scope, feed_arrays):
         ro = {n: scope.vars[n] for n in self.ro_names}

@@ -14,3 +14,8 @@ from .. import (  # noqa: F401
     unique_name,
 )
 from ..executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from ..parallel_executor import (  # noqa: F401
+    BuildStrategy,
+    ExecutionStrategy,
+    ParallelExecutor,
+)

@@ -1,0 +1,8 @@
+"""Model zoo built purely from fluid-style layers — the acceptance configs of
+BASELINE.json (MNIST LeNet, ResNet-50, VGG, Transformer NMT, DeepFM CTR,
+stacked-LSTM LM), mirroring reference benchmark/fluid/models/."""
+
+from . import lenet, resnet, vgg
+from .lenet import lenet5
+from .resnet import resnet50, resnet_cifar10
+from .vgg import vgg16

@@ -1,0 +1,30 @@
+"""VGG-16 (reference benchmark/fluid/models/vgg.py conv_block structure)."""
+
+from .. import layers
+
+
+def conv_block(input, num_filter, groups):
+    conv = input
+    for _ in range(groups):
+        conv = layers.conv2d(
+            conv, num_filters=num_filter, filter_size=3, padding=1, act="relu"
+        )
+    return layers.pool2d(conv, pool_size=2, pool_stride=2)
+
+
+def vgg16(img, label, class_num=1000, dropout=True):
+    conv1 = conv_block(img, 64, 2)
+    conv2 = conv_block(conv1, 128, 2)
+    conv3 = conv_block(conv2, 256, 3)
+    conv4 = conv_block(conv3, 512, 3)
+    conv5 = conv_block(conv4, 512, 3)
+    fc1 = layers.fc(conv5, size=4096, act="relu")
+    if dropout:
+        fc1 = layers.dropout(fc1, dropout_prob=0.5)
+    fc2 = layers.fc(fc1, size=4096, act="relu")
+    if dropout:
+        fc2 = layers.dropout(fc2, dropout_prob=0.5)
+    logits = layers.fc(fc2, size=class_num)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return loss, acc, logits

@@ -1,0 +1,84 @@
+"""ParallelExecutor correctness = convergence equivalence with the plain
+Executor (reference unittests/parallel_executor_test_base.py
+check_network_convergence), run on the 8-device virtual CPU mesh."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def build_model():
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=32, act="relu")
+    logits = fluid.layers.fc(h, size=4)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, y)
+    )
+    return loss
+
+
+def make_data(rng, n):
+    x = rng.randn(n, 16).astype("float32")
+    y = (np.abs(x[:, :4]).argmax(1)).astype("int64").reshape(n, 1)
+    return x, y
+
+
+def train(use_pe, batches, seed=3):
+    main = framework.Program()
+    startup = framework.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            loss = build_model()
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with scope_guard(Scope(seed=seed)):
+        exe.run(startup)
+        runner = (
+            fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name, main_program=main)
+            if use_pe
+            else None
+        )
+        for x, y in batches:
+            if use_pe:
+                (l,) = runner.run(fetch_list=[loss.name], feed={"x": x, "y": y})
+            else:
+                (l,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss.name])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+    return losses
+
+
+def test_pe_matches_single_device_convergence():
+    rng = np.random.RandomState(0)
+    batches = [make_data(rng, 64) for _ in range(20)]
+    single = train(False, batches)
+    multi = train(True, batches)
+    # same data, same init seed → identical trajectories up to fp reduction order
+    np.testing.assert_allclose(single, multi, rtol=2e-3, atol=2e-4)
+    assert multi[-1] < multi[0] * 0.9
+
+
+def test_pe_rejects_indivisible_batch():
+    import jax
+
+    main = framework.Program()
+    startup = framework.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            loss = build_model()
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(main_program=main, loss_name=loss.name)
+        if pe.device_count > 1:
+            rng = np.random.RandomState(0)
+            x, y = make_data(rng, pe.device_count + 1)
+            try:
+                pe.run(fetch_list=[loss.name], feed={"x": x, "y": y})
+                raise AssertionError("expected ValueError for indivisible batch")
+            except ValueError:
+                pass
