@@ -12,14 +12,20 @@ isn't enough.
 from . import (
     backward,
     clip,
+    dataset,
     framework,
     initializer,
+    io,
     layers,
     optimizer,
     param_attr,
+    reader,
     regularizer,
     unique_name,
 )
+from .batch import batch
+from .data_feeder import DataFeeder
+from .py_reader import EOFException
 from .backward import append_backward
 from .executor import Executor, Scope, global_scope, scope_guard
 from .framework import (

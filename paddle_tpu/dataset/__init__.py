@@ -1,0 +1,14 @@
+"""Dataset package (reference python/paddle/dataset/ — mnist, cifar, imdb,
+uci_housing, wmt14/16, movielens, flowers…).
+
+The reference downloads from public mirrors at import time. This build runs in
+zero-egress environments, so each dataset module serves from a local cache dir
+(`PADDLE_TPU_DATA_HOME`, default ~/.cache/paddle_tpu/dataset) when real files
+exist there, and otherwise falls back to a DOCUMENTED deterministic synthetic
+sample stream with the same shapes/dtypes/vocabulary so that models, readers,
+and tests exercise the identical code path.
+"""
+
+from . import cifar, imdb, mnist, uci_housing
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "common"]

@@ -75,11 +75,17 @@ class scope_guard:
 
 
 def _as_feed_array(value, var):
-    if isinstance(value, jax.Array):
-        return value  # device-resident feed: no host round-trip
-    arr = np.asarray(value)
+    want = None
     if var is not None and var.dtype is not None:
-        arr = arr.astype(np.dtype(var.dtype) if var.dtype != "bfloat16" else jnp.bfloat16)
+        want = jnp.bfloat16 if var.dtype == "bfloat16" else np.dtype(var.dtype)
+    if isinstance(value, jax.Array):
+        # device-resident feed: cast on device if needed, no host round-trip
+        if want is not None and value.dtype != jnp.dtype(want):
+            value = value.astype(want)
+        return value
+    arr = np.asarray(value)
+    if want is not None:
+        arr = arr.astype(want)
     return arr
 
 
@@ -257,6 +263,11 @@ class Executor:
             program = framework.default_main_program()
         if feed is None:
             feed = {}
+            # pull staged batches from started py_readers (reference read_op
+            # popping the LoDTensorBlockingQueue); raises EOFException at end
+            for rd in getattr(program, "_py_readers", []):
+                if rd.started:
+                    feed.update(rd.next_batch())
         if fetch_list is None:
             fetch_list = []
         scope = scope or global_scope()

@@ -7,12 +7,15 @@ from .. import (  # noqa: F401
     clip,
     framework,
     initializer,
+    io,
     layers,
     optimizer,
     param_attr,
     regularizer,
     unique_name,
 )
+from ..data_feeder import DataFeeder  # noqa: F401
+from ..py_reader import EOFException  # noqa: F401
 from ..executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from ..parallel_executor import (  # noqa: F401
     BuildStrategy,

@@ -598,9 +598,7 @@ class Program:
             used.update(op.input_arg_names)
             used.update(op.output_arg_names)
         blk.vars = {
-            n: v
-            for n, v in blk.vars.items()
-            if n in used or n in target_names or v.persistable
+            n: v for n, v in blk.vars.items() if n in used or n in target_names
         }
         p._bump_version()
         return p

@@ -1,0 +1,215 @@
+"""Checkpoint and inference-model I/O (reference python/paddle/fluid/io.py:
+save/load_vars:89, save/load_params, save/load_persistables:270/490,
+save/load_inference_model:570/703).
+
+Design deviation from the reference (documented): the reference serializes
+tensors via save/load *ops* (operators/save_op.cc, load_op.cc) executed inside
+programs. Side-effectful file ops don't belong inside an XLA module, so here
+save/load are host-side executor-level operations reading/writing the Scope —
+the user-visible API and on-disk completeness are the same. Tensors are stored
+as .npy (one file per var) or a single .npz (`filename=` form, the reference's
+save_combine), and the program as JSON (`__model__`, the ProgramDesc analog).
+"""
+
+import json
+import os
+
+import numpy as np
+
+from . import framework
+from .executor import global_scope
+from .framework import Parameter, Program, Variable
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+    "get_inference_program",
+]
+
+MODEL_FILENAME = "__model__"
+
+
+def _bf16_safe_save(arr):
+    a = np.asarray(arr)
+    if a.dtype.name == "bfloat16" or "bfloat16" in str(a.dtype):
+        return a.astype(np.float32), "bfloat16"
+    return a, None
+
+
+def save_vars(
+    executor,
+    dirname,
+    main_program=None,
+    vars=None,
+    predicate=None,
+    filename=None,
+):
+    """Persist selected scope variables (reference io.py:89 save_vars)."""
+    program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = [v for v in program.list_vars() if predicate is None or predicate(v)]
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    combined = {}
+    meta = {}
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else str(v)
+        val = scope.find_var(name)
+        if val is None:
+            raise RuntimeError("variable %r has no value in scope; run startup first" % name)
+        arr, orig_dtype = _bf16_safe_save(val)
+        if orig_dtype:
+            meta[name] = orig_dtype
+        if filename is None:
+            np.save(os.path.join(dirname, name + ".npy"), arr)
+        else:
+            combined[name] = arr
+    if filename is not None:
+        np.savez(os.path.join(dirname, filename), **combined)
+    if meta:
+        with open(os.path.join(dirname, "__dtypes__.json"), "w") as f:
+            json.dump(meta, f)
+
+
+def _is_param(v):
+    return isinstance(v, Parameter)
+
+
+def _is_persistable(v):
+    return v.persistable and v.type not in (
+        framework.VarType.RAW,
+        framework.VarType.READER,
+    )
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(
+        executor, dirname, main_program, predicate=_is_param, filename=filename
+    )
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(
+        executor, dirname, main_program, predicate=_is_persistable, filename=filename
+    )
+
+
+def load_vars(
+    executor,
+    dirname,
+    main_program=None,
+    vars=None,
+    predicate=None,
+    filename=None,
+):
+    import jax.numpy as jnp
+
+    program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = [v for v in program.list_vars() if predicate is None or predicate(v)]
+    scope = global_scope()
+    meta_path = os.path.join(dirname, "__dtypes__.json")
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    combined = None
+    if filename is not None:
+        combined = np.load(os.path.join(dirname, filename + (".npz" if not filename.endswith(".npz") else "")))
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else str(v)
+        if combined is not None:
+            arr = combined[name]
+        else:
+            arr = np.load(os.path.join(dirname, name + ".npy"))
+        if meta.get(name) == "bfloat16":
+            arr = jnp.asarray(arr, dtype=jnp.bfloat16)
+        scope.set_var(name, jnp.asarray(arr))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(
+        executor, dirname, main_program, predicate=_is_param, filename=filename
+    )
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(
+        executor, dirname, main_program, predicate=_is_persistable, filename=filename
+    )
+
+
+def get_inference_program(target_vars, main_program=None):
+    program = main_program or framework.default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    pruned = program.clone(for_test=True)._prune(target_vars)
+    return pruned
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names,
+    target_vars,
+    executor,
+    main_program=None,
+    model_filename=None,
+    params_filename=None,
+    export_for_deployment=True,
+):
+    """Prune to targets + save program and params (reference io.py:570).
+    The saved `__model__` JSON also records feed/fetch names (the reference
+    encodes them as feed/fetch ops prepended/appended to the program)."""
+    program = main_program or framework.default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    pruned = program.clone(for_test=True)._prune(target_vars)
+    os.makedirs(dirname, exist_ok=True)
+    doc = pruned.to_dict()
+    doc["feed_var_names"] = list(feeded_var_names)
+    doc["fetch_var_names"] = [
+        t.name if isinstance(t, Variable) else str(t) for t in target_vars
+    ]
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "w") as f:
+        json.dump(doc, f)
+    # only persistables the pruned program still references
+    needed = {
+        v.name
+        for v in pruned.list_vars()
+        if v.persistable
+    }
+    save_vars(
+        executor,
+        dirname,
+        program,
+        vars=[v for v in program.list_vars() if v.persistable and v.name in needed],
+        filename=params_filename,
+    )
+    return doc["fetch_var_names"]
+
+
+def load_inference_model(
+    dirname, executor, model_filename=None, params_filename=None
+):
+    """Returns (program, feed_var_names, fetch_vars) like the reference
+    (io.py:703)."""
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME)) as f:
+        doc = json.load(f)
+    program = Program.from_dict(doc)
+    load_vars(
+        executor,
+        dirname,
+        program,
+        vars=[v for v in program.list_vars() if v.persistable],
+        filename=params_filename,
+    )
+    fetch_vars = [
+        program.global_block().var(n) for n in doc.get("fetch_var_names", [])
+    ]
+    return program, doc.get("feed_var_names", []), fetch_vars

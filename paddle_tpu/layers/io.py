@@ -1,10 +1,10 @@
 """Data-layer entry points (reference python/paddle/fluid/layers/io.py:39
-`data`, :633 `py_reader`)."""
+`data`, :633 `py_reader`, read_file, double_buffer)."""
 
-from .. import framework
+from .. import framework, unique_name
 from ..framework import VarType
 
-__all__ = ["data"]
+__all__ = ["data", "py_reader", "read_file", "double_buffer", "batch", "shuffle"]
 
 
 def data(
@@ -32,3 +32,97 @@ def data(
         lod_level=lod_level,
         is_data=True,
     )
+
+
+class GraphPyReader:
+    """The graph-side handle returned by layers.py_reader (reference
+    layers/io.py:633): owns the feed variables and the async device-prefetch
+    queue; the Executor pulls staged batches from it when run() gets no feed."""
+
+    def __init__(self, capacity, shapes, dtypes, lod_levels=None, name=None,
+                 use_double_buffer=True):
+        from ..py_reader import PyReader
+
+        program = framework.default_main_program()
+        name = name or unique_name.generate("py_reader")
+        self.name = name
+        lod_levels = lod_levels or [0] * len(shapes)
+        self.vars = []
+        for i, (shape, dtype, lod) in enumerate(zip(shapes, dtypes, lod_levels)):
+            v = program.current_block().create_var(
+                name="%s_slot_%d" % (name, i),
+                shape=list(shape),
+                dtype=dtype,
+                lod_level=lod,
+                is_data=True,
+                stop_gradient=True,
+            )
+            self.vars.append(v)
+        self._impl = PyReader(
+            [v.name for v in self.vars],
+            capacity=capacity,
+            return_device_arrays=use_double_buffer,
+        )
+        readers = getattr(program, "_py_readers", None)
+        if readers is None:
+            readers = program._py_readers = []
+        readers.append(self)
+
+    # delegate lifecycle to the async impl
+    def decorate_paddle_reader(self, reader, places=None):
+        from ..data_feeder import DataFeeder
+
+        self._impl.set_feeder(DataFeeder(self.vars))
+        self._impl._paddle_reader = reader
+        return self
+
+    def decorate_tensor_provider(self, reader):
+        return self._impl.decorate_tensor_provider(reader)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self._impl.decorate_batch_generator(reader)
+
+    def start(self):
+        return self._impl.start()
+
+    def reset(self):
+        return self._impl.reset()
+
+    def next_batch(self):
+        return self._impl.next_batch()
+
+    @property
+    def started(self):
+        return self._impl._started
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    return GraphPyReader(capacity, shapes, dtypes, lod_levels, name,
+                         use_double_buffer)
+
+
+def read_file(reader):
+    """Unpack a py_reader's slots into variables (reference layers/io.py
+    read_file → read_op)."""
+    if len(reader.vars) == 1:
+        return reader.vars[0]
+    return list(reader.vars)
+
+
+def double_buffer(reader, place=None, name=None):
+    """compat: prefetch-to-device is built into py_reader already"""
+    return reader
+
+
+def batch(reader, batch_size):
+    """compat alias for paddle.batch on a reader creator"""
+    from ..batch import batch as _batch
+
+    return _batch(reader, batch_size)
+
+
+def shuffle(reader, buffer_size):
+    from .. import reader as reader_mod
+
+    return reader_mod.shuffle(reader, buffer_size)

@@ -104,6 +104,12 @@ class ParallelExecutor:
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = feed if feed is not None else (feed_dict or {})
+        if not feed:
+            # pull staged batches from started py_readers, like Executor.run
+            feed = {}
+            for rd in getattr(self._program, "_py_readers", []):
+                if rd.started:
+                    feed.update(rd.next_batch())
         if isinstance(feed, (list, tuple)):
             # reference API form: one dict per device (reference
             # parallel_executor.py:183-213) — concatenate along the batch dim

@@ -1,0 +1,144 @@
+"""PyReader: decoupled async input pipeline with device-side prefetch.
+
+Reference analog: layers/io.py:633 py_reader + operators/reader/
+lod_tensor_blocking_queue.h + buffered_reader (double-buffer prefetch to
+device). A feeder thread pulls numpy batches from the user's reader, stages
+them on device (jax.device_put) AHEAD of compute, and the executor pops the
+staged batch at each run — overlapping host->device transfer with the previous
+step's compute, which is exactly what the reference's double_buffer reader did
+with CUDA streams. EOF surfaces as EOFException caught by the train loop
+(reference fluid_benchmark.py:244-246 pattern).
+"""
+
+import queue as Queue
+import threading
+
+import jax
+
+__all__ = ["PyReader", "EOFException"]
+
+
+class EOFException(Exception):
+    """reference core.EOFException"""
+
+
+class _EndOfEpoch:
+    pass
+
+
+class PyReader:
+    def __init__(self, feed_names, capacity=4, return_device_arrays=True):
+        self.feed_names = list(feed_names)
+        self.capacity = capacity
+        self._queue = None
+        self._thread = None
+        self._stop = None
+        self._paddle_reader = None
+        self._feeder = None
+        self._batched_tuples = False
+        self._return_device = return_device_arrays
+        self._started = False
+
+    # --- decoration (reference py_reader.decorate_paddle_reader) ---
+    def decorate_paddle_reader(self, reader, places=None):
+        """reader yields batches as lists of sample tuples (paddle.batch
+        output). Without an attached DataFeeder the columns are stacked
+        dense; ragged (LoD) fields need a DataFeeder (set_feeder)."""
+        self._paddle_reader = reader
+        self._batched_tuples = True
+        return self
+
+    def decorate_tensor_provider(self, reader):
+        """reader yields dicts name->numpy directly"""
+        self._paddle_reader = reader
+        self._raw_dicts = True
+        return self
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.decorate_tensor_provider(reader)
+
+    def set_feeder(self, feeder):
+        self._feeder = feeder
+        return self
+
+    # --- lifecycle ---
+    def start(self):
+        if self._started:
+            raise RuntimeError("PyReader already started; call reset() first")
+        self._queue = Queue.Queue(maxsize=self.capacity)
+        self._stop = threading.Event()
+        self._started = True
+
+        # local refs: reset() swaps these out mid-epoch
+        q = self._queue
+        stop = self._stop
+
+        def _convert(item):
+            if isinstance(item, dict):
+                return item
+            if self._feeder is not None:
+                return self._feeder.feed(item)
+            if self._batched_tuples:
+                # list of sample tuples (paddle.batch output) → column-stacked
+                import numpy as np
+
+                cols = list(zip(*item))
+                return {
+                    name: np.stack([np.asarray(v) for v in col])
+                    for name, col in zip(self.feed_names, cols)
+                }
+            return dict(zip(self.feed_names, item))
+
+        def _put(value):
+            while not stop.is_set():
+                try:
+                    q.put(value, timeout=0.1)
+                    return True
+                except Queue.Full:
+                    continue
+            return False
+
+        def fill():
+            try:
+                for item in self._paddle_reader():
+                    if stop.is_set():
+                        return
+                    feed = _convert(item)
+                    if self._return_device:
+                        # stage on device ahead of compute (double buffering)
+                        feed = {k: jax.device_put(v) for k, v in feed.items()}
+                    if not _put(feed):
+                        return
+            finally:
+                _put(_EndOfEpoch)
+
+        self._thread = threading.Thread(target=fill, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        """Stop the feeder thread (reference reader ResetAll); safe to call
+        mid-epoch — the thread exits and its staged buffers are dropped."""
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5)
+        self._started = False
+        self._queue = None
+        self._thread = None
+        self._stop = None
+
+    def next_batch(self):
+        if not self._started:
+            raise RuntimeError("PyReader not started")
+        item = self._queue.get()
+        if item is _EndOfEpoch:
+            self._started = False
+            raise EOFException("reader exhausted")
+        return item
+
+    def __call__(self):  # iterate batches
+        try:
+            while True:
+                yield self.next_batch()
+        except EOFException:
+            return

@@ -1,0 +1,228 @@
+"""Checkpoint I/O + data-layer tests (reference unittests: test_io_save_load*,
+test_py_reader_using_executor.py, reader decorator tests)."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _build_linear():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(x, size=2, act=None)
+    return x, y
+
+
+def test_save_load_persistables_roundtrip():
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x, y = _build_linear()
+    exe = fluid.Executor()
+    with tempfile.TemporaryDirectory() as d:
+        with scope_guard(Scope(seed=1)):
+            exe.run(startup)
+            xv = np.ones((3, 4), "float32")
+            (before,) = exe.run(main, feed={"x": xv}, fetch_list=[y.name])
+            fluid.io.save_persistables(exe, d, main)
+        # fresh scope: load and verify identical output
+        with scope_guard(Scope(seed=99)):
+            fluid.io.load_persistables(exe, d, main)
+            (after,) = exe.run(main, feed={"x": xv}, fetch_list=[y.name])
+        np.testing.assert_allclose(before, after)
+
+
+def test_save_load_inference_model():
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x, y = _build_linear()
+        # extra head that must be pruned away
+        z = fluid.layers.fc(x, size=9)
+    exe = fluid.Executor()
+    with tempfile.TemporaryDirectory() as d:
+        with scope_guard(Scope(seed=2)):
+            exe.run(startup)
+            xv = np.random.RandomState(0).randn(5, 4).astype("float32")
+            (before,) = exe.run(main, feed={"x": xv}, fetch_list=[y.name])
+            fluid.io.save_inference_model(d, ["x"], [y], exe, main)
+        with scope_guard(Scope(seed=3)):
+            prog, feed_names, fetch_vars = fluid.io.load_inference_model(d, exe)
+            assert feed_names == ["x"]
+            (after,) = exe.run(
+                prog, feed={"x": xv}, fetch_list=[fetch_vars[0].name]
+            )
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+        # pruning dropped the unrelated head's params from disk
+        files = set(os.listdir(d))
+        assert not any("fc_1" in f for f in files), files
+
+
+def test_reader_decorators():
+    def r():
+        return iter(range(10))
+
+    assert list(paddle.reader.firstn(r, 3)()) == [0, 1, 2]
+    assert sorted(paddle.reader.shuffle(r, 5)()) == list(range(10))
+    assert list(paddle.reader.map_readers(lambda a: a * 2, r)()) == [
+        2 * i for i in range(10)
+    ]
+    assert list(paddle.reader.buffered(r, 2)()) == list(range(10))
+    chained = paddle.reader.chain(r, r)
+    assert len(list(chained())) == 20
+    batches = list(paddle.batch(r, 4)())
+    assert batches[0] == [0, 1, 2, 3] and batches[-1] == [8, 9]
+
+
+def test_data_feeder_pads_lod_fields():
+    main = framework.Program()
+    with fluid.program_guard(main, framework.Program()):
+        words = fluid.layers.data(name="words", shape=[1], dtype="int64", lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    feeder = fluid.DataFeeder([words, label], program=main)
+    feed = feeder.feed([([1, 2, 3], 0), ([4, 5], 1)])
+    assert feed["words"].shape == (2, 3, 1)
+    np.testing.assert_array_equal(feed["words@LEN"], [3, 2])
+    assert feed["label"].shape == (2, 1)
+
+
+def test_py_reader_trains_and_raises_eof():
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=4, shapes=[(-1, 4), (-1, 1)], dtypes=["float32", "int64"]
+        )
+        x, label = fluid.layers.read_file(reader)
+        logits = fluid.layers.fc(x, size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label)
+        )
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def gen():
+        for _ in range(6):
+            xs = rng.randn(8, 4).astype("float32")
+            ys = (xs.sum(1) > 0).astype("int64").reshape(8, 1)
+            yield {"x": xs, "label": ys}
+
+    # decorate with dict provider using real var names
+    def provider():
+        for batch in gen():
+            yield {x.name: batch["x"], label.name: batch["label"]}
+
+    reader.decorate_tensor_provider(provider)
+    exe = fluid.Executor()
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        reader.start()
+        seen = 0
+        try:
+            while True:
+                exe.run(main, fetch_list=[loss.name])
+                seen += 1
+        except fluid.EOFException:
+            pass
+        assert seen == 6
+        # second epoch works after restart
+        reader.start()
+        (l,) = exe.run(main, fetch_list=[loss.name])
+        assert np.isfinite(l).all()
+        reader.reset()
+
+
+def test_standalone_pyreader_batched_tuples():
+    from paddle_tpu.py_reader import PyReader
+
+    r = PyReader(["img", "label"], return_device_arrays=False)
+    data = [
+        [(np.ones(4, "float32") * i, i) for i in range(3)]
+        for _ in range(2)
+    ]
+    r.decorate_paddle_reader(lambda: iter(data))
+    r.start()
+    b = r.next_batch()
+    assert b["img"].shape == (3, 4)
+    np.testing.assert_array_equal(b["label"], [0, 1, 2])
+    r.reset()
+    assert r._thread is None
+
+
+def test_pyreader_reset_mid_epoch_stops_thread():
+    from paddle_tpu.py_reader import PyReader
+
+    produced = []
+
+    def src():
+        for i in range(1000):
+            produced.append(i)
+            yield {"x": np.asarray([i])}
+
+    r = PyReader(["x"], capacity=2, return_device_arrays=False)
+    r.decorate_tensor_provider(src)
+    r.start()
+    r.next_batch()
+    thread = r._thread
+    r.reset()
+    assert not thread.is_alive()
+    assert len(produced) < 1000  # source was not drained
+
+
+def test_xmap_readers_order_preserved():
+    def src():
+        return iter(range(50))
+
+    mapped = paddle.reader.xmap_readers(
+        lambda x: x * 2, src, process_num=4, buffer_size=8, order=True
+    )
+    assert list(mapped()) == [2 * i for i in range(50)]
+
+
+def test_pe_pulls_from_py_reader():
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=4, shapes=[(-1, 4), (-1, 1)], dtypes=["float32", "int64"]
+        )
+        x, label = fluid.layers.read_file(reader)
+        logits = fluid.layers.fc(x, size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label)
+        )
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    def provider():
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            yield {
+                x.name: rng.randn(16, 4).astype("float32"),
+                label.name: rng.randint(0, 2, (16, 1)).astype("int64"),
+            }
+
+    reader.decorate_tensor_provider(provider)
+    exe = fluid.Executor()
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(loss_name=loss.name, main_program=main)
+        reader.start()
+        n = 0
+        try:
+            while True:
+                pe.run(fetch_list=[loss.name])
+                n += 1
+        except fluid.EOFException:
+            pass
+        assert n == 3
+
+
+def test_dataset_shims():
+    sample = next(paddle.dataset.mnist.train()())
+    assert sample[0].shape == (784,) and 0 <= sample[1] < 10
+    x, y = next(paddle.dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    seq, lbl = next(paddle.dataset.imdb.train()())
+    assert isinstance(seq, list) and lbl in (0, 1)
