@@ -17,6 +17,8 @@ from . import (
     initializer,
     io,
     layers,
+    metrics,
+    nets,
     optimizer,
     param_attr,
     reader,

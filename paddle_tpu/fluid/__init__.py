@@ -9,6 +9,8 @@ from .. import (  # noqa: F401
     initializer,
     io,
     layers,
+    metrics,
+    nets,
     optimizer,
     param_attr,
     regularizer,

@@ -4,11 +4,12 @@ All public layer functions are re-exported flat, so user code written as
 `fluid.layers.fc(...)` works unchanged against `paddle_tpu.layers`.
 """
 
-from . import io, metric_op, nn, ops, tensor
+from . import io, metric_op, nn, ops, sequence, tensor
 from .io import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from . import learning_rate_scheduler
@@ -18,6 +19,7 @@ __all__ = (
     + metric_op.__all__
     + nn.__all__
     + ops.__all__
+    + sequence.__all__
     + tensor.__all__
     + learning_rate_scheduler.__all__
 )

@@ -21,9 +21,15 @@ def data(
     executor's shape-keyed compile cache."""
     helper_block = framework.default_main_program().current_block()
     shape = list(shape)
-    if append_batch_size:
+    if lod_level and lod_level > 0:
+        # padded ragged field: (batch, time, *shape) — reference LoD tensors
+        # are packed (T_total, *shape); the padded form adds the batch dim.
+        # With append_batch_size=False the user's shape already leads with the
+        # batch dim, so the time dim is inserted after it.
+        shape = [-1, -1] + shape if append_batch_size else shape[:1] + [-1] + shape[1:]
+    elif append_batch_size:
         shape = [-1] + shape
-    return helper_block.create_var(
+    v = helper_block.create_var(
         name=name,
         shape=shape,
         dtype=dtype,
@@ -32,6 +38,19 @@ def data(
         lod_level=lod_level,
         is_data=True,
     )
+    if lod_level and lod_level > 0:
+        # ragged field: companion per-sample length vector fed alongside
+        # (the TPU-native LoD representation — SURVEY.md §5.7); DataFeeder
+        # produces `<name>@LEN` automatically.
+        lv = helper_block.create_var(
+            name=name + "@LEN",
+            shape=[-1],
+            dtype="int32",
+            stop_gradient=True,
+            is_data=True,
+        )
+        v._len_name = lv.name
+    return v
 
 
 class GraphPyReader:

@@ -90,8 +90,14 @@ def fc(
     mul_results = []
     for input_var, param_attr in helper.iter_inputs_and_params():
         input_shape = input_var.shape
+        nfd = num_flatten_dims
+        # ragged input: reference LoD tensors are (T_total, d) so fc's default
+        # num_flatten_dims=1 means "per timestep"; our padded (b, t, d) needs
+        # the feature dim alone flattened for the same semantics
+        if getattr(input_var, "_len_name", None) and num_flatten_dims == 1:
+            nfd = len(input_shape) - 1
         param_shape = [
-            int(np.prod(input_shape[num_flatten_dims:])),
+            int(np.prod(input_shape[nfd:])),
             size,
         ]
         w = helper.create_parameter(
@@ -102,8 +108,10 @@ def fc(
             type="mul",
             inputs={"X": [input_var.name], "Y": [w.name]},
             outputs={"Out": [tmp.name]},
-            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+            attrs={"x_num_col_dims": nfd, "y_num_col_dims": 1},
         )
+        if getattr(input_var, "_len_name", None):
+            tmp._len_name = input_var._len_name
         mul_results.append(tmp)
     if len(mul_results) == 1:
         pre_bias = mul_results[0]
@@ -114,8 +122,11 @@ def fc(
             inputs={"X": [v.name for v in mul_results]},
             outputs={"Out": [pre_bias.name]},
         )
-    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
-    return helper.append_activation(pre_act)
+    pre_act = helper.append_bias_op(pre_bias, dim_start=nfd)
+    out = helper.append_activation(pre_act)
+    from .sequence import _propagate
+
+    return _propagate(out, mul_results[0])
 
 
 def embedding(
@@ -153,6 +164,8 @@ def embedding(
             "padding_idx": padding_idx,
         },
     )
+    if getattr(input, "_len_name", None):
+        tmp._len_name = input._len_name
     return tmp
 
 

@@ -1,0 +1,262 @@
+"""Sequence layers over the padded+lengths representation (reference
+python/paddle/fluid/layers/nn.py: dynamic_lstm, dynamic_gru, sequence_pool,
+sequence_softmax, sequence_conv, sequence_first/last_step, gru_unit).
+
+A ragged variable carries `_len_name` pointing at its `<name>@LEN` companion
+(created by layers.data(lod_level=1) / propagated by sequence-aware layers)."""
+
+from ..framework import Variable
+from ..initializer import Constant
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "dynamic_lstm",
+    "dynamic_gru",
+    "gru_unit",
+    "sequence_pool",
+    "sequence_softmax",
+    "sequence_conv",
+    "sequence_first_step",
+    "sequence_last_step",
+    "sequence_reverse",
+    "sequence_expand",
+]
+
+
+def seq_len_of(var):
+    name = getattr(var, "_len_name", None)
+    if name is None:
+        raise ValueError(
+            "variable %r has no sequence-length companion; build ragged inputs "
+            "with layers.data(..., lod_level=1) or propagate through sequence "
+            "layers" % var.name
+        )
+    return name
+
+
+def _propagate(dst, src):
+    if getattr(src, "_len_name", None):
+        dst._len_name = src._len_name
+    return dst
+
+
+def dynamic_lstm(
+    input,
+    size,
+    h_0=None,
+    c_0=None,
+    param_attr=None,
+    bias_attr=None,
+    use_peepholes=True,
+    is_reverse=False,
+    gate_activation="sigmoid",
+    cell_activation="tanh",
+    candidate_activation="tanh",
+    dtype="float32",
+    name=None,
+):
+    """reference layers/nn.py dynamic_lstm → lstm op. `input` is the fc
+    projection (b, t, 4*hidden); returns (hidden, cell) sequences."""
+    if h_0 is not None or c_0 is not None:
+        raise NotImplementedError(
+            "dynamic_lstm h_0/c_0 initial state lands with the seq2seq tier; "
+            "zeros are used today"
+        )
+    helper = LayerHelper("lstm", **locals())
+    hidden_size = size // 4
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[hidden_size, 4 * hidden_size], dtype=dtype
+    )
+    bias_size = [1, 7 * hidden_size] if use_peepholes else [1, 4 * hidden_size]
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=bias_size, dtype=dtype, is_bias=True
+    )
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="dynamic_lstm",
+        inputs={
+            "Input": [input.name],
+            "Weight": [weight.name],
+            "Bias": [bias.name],
+            "SeqLen": [seq_len_of(input)],
+        },
+        outputs={"Hidden": [hidden.name], "Cell": [cell.name]},
+        attrs={
+            "use_peepholes": use_peepholes,
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    _propagate(hidden, input)
+    _propagate(cell, input)
+    return hidden, cell
+
+
+def dynamic_gru(
+    input,
+    size,
+    param_attr=None,
+    bias_attr=None,
+    is_reverse=False,
+    gate_activation="sigmoid",
+    candidate_activation="tanh",
+    h_0=None,
+    name=None,
+):
+    if h_0 is not None:
+        raise NotImplementedError(
+            "dynamic_gru h_0 initial state lands with the seq2seq tier; "
+            "zeros are used today"
+        )
+    helper = LayerHelper("gru", **locals())
+    dtype = input.dtype
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, 3 * size], dtype=dtype
+    )
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[1, 3 * size], dtype=dtype, is_bias=True
+    )
+    hidden = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="dynamic_gru",
+        inputs={
+            "Input": [input.name],
+            "Weight": [weight.name],
+            "Bias": [bias.name],
+            "SeqLen": [seq_len_of(input)],
+        },
+        outputs={"Hidden": [hidden.name]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "activation": candidate_activation,
+        },
+    )
+    return _propagate(hidden, input)
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None, activation="tanh", gate_activation="sigmoid"):
+    helper = LayerHelper("gru_unit", **locals())
+    dtype = input.dtype
+    hidden_size = size // 3
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[hidden_size, 3 * hidden_size], dtype=dtype
+    )
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[1, 3 * hidden_size], dtype=dtype, is_bias=True
+    )
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_hidden = helper.create_variable_for_type_inference(dtype)
+    updated = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="gru_unit",
+        inputs={
+            "Input": [input.name],
+            "HiddenPrev": [hidden.name],
+            "Weight": [weight.name],
+            "Bias": [bias.name],
+        },
+        outputs={
+            "Gate": [gate.name],
+            "ResetHiddenPrev": [reset_hidden.name],
+            "Hidden": [updated.name],
+        },
+        attrs={"activation": activation, "gate_activation": gate_activation},
+    )
+    return updated, reset_hidden, gate
+
+
+def sequence_pool(input, pool_type):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_pool",
+        inputs={"X": [input.name], "SeqLen": [seq_len_of(input)]},
+        outputs={"Out": [out.name]},
+        attrs={"pooltype": pool_type.upper()},
+    )
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_softmax",
+        inputs={"X": [input.name], "SeqLen": [seq_len_of(input)]},
+        outputs={"Out": [out.name]},
+    )
+    return _propagate(out, input)
+
+
+def sequence_conv(
+    input,
+    num_filters,
+    filter_size=3,
+    filter_stride=1,
+    padding=None,
+    bias_attr=None,
+    param_attr=None,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("sequence_conv", **locals())
+    dtype = input.dtype
+    d_in = input.shape[-1]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[filter_size * d_in, num_filters], dtype=dtype
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="sequence_conv",
+        inputs={
+            "X": [input.name],
+            "Filter": [w.name],
+            "SeqLen": [seq_len_of(input)],
+        },
+        outputs={"Out": [out.name]},
+        attrs={
+            "contextLength": filter_size,
+            "contextStart": -((filter_size - 1) // 2),
+            "contextStride": filter_stride,
+        },
+    )
+    _propagate(out, input)
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    _propagate(pre_act, input)
+    result = helper.append_activation(pre_act)
+    return _propagate(result, input)
+
+
+def sequence_reverse(x, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_reverse",
+        inputs={"X": [x.name], "SeqLen": [seq_len_of(x)]},
+        outputs={"Y": [out.name]},
+    )
+    return _propagate(out, x)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_expand",
+        inputs={"X": [x.name], "Y": [y.name]},
+        outputs={"Out": [out.name]},
+        attrs={"ref_level": ref_level},
+    )
+    return _propagate(out, y)

@@ -1,0 +1,56 @@
+"""DeepFM CTR model (BASELINE config 4; the reference era's CTR tier —
+dist_ctr.py / deep-and-wide models built on sparse lookup_table + logloss +
+AUC). FM second-order term uses the sum-square identity
+0.5 * ((Σv)² − Σv²) so everything is one dense XLA computation; embedding
+gradients are fused scatter-adds (SelectedRows' TPU-native equivalent —
+SURVEY.md §7.7), and sharded tables come from the parallel embedding path."""
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def deepfm(
+    feat_ids,
+    label,
+    num_features=10000,
+    num_fields=10,
+    embedding_size=8,
+    layer_sizes=(64, 32),
+):
+    """feat_ids: (b, num_fields, 1) int ids into a shared feature space."""
+    # first-order term: per-feature scalar weights
+    first_emb = layers.embedding(
+        feat_ids,
+        size=[num_features, 1],
+        param_attr=ParamAttr(name="fm_first"),
+    )  # (b, f, 1)
+    y_first = layers.reduce_sum(layers.reshape(first_emb, [0, num_fields]), dim=[1], keep_dim=True)
+
+    # second-order term via sum-square trick
+    emb = layers.embedding(
+        feat_ids,
+        size=[num_features, embedding_size],
+        param_attr=ParamAttr(name="fm_emb"),
+    )  # (b, f, k)
+    summed = layers.reduce_sum(emb, dim=[1])  # (b, k)
+    sum_sq = layers.square(summed)
+    sq_sum = layers.reduce_sum(layers.square(emb), dim=[1])
+    y_second = layers.scale(
+        layers.reduce_sum(layers.elementwise_sub(sum_sq, sq_sum), dim=[1], keep_dim=True),
+        scale=0.5,
+    )
+
+    # deep tower
+    deep = layers.reshape(emb, [0, num_fields * embedding_size])
+    for width in layer_sizes:
+        deep = layers.fc(deep, size=width, act="relu")
+    y_deep = layers.fc(deep, size=1)
+
+    logit = layers.elementwise_add(
+        layers.elementwise_add(y_first, y_second), y_deep
+    )
+    pred = layers.sigmoid(logit)
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logit, label)
+    )
+    return loss, pred, logit

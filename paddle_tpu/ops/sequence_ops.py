@@ -1,0 +1,283 @@
+"""Sequence (ragged) ops and recurrent blocks.
+
+Reference analog: paddle/fluid/operators/sequence_ops/ (46 files operating on
+LoD tensors — packed ragged rows) and lstm_op.cc / gru_op.cc with
+math/sequence2batch.h reordering. TPU-first redesign (SURVEY.md §5.7): ragged
+batches are PADDED DENSE tensors (batch, time, ...) with an explicit `SeqLen`
+(batch,) int32 companion — static shapes for XLA — and every op masks padding
+explicitly. Recurrence is jax.lax.scan over the time axis (compiled XLA While)
+instead of the reference's sequence2batch + per-step kernel launches; grads
+come from the registry's generic vjp, which differentiates through scan.
+
+Gate layouts match the reference kernels so checkpoints interchange:
+dynamic_lstm gates are (c, i, f, o) [candidate, input, forget, output] —
+operators/math/detail/lstm_cpu_kernel.h lays out value_in (candidate, tanh)
+first, then value_ig/value_fg/value_og; dynamic_gru gates are (u, r, c) with
+h = (1-u)*h_prev + u*c (gru_kernel.h gru_finalOutput).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register
+
+
+def _valid_mask(x, seqlen):
+    """(b, t) boolean validity mask broadcastable against (b, t, ...)."""
+    t = x.shape[1]
+    return (jnp.arange(t)[None, :] < seqlen.reshape(-1, 1)).astype(x.dtype)
+
+
+def _masked(x, seqlen):
+    m = _valid_mask(x, seqlen)
+    return x * m.reshape(m.shape + (1,) * (x.ndim - 2))
+
+
+@register("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (seqlen,) = ins["SeqLen"]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    m = _valid_mask(x, lens)
+    mexp = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    if ptype == "SUM":
+        out = jnp.sum(x * mexp, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * mexp, axis=1) / jnp.maximum(lens, 1).reshape(-1, 1).astype(
+            x.dtype
+        )
+    elif ptype == "SQRT":
+        out = jnp.sum(x * mexp, axis=1) / jnp.sqrt(
+            jnp.maximum(lens, 1).astype(x.dtype)
+        ).reshape(-1, 1)
+    elif ptype == "MAX":
+        neg = jnp.asarray(jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else -(2**30), x.dtype)
+        out = jnp.max(jnp.where(mexp > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape(-1, 1, *([1] * (x.ndim - 2))), axis=1
+        ).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    return {"Out": [out]}
+
+
+@register("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (seqlen,) = ins["SeqLen"]
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    v = x.reshape(x.shape[:2]) if squeeze else x
+    m = _valid_mask(v, lens)
+    neg = jnp.asarray(-1e9, v.dtype)
+    logits = jnp.where(m > 0, v, neg)
+    sm = jax.nn.softmax(logits, axis=1) * m
+    sm = sm / jnp.maximum(jnp.sum(sm, axis=1, keepdims=True), 1e-9)
+    out = sm.reshape(x.shape) if squeeze else sm
+    return {"Out": [out]}
+
+
+@register("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window projection over time (reference
+    sequence_ops/sequence_conv_op.cc + math/context_project.h): for each
+    position, concat context_length timesteps starting at context_start and
+    project with Filter (ctx_len*d_in, d_out). Zero padding outside sequence
+    bounds, matching the reference's trainable-padding-disabled mode."""
+    (x,) = ins["X"]
+    (w,) = ins["Filter"]
+    (seqlen,) = ins["SeqLen"]
+    ctx_len = int(attrs.get("contextLength", attrs.get("context_length", 3)))
+    ctx_start = int(attrs.get("contextStart", attrs.get("context_start", -((ctx_len - 1) // 2))))
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    xm = _masked(x, lens)
+    b, t, d = xm.shape
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(xm, -off, axis=1)
+        idx = jnp.arange(t) + off
+        ok = ((idx >= 0) & (idx < t)).astype(x.dtype).reshape(1, t, 1)
+        cols.append(shifted * ok)
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # (b, t, ctx_len*d)
+    out = jnp.einsum("btd,do->bto", ctx_mat, w)
+    out = _masked(out, lens)
+    return {"Out": [out]}
+
+
+@register("sequence_reverse")
+def _sequence_reverse(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (seqlen,) = ins["SeqLen"]
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    t = x.shape[1]
+    # position i maps to len-1-i within the valid prefix; padding stays put
+    pos = jnp.arange(t)[None, :]
+    src = jnp.where(pos < lens[:, None], lens[:, None] - 1 - pos, pos)
+    out = jnp.take_along_axis(x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    return {"Y": [out]}
+
+
+@register("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    """Padded-dense analog of sequence_expand (reference
+    sequence_ops/sequence_expand_op.cc): tile each row of X along a new/existing
+    time axis to Y's time length."""
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    if x.ndim == y.ndim - 1:
+        out = jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1]) + x.shape[1:])
+    else:
+        out = jnp.broadcast_to(x, y.shape[:2] + x.shape[2:])
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# recurrent blocks
+# ---------------------------------------------------------------------------
+
+
+def _scan_time(step, carry, xs, reverse=False):
+    carry, ys = lax.scan(step, carry, xs, reverse=reverse)
+    return carry, ys
+
+
+@register("dynamic_lstm")
+def _dynamic_lstm(ctx, ins, attrs):
+    """LSTM over padded (b,t,4h) gate pre-activations (reference lstm_op.cc;
+    input already projected by an fc, as in fluid's dynamic_lstm API).
+    Peepholes supported (use_peepholes attr, bias then holds 7h)."""
+    (x,) = ins["Input"]
+    (w,) = ins["Weight"]  # (h, 4h) recurrent weights
+    (seqlen,) = ins["SeqLen"]
+    bias = ins["Bias"][0] if "Bias" in ins else None
+    use_peepholes = bool(attrs.get("use_peepholes", True))
+    is_reverse = bool(attrs.get("is_reverse", False))
+    b, t, h4 = x.shape
+    h = h4 // 4
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+
+    gate_bias = None
+    w_ic = w_fc = w_oc = None
+    if bias is not None:
+        flat = bias.reshape(-1)
+        gate_bias = flat[: 4 * h]
+        if use_peepholes and flat.shape[0] >= 7 * h:
+            w_ic = flat[4 * h : 5 * h]
+            w_fc = flat[5 * h : 6 * h]
+            w_oc = flat[6 * h : 7 * h]
+
+    xs = jnp.moveaxis(x, 1, 0)  # (t, b, 4h)
+    tidx = jnp.arange(t)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, ti = inp
+        gates = xt + h_prev @ w
+        if gate_bias is not None:
+            gates = gates + gate_bias
+        # reference layout: candidate, input gate, forget gate, output gate
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
+        if w_ic is not None:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i = jax.nn.sigmoid(gi)
+        f = jax.nn.sigmoid(gf)
+        cand = jnp.tanh(gc)
+        c_new = f * c_prev + i * cand
+        if w_oc is not None:
+            go = go + c_new * w_oc
+        o = jax.nn.sigmoid(go)
+        h_new = o * jnp.tanh(c_new)
+        mask = (ti < lens).astype(x.dtype).reshape(-1, 1)
+        h_out = mask * h_new + (1 - mask) * h_prev
+        c_out = mask * c_new + (1 - mask) * c_prev
+        return (h_out, c_out), (h_out, c_out)
+
+    # with reverse=True the scan hits padding (t >= len) first; it is masked
+    init = (jnp.zeros((b, h), x.dtype), jnp.zeros((b, h), x.dtype))
+    _, (hs, cs) = _scan_time(step, init, (xs, tidx), reverse=is_reverse)
+    hidden = jnp.moveaxis(hs, 0, 1)
+    cell = jnp.moveaxis(cs, 0, 1)
+    hidden = _masked(hidden, lens)
+    cell = _masked(cell, lens)
+    return {"Hidden": [hidden], "Cell": [cell]}
+
+
+@register("dynamic_gru")
+def _dynamic_gru(ctx, ins, attrs):
+    """GRU over padded (b,t,3h) pre-activations (reference gru_op.cc). Weight
+    is (h, 3h): [:, :2h] update/reset recurrent weights, [:, 2h:] candidate."""
+    (x,) = ins["Input"]
+    (w,) = ins["Weight"]
+    (seqlen,) = ins["SeqLen"]
+    bias = ins["Bias"][0] if "Bias" in ins else None
+    is_reverse = bool(attrs.get("is_reverse", False))
+    b, t, h3 = x.shape
+    h = h3 // 3
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    w_ur = w[:, : 2 * h]
+    w_c = w[:, 2 * h :]
+
+    xs = jnp.moveaxis(x, 1, 0)
+    tidx = jnp.arange(t)
+
+    def step(h_prev, inp):
+        xt, ti = inp
+        if bias is not None:
+            xt = xt + bias.reshape(-1)
+        g_ur = xt[:, : 2 * h] + h_prev @ w_ur
+        u = jax.nn.sigmoid(g_ur[:, :h])
+        r = jax.nn.sigmoid(g_ur[:, h:])
+        c = jnp.tanh(xt[:, 2 * h :] + (r * h_prev) @ w_c)
+        # reference gru_finalOutput: h = (1-u)*h_prev + u*c
+        h_new = (1 - u) * h_prev + u * c
+        mask = (ti < lens).astype(x.dtype).reshape(-1, 1)
+        h_out = mask * h_new + (1 - mask) * h_prev
+        return h_out, h_out
+
+    init = jnp.zeros((b, h), x.dtype)
+    _, hs = _scan_time(step, init, (xs, tidx), reverse=is_reverse)
+    hidden = _masked(jnp.moveaxis(hs, 0, 1), lens)
+    return {"Hidden": [hidden]}
+
+
+@register("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """Single LSTM step (reference lstm_unit_op.cc): X (b,4h) pre-activations,
+    C_prev (b,h) → C, H."""
+    (x,) = ins["X"]
+    (c_prev,) = ins["C_prev"]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    gi, gc, gf, go = jnp.split(x, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c = f * c_prev + i * jnp.tanh(gc)
+    hidden = jax.nn.sigmoid(go) * jnp.tanh(c)
+    return {"C": [c], "H": [hidden]}
+
+
+@register("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """Single GRU step (reference gru_unit_op.cc)."""
+    (x,) = ins["Input"]
+    (h_prev,) = ins["HiddenPrev"]
+    (w,) = ins["Weight"]
+    bias = ins["Bias"][0] if "Bias" in ins else None
+    h = h_prev.shape[-1]
+    if bias is not None:
+        x = x + bias.reshape(-1)
+    g_ur = x[:, : 2 * h] + h_prev @ w[:, : 2 * h]
+    u = jax.nn.sigmoid(g_ur[:, :h])
+    r = jax.nn.sigmoid(g_ur[:, h:])
+    c = jnp.tanh(x[:, 2 * h :] + (r * h_prev) @ w[:, 2 * h :])
+    # reference gru_unit_op.h:116: h = u*(c - h_prev) + h_prev
+    h_new = (1 - u) * h_prev + u * c
+    return {"Hidden": [h_new], "ResetHiddenPrev": [r * h_prev], "Gate": [jnp.concatenate([u, r, c], -1)]}

@@ -1,0 +1,129 @@
+"""Sequence-op and recurrent-model tests (reference
+unittests/test_sequence_pool.py, test_lstm_op.py, book
+understand_sentiment_lstm pattern)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _run_seq_op(op_type, x, lens, attrs=None, extra=None, out_slot="Out", x_slot="X"):
+    main = framework.Program()
+    with fluid.program_guard(main, framework.Program()):
+        blk = main.global_block()
+        blk.create_var(name="x", shape=x.shape, dtype="float32")
+        blk.create_var(name="len", shape=lens.shape, dtype="int32")
+        inputs = {x_slot: ["x"], "SeqLen": ["len"]}
+        feed = {"x": x, "len": lens}
+        for slot, (nm, arr) in (extra or {}).items():
+            blk.create_var(name=nm, shape=arr.shape, dtype="float32")
+            inputs[slot] = [nm]
+            feed[nm] = arr
+        blk.create_var(name="out")
+        blk.append_op(
+            type=op_type, inputs=inputs, outputs={out_slot: ["out"]}, attrs=attrs or {}
+        )
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        (out,) = exe.run(main, feed=feed, fetch_list=["out"])
+    return out
+
+
+def test_sequence_pool_types():
+    x = np.arange(24, dtype="float32").reshape(2, 4, 3)
+    lens = np.asarray([2, 3], "int32")
+    s = _run_seq_op("sequence_pool", x, lens, {"pooltype": "SUM"})
+    np.testing.assert_allclose(s[0], x[0, :2].sum(0))
+    np.testing.assert_allclose(s[1], x[1, :3].sum(0))
+    a = _run_seq_op("sequence_pool", x, lens, {"pooltype": "AVERAGE"})
+    np.testing.assert_allclose(a[1], x[1, :3].mean(0))
+    m = _run_seq_op("sequence_pool", x, lens, {"pooltype": "MAX"})
+    np.testing.assert_allclose(m[0], x[0, :2].max(0))
+    last = _run_seq_op("sequence_pool", x, lens, {"pooltype": "LAST"})
+    np.testing.assert_allclose(last[0], x[0, 1])
+    np.testing.assert_allclose(last[1], x[1, 2])
+    first = _run_seq_op("sequence_pool", x, lens, {"pooltype": "FIRST"})
+    np.testing.assert_allclose(first[0], x[0, 0])
+
+
+def test_sequence_softmax_masks_padding():
+    x = np.random.RandomState(0).randn(2, 5).astype("float32")
+    lens = np.asarray([3, 5], "int32")
+    out = _run_seq_op("sequence_softmax", x, lens)
+    np.testing.assert_allclose(out[0, 3:], 0.0, atol=1e-7)
+    np.testing.assert_allclose(out[0, :3].sum(), 1.0, rtol=1e-5)
+    e = np.exp(x[0, :3] - x[0, :3].max())
+    np.testing.assert_allclose(out[0, :3], e / e.sum(), rtol=1e-5)
+
+
+def test_sequence_reverse():
+    x = np.arange(12, dtype="float32").reshape(2, 3, 2)
+    lens = np.asarray([2, 3], "int32")
+    out = _run_seq_op("sequence_reverse", x, lens, out_slot="Y")
+    np.testing.assert_allclose(out[0, 0], x[0, 1])
+    np.testing.assert_allclose(out[0, 1], x[0, 0])
+    np.testing.assert_allclose(out[0, 2], x[0, 2])  # padding untouched
+    np.testing.assert_allclose(out[1, 0], x[1, 2])
+
+
+def test_dynamic_lstm_masks_and_shapes():
+    rng = np.random.RandomState(1)
+    b, t, h = 3, 5, 4
+    x = rng.randn(b, t, 4 * h).astype("float32")
+    w = rng.randn(h, 4 * h).astype("float32") * 0.1
+    lens = np.asarray([2, 5, 3], "int32")
+    out = _run_seq_op(
+        "dynamic_lstm",
+        x,
+        lens,
+        {"use_peepholes": False},
+        extra={"Weight": ("w", w)},
+        out_slot="Hidden",
+        x_slot="Input",
+    )
+    assert out.shape == (b, t, h)
+    # outputs beyond each length are zeroed
+    np.testing.assert_allclose(out[0, 2:], 0.0, atol=1e-7)
+    np.testing.assert_allclose(out[2, 3:], 0.0, atol=1e-7)
+    assert np.abs(out[1]).sum() > 0
+
+
+def test_stacked_lstm_text_classification_converges():
+    from paddle_tpu.models.stacked_lstm import stacked_lstm_net
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="words", shape=[1], dtype="int64", lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        loss, acc, _ = stacked_lstm_net(
+            words, label, dict_dim=200, emb_dim=16, hid_dim=16, stacked_num=2
+        )
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def make_batch(n=16, maxlen=12):
+        lens = rng.randint(4, maxlen + 1, n).astype("int32")
+        lbl = rng.randint(0, 2, (n, 1)).astype("int64")
+        words = np.zeros((n, maxlen, 1), "int64")
+        for i in range(n):
+            lo, hi = (0, 100) if lbl[i, 0] == 1 else (100, 200)
+            words[i, : lens[i], 0] = rng.randint(lo, hi, lens[i])
+        return words, lens, lbl
+
+    exe = fluid.Executor()
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        losses = []
+        for step in range(30):
+            w, l, y = make_batch()
+            (lv,) = exe.run(
+                main,
+                feed={"words": w, "words@LEN": l, "label": y},
+                fetch_list=[loss.name],
+            )
+            losses.append(float(lv[0]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8, losses
