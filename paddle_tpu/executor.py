@@ -163,7 +163,7 @@ class _CompiledBlock:
             env.update(ro_state)
             env.update(mut_state)
             env.update(feeds)
-            ctx = registry.LowerCtx(rng_key)
+            ctx = registry.LowerCtx(rng_key, mesh=mesh)
             for op in ops_:
                 opdef = registry.get(op.type)
                 ins = {}
@@ -198,19 +198,41 @@ class _CompiledBlock:
             batch = NamedSharding(mesh, P(data_axes))
             repl = NamedSharding(mesh, P())
             self._feed_sharding = batch
+
+            def state_sharding(name):
+                """Parameters annotated via parallel.shard_parameter carry a
+                PartitionSpec tuple (tensor parallelism); default replicated.
+                Axes the current mesh doesn't have degrade to replication so
+                the same program runs on any mesh (e.g. distributed_embedding
+                under a dp-only ParallelExecutor)."""
+                try:
+                    v = block._var_recursive(name)
+                except KeyError:
+                    return repl
+                spec = getattr(v, "sharding_spec", None)
+                if spec is None:
+                    return repl
+                def keep(axis):
+                    if axis is None:
+                        return None
+                    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+                    kept = tuple(a for a in axes if a in mesh.shape)
+                    return kept if kept else None
+                return NamedSharding(mesh, P(*(keep(a) for a in spec)))
+
             # rank-0 feeds (scalars) cannot be batch-sharded — replicate them
             feed_ranks = feed_ranks or {}
             feed_sh = {
                 n: (batch if feed_ranks.get(n, 1) else repl)
                 for n in self.feed_names
             }
-            ro_sh = {n: repl for n in self.ro_names}
-            mut_sh = {n: repl for n in self.mut_names}
+            ro_sh = {n: state_sharding(n) for n in self.ro_names}
+            mut_sh = {n: state_sharding(n) for n in self.mut_names}
             # created dict's membership is only known at trace time (ops may
             # omit declared outputs), so its sharding is left to XLA (None)
             out_sh = (
                 [repl] * len(self.fetch_names),
-                {n: repl for n in self.mut_names},
+                {n: state_sharding(n) for n in self.mut_names},
                 None,
                 repl,
             )

@@ -70,6 +70,8 @@ __all__ = [
     "resize_bilinear",
     "resize_nearest",
     "autoincreased_step_counter",
+    "ring_attention",
+    "distributed_embedding",
 ]
 
 
@@ -87,6 +89,18 @@ def fc(
     + sum + bias + activation, composed from `mul`)."""
     helper = LayerHelper("fc", **locals())
     dtype = helper.input_dtype()
+    all_inputs = helper.multiple_input()
+    if num_flatten_dims == 1 and len(all_inputs) > 1:
+        # mixed ragged/dense inputs would produce rank-mismatched mul results
+        out_ranks = {
+            (len(v.shape) if getattr(v, "_len_name", None) else 2)
+            for v in all_inputs
+        }
+        if len(out_ranks) > 1:
+            raise ValueError(
+                "fc with mixed ragged and non-ragged inputs is ambiguous; "
+                "pass an explicit num_flatten_dims"
+            )
     mul_results = []
     for input_var, param_attr in helper.iter_inputs_and_params():
         input_shape = input_var.shape
@@ -1038,6 +1052,44 @@ def resize_bilinear(input, out_shape=None, scale=None, name=None, actual_shape=N
 
 def resize_nearest(input, out_shape=None, scale=None, name=None, actual_shape=None, align_corners=True):
     return image_resize(input, out_shape, scale, name, "NEAREST", actual_shape, align_corners)
+
+
+def ring_attention(q, k, v, causal=False, axis_name="sp", name=None):
+    """Exact attention with sequence sharded over the mesh's `axis_name`
+    (context parallelism — new TPU-native capability; see
+    parallel/ring_attention.py). q/k/v: (b, heads, t, d)."""
+    helper = LayerHelper("ring_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(
+        type="ring_attention",
+        inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
+        outputs={"Out": [out.name]},
+        attrs={"causal": causal, "axis_name": axis_name},
+    )
+    return out
+
+
+def distributed_embedding(
+    input, size, param_attr=None, dtype="float32", axis_name="ep", name=None
+):
+    """Row-sharded embedding (the reference's distributed lookup table,
+    SURVEY.md §2.7.5, re-done as mesh-sharded rows + psum). The table param is
+    annotated to shard over `axis_name`."""
+    from ..parallel import shard_parameter
+
+    helper = LayerHelper("distributed_embedding", name=name)
+    w = helper.create_parameter(
+        attr=param_attr, shape=size, dtype=dtype, is_bias=False
+    )
+    shard_parameter(w, (axis_name, None))
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="distributed_lookup_table",
+        inputs={"W": [w.name], "Ids": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={"axis_name": axis_name},
+    )
+    return out
 
 
 def autoincreased_step_counter(counter_name=None, begin=1, step=1):

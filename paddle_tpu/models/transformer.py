@@ -181,15 +181,20 @@ def transformer(
     return loss, logits
 
 
-def make_attn_bias(lens, maxlen, n_head, causal=False, q_lens=None):
+def make_attn_bias(lens, maxlen, n_head, causal=False, q_maxlen=None):
     """Host-side bias construction, as the reference feeds biases from its
-    data pipeline (dist_transformer.py prepare_batch_input)."""
+    data pipeline (dist_transformer.py prepare_batch_input). `lens`/`maxlen`
+    describe the KEY side; `q_maxlen` the query side for cross-attention
+    (defaults to maxlen for self-attention). Returns (b, n_head, q, k)."""
     b = len(lens)
+    q_maxlen = q_maxlen if q_maxlen is not None else maxlen
     mask = np.zeros((b, 1, 1, maxlen), dtype="float32")
     for i, l in enumerate(lens):
         mask[i, 0, 0, l:] = -1e9
-    bias = np.tile(mask, (1, n_head, maxlen, 1))
+    bias = np.tile(mask, (1, n_head, q_maxlen, 1))
     if causal:
+        if q_maxlen != maxlen:
+            raise ValueError("causal bias requires q_maxlen == maxlen")
         tri = np.triu(np.full((maxlen, maxlen), -1e9, dtype="float32"), k=1)
         bias = bias + tri[None, None, :, :]
     return bias

@@ -1,0 +1,46 @@
+"""Mesh-aware graph ops: ring attention and sharded embedding lookup.
+
+These are new TPU-native capabilities (the reference has no sequence
+parallelism, SURVEY.md §5.7; its embedding parallelism was the pserver
+distributed lookup table, §2.7.5). Each op picks its distributed lowering when
+the executor compiles over a mesh whose relevant axis is >1, and falls back to
+the exact single-device computation otherwise — so the same program runs
+anywhere.
+"""
+
+import jax.numpy as jnp
+
+from ..parallel.ring_attention import ring_attention, ring_attention_sharded
+from ..parallel.sharded_embedding import sharded_embedding_lookup
+from .registry import register
+
+
+@register("ring_attention")
+def _ring_attention(ctx, ins, attrs):
+    (q,) = ins["Q"]
+    (k,) = ins["K"]
+    (v,) = ins["V"]
+    causal = bool(attrs.get("causal", False))
+    axis = attrs.get("axis_name", "sp")
+    mesh = ctx.mesh
+    if mesh is not None and mesh.shape.get(axis, 1) > 1:
+        out = ring_attention_sharded(q, k, v, mesh, axis_name=axis, causal=causal)
+    else:
+        out = ring_attention(q, k, v, causal=causal)
+    return {"Out": [out]}
+
+
+@register("distributed_lookup_table")
+def _distributed_lookup_table(ctx, ins, attrs):
+    (w,) = ins["W"]
+    (ids,) = ins["Ids"]
+    axis = attrs.get("axis_name", "ep")
+    flat = ids.reshape(ids.shape[:-1]) if ids.shape[-1] == 1 else ids
+    mesh = ctx.mesh
+    if mesh is not None and mesh.shape.get(axis, 1) > 1:
+        out = sharded_embedding_lookup(w, flat.astype(jnp.int32), mesh, axis_name=axis)
+    else:
+        out = jnp.take(w, flat.reshape(-1).astype(jnp.int32), axis=0).reshape(
+            flat.shape + (w.shape[1],)
+        )
+    return {"Out": [out]}

@@ -107,11 +107,14 @@ def is_registered(type):
 
 class LowerCtx:
     """Per-trace context handed to lowerings. Threads the PRNG key through the
-    block (stochastic ops call next_rng()) and carries build attrs."""
+    block (stochastic ops call next_rng()), carries build attrs, and exposes
+    the SPMD mesh (None single-device) so mesh-aware ops (ring attention,
+    sharded embedding) can pick their distributed lowering."""
 
-    def __init__(self, key, is_test=False):
+    def __init__(self, key, is_test=False, mesh=None):
         self.key = key
         self.is_test = is_test
+        self.mesh = mesh
 
     def next_rng(self):
         self.key, sub = jax.random.split(self.key)

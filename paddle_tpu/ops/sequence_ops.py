@@ -251,15 +251,15 @@ def _dynamic_gru(ctx, ins, attrs):
 
 @register("lstm_unit")
 def _lstm_unit(ctx, ins, attrs):
-    """Single LSTM step (reference lstm_unit_op.cc): X (b,4h) pre-activations,
-    C_prev (b,h) → C, H."""
+    """Single LSTM step (reference lstm_unit_op.h:63-66, gate layout
+    (i, f, o, g)): X (b,4h) pre-activations, C_prev (b,h) → C, H."""
     (x,) = ins["X"]
     (c_prev,) = ins["C_prev"]
     forget_bias = attrs.get("forget_bias", 0.0)
-    gi, gc, gf, go = jnp.split(x, 4, axis=-1)
+    gi, gf, go, gg = jnp.split(x, 4, axis=-1)
     i = jax.nn.sigmoid(gi)
     f = jax.nn.sigmoid(gf + forget_bias)
-    c = f * c_prev + i * jnp.tanh(gc)
+    c = f * c_prev + i * jnp.tanh(gg)
     hidden = jax.nn.sigmoid(go) * jnp.tanh(c)
     return {"C": [c], "H": [hidden]}
 

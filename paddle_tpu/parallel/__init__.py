@@ -1,0 +1,36 @@
+"""TPU-native parallelism package.
+
+The reference's parallelism machinery (SURVEY.md §2.2, §2.7) — ParallelExecutor
+SSA graphs + NCCL, gRPC parameter servers, distributed lookup tables — maps
+here onto jax.sharding over a device Mesh:
+
+- data parallel (dp): batch-sharded feeds, replicated params (parallel_executor.py)
+- tensor parallel (tp): parameter PartitionSpecs via shard_parameter
+- sequence/context parallel (sp): ring attention over ICI (ring_attention.py)
+- embedding parallel (ep): row-sharded tables with psum combine (sharded_embedding)
+- multi-host: jax.distributed over DCN (multihost.py), replacing the
+  reference's gen_nccl_id gRPC rendezvous (gen_nccl_id_op.cc:31-110)
+"""
+
+from .mesh import MeshConfig, make_mesh
+from .multihost import init_distributed
+from .ring_attention import ring_attention
+from . import collectives
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "init_distributed",
+    "ring_attention",
+    "collectives",
+    "shard_parameter",
+]
+
+
+def shard_parameter(param, spec):
+    """Annotate a Parameter with a PartitionSpec-like tuple (e.g. (None, 'tp'))
+    consumed by the SPMD executor instead of the default replication — the
+    TPU-native 'model parallelism' the reference only had for sparse tables
+    (distributed lookup table, SURVEY.md §2.7.5)."""
+    param.sharding_spec = tuple(spec)
+    return param

@@ -1,0 +1,59 @@
+"""Collective wrappers for shard_map code (reference: the NCCL op set —
+all_reduce_op_handle.cc, reduce_op_handle.cc, broadcast_op_handle.cc —
+and the legacy nccl ops). Inside shard_map these lower to XLA collectives
+over ICI/DCN."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "all_reduce",
+    "all_gather",
+    "reduce_scatter",
+    "ppermute_shift",
+    "broadcast",
+    "axis_index",
+    "axis_size",
+]
+
+
+def all_reduce(x, axis_name, op="sum"):
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    raise ValueError("unknown reduce op %r" % op)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, axis=0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ppermute_shift(x, axis_name, shift=1):
+    """Rotate shards around the ring: each rank sends to rank+shift."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def broadcast(x, axis_name, root=0):
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.axis_size(axis_name)

@@ -1,0 +1,47 @@
+"""Mesh construction (the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA insert collectives). Axis order puts dp outermost so data
+parallel rides DCN across hosts while tp/sp ride ICI within a slice."""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["MeshConfig", "make_mesh"]
+
+
+class MeshConfig:
+    """Named mesh-axis sizes. size=-1 on one axis means 'all remaining
+    devices'."""
+
+    def __init__(self, dp=-1, tp=1, sp=1, ep=1):
+        self.axes = {"dp": dp, "tp": tp, "sp": sp, "ep": ep}
+
+    def resolve(self, n_devices):
+        sizes = dict(self.axes)
+        wild = [k for k, v in sizes.items() if v == -1]
+        fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    "%d devices not divisible by fixed axes %s" % (n_devices, sizes)
+                )
+            sizes[wild[0]] = n_devices // fixed
+        total = int(np.prod(list(sizes.values())))
+        if total != n_devices:
+            raise ValueError(
+                "mesh %s needs %d devices, have %d" % (sizes, total, n_devices)
+            )
+        return sizes
+
+
+def make_mesh(config=None, devices=None):
+    devices = devices if devices is not None else jax.devices()
+    config = config or MeshConfig()
+    sizes = config.resolve(len(devices))
+    names = [k for k in ("dp", "tp", "sp", "ep")]
+    shape = [sizes[k] for k in names]
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(names))

@@ -1,0 +1,45 @@
+"""Multi-host initialization over DCN.
+
+Reference analog: the NCCL2 multi-node mode — gen_nccl_id_op.cc:31-110 has
+rank 0 serve an ncclUniqueId over a temporary gRPC server, after which
+NCCLContextMap forms a num_trainers x nGPU world (nccl_helper.h:104-120).
+On TPU the same rendezvous is jax.distributed.initialize against the
+coordination service; afterwards jax.devices() spans all hosts and the SPMD
+mesh simply includes them (dp axis over DCN)."""
+
+import os
+
+import jax
+
+__all__ = ["init_distributed"]
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address=None, num_processes=None, process_id=None
+):
+    """Call once per host before building meshes. Arguments default from the
+    fluid-style env vars the reference's transpiler mode used
+    (PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINER_ID, SURVEY.md §3.4) and fall
+    back to JAX's own cluster autodetection."""
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        if eps:
+            coordinator_address = eps.split(",")[0]
+            num_processes = num_processes or len(eps.split(","))
+    if process_id is None:
+        process_id = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    if coordinator_address is None:
+        # single host — nothing to rendezvous
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True

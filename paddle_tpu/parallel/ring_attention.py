@@ -1,0 +1,98 @@
+"""Ring attention: exact attention over sequences sharded across the 'sp'
+mesh axis (context parallelism over ICI).
+
+The reference (2018-era) has NO sequence parallelism — its long-sequence
+answer was LoD ragged batching (SURVEY.md §5.7); this is the new capability
+the TPU build adds. Algorithm (Liu et al. ring attention; public pattern):
+each rank holds a (b, h, t_local, d) shard of Q/K/V along the sequence; K/V
+chunks rotate around the ring via ppermute while each rank accumulates its
+queries' attention with an online (streaming) softmax — max/denominator
+corrections per incoming chunk — so the result is EXACT full attention
+without ever materializing the (t, t) score matrix on one chip, and the
+K/V transfer overlaps compute around the ring.
+
+Causal masking uses global positions derived from each chunk's rank of
+origin (after i rotations a rank holds the chunk of rank (me - i) mod n).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale):
+    """Runs inside shard_map: q,k,v are local (b, h, t_loc, d) shards."""
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, h, t_loc, d = q.shape
+
+    q_pos = me * t_loc + jnp.arange(t_loc)  # global positions of my queries
+
+    def step(i, carry):
+        k_cur, v_cur, m, l, o = carry
+        src = (me - i) % n  # rank of origin of the chunk I currently hold
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur) * scale
+        if causal:
+            k_pos = src * t_loc + jnp.arange(t_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_cur)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m_new, l_new, o_new)
+
+    m0 = jnp.full((b, h, t_loc), NEG_INF, q.dtype)
+    l0 = jnp.zeros((b, h, t_loc), q.dtype)
+    o0 = jnp.zeros((b, h, t_loc, d), q.dtype)
+    carry = (k, v, m0, l0, o0)
+    # unrolled python loop: n is a static mesh size, so XLA can pipeline the
+    # ppermute of chunk i+1 behind the matmuls of chunk i
+    for i in range(n):
+        carry = step(i, carry)
+    _, _, m, l, o = carry
+    return o / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
+    """q,k,v: (b, h, t, d) GLOBAL arrays (sharded or shardable on t over
+    `axis_name`). Returns attention output with the same sharding."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(("dp",), None, (axis_name,), None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def ring_attention(q, k, v, causal=False, scale=None, axis_name="sp", mesh=None):
+    """Plain attention when no sp sharding is active; ring algorithm when a
+    mesh with a >1 'sp' axis is supplied (or found on the inputs)."""
+    if mesh is not None and mesh.shape.get(axis_name, 1) > 1:
+        return ring_attention_sharded(q, k, v, mesh, axis_name, causal, scale)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)

@@ -83,6 +83,7 @@ class ParallelExecutor:
         trainer_id=0,
         scope=None,
         devices=None,
+        mesh_config=None,
     ):
         self._program = main_program or framework.default_main_program()
         self._loss_name = loss_name
@@ -93,14 +94,21 @@ class ParallelExecutor:
             self._scope = share_vars_from._scope
         devices = devices if devices is not None else jax.devices()
         # reference: one rank per GPU per trainer (nccl_helper.h:115-120);
-        # here: the mesh spans all local devices on the 'dp' axis. Multi-host
-        # (num_trainers>1) extends the same mesh across processes over DCN.
-        self._mesh = Mesh(np.asarray(devices), ("dp",))
+        # here: the mesh spans all local devices — pure 'dp' by default, or a
+        # full dp×tp×sp×ep mesh via mesh_config (parallel.MeshConfig).
+        # Multi-host (num_trainers>1) extends the mesh across processes (DCN).
+        if mesh_config is not None:
+            from .parallel import make_mesh
+
+            self._mesh = make_mesh(mesh_config, devices)
+        else:
+            self._mesh = Mesh(np.asarray(devices), ("dp",))
         self._cache = {}
 
     @property
     def device_count(self):
-        return self._mesh.size
+        """Number of ways the batch is split (the 'dp' axis extent)."""
+        return self._mesh.shape.get("dp", self._mesh.size)
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = feed if feed is not None else (feed_dict or {})
