@@ -24,7 +24,7 @@ from .ops import registry
 
 __all__ = ["append_backward"]
 
-EMPTY_VAR_NAME = "@EMPTY@"
+from .ops.registry import EMPTY_VAR_NAME
 
 
 def _create_grad_var(block, ref_var, name):

@@ -27,8 +27,7 @@ import jax.numpy as jnp
 from . import framework
 from .framework import Program, Variable, convert_np_dtype
 from .ops import registry
-
-EMPTY_VAR_NAME = "@EMPTY@"  # reference core.kEmptyVarName
+from .ops.registry import EMPTY_VAR_NAME
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
 
@@ -164,22 +163,7 @@ class _CompiledBlock:
             env.update(mut_state)
             env.update(feeds)
             ctx = registry.LowerCtx(rng_key, mesh=mesh)
-            for op in ops_:
-                opdef = registry.get(op.type)
-                ins = {}
-                for slot, names in op.inputs.items():
-                    if names:
-                        ins[slot] = [
-                            env[n] if n != EMPTY_VAR_NAME else None for n in names
-                        ]
-                outs = opdef.lower(ctx, ins, op.attrs)
-                for slot, names in op.outputs.items():
-                    vals = outs.get(slot)
-                    if vals is None:
-                        continue
-                    for name, val in zip(names, vals):
-                        if val is not None and name != EMPTY_VAR_NAME:
-                            env[name] = val
+            registry.lower_ops(ctx, ops_, env)
             fetches = [env[n] for n in self.fetch_names]
             new_mut = {n: env[n] for n in self.mut_names}
             # an op may legally omit a declared output slot (lowering returns

@@ -4,7 +4,8 @@ All public layer functions are re-exported flat, so user code written as
 `fluid.layers.fc(...)` works unchanged against `paddle_tpu.layers`.
 """
 
-from . import io, metric_op, nn, ops, sequence, tensor
+from . import control_flow, io, metric_op, nn, ops, sequence, tensor
+from .control_flow import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
@@ -15,7 +16,8 @@ from .learning_rate_scheduler import *  # noqa: F401,F403
 from . import learning_rate_scheduler
 
 __all__ = (
-    io.__all__
+    control_flow.__all__
+    + io.__all__
     + metric_op.__all__
     + nn.__all__
     + ops.__all__

@@ -1,0 +1,304 @@
+"""Control-flow ops: while / conditional_block / recurrent (scan) / tensor
+arrays / print.
+
+Reference analog: paddle/fluid/operators/controlflow/ — while_op.cc:36 runs its
+sub-block via a nested Executor once per iteration, saving per-step scopes
+(StepScopes) for the hand-written while_grad (while_op.cc:112);
+conditional_block_op.cc likewise nests an Executor. The TPU-first redesign
+lowers the sub-block *into the same XLA computation*:
+
+- ``while``   -> jax.lax.while_loop over a carry of the loop-written outer vars
+  (with ``maximum_iterations`` set, a masked lax.scan instead, which XLA can
+  reverse-differentiate — replacing the reference's StepScopes grad machinery
+  with jax.vjp through scan).
+- ``conditional_block`` -> jax.lax.cond; the false branch returns the prior
+  values of the written vars (the reference leaves them untouched in the scope;
+  rebinding the old value is the functional equivalent).
+- ``recurrent`` -> jax.lax.scan; this is the engine under StaticRNN/DynamicRNN
+  (reference recurrent_op.cc + layers/control_flow.py:429,1546). Variable-length
+  sequences use a SeqLen companion and per-row masking instead of the
+  reference's shrinking-batch LoD reordering (SURVEY.md §5.7).
+- tensor arrays (write_to_array / read_from_array, lod_tensor_to_array /
+  array_to_lod_tensor, reference controlflow/tensor_array_read_write_op.cc,
+  lod_tensor_to_array_op.cc) are (buffer[T, ...], size) pairs — a fixed-
+  capacity time-major buffer plus a logical length, static shapes for XLA.
+
+Carries in while/scan must be fixed-shape: arrays written inside a loop must be
+pre-allocated (create_array(shape=...) or lod_tensor_to_array); outside loops
+writes grow the buffer by concatenation (each call site is its own trace).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import LowerCtx, lower_ops, register
+
+
+def _noop_infer(op, block):
+    """Output shapes are set at layer-build time (layers/control_flow.py);
+    array values are (buffer, size) tuples jax.eval_shape cannot abstract
+    from flat var metadata, and while/cond outputs alias their input names
+    whose shapes are already known."""
+    return None
+
+
+def _scalar_bool(x):
+    return jnp.reshape(x, ()).astype(bool)
+
+
+def _mask_rows(active, new, old):
+    """Select per-batch-row between new and old ([B, ...] tensors)."""
+    a = active.reshape((-1,) + (1,) * (new.ndim - 1))
+    return jnp.where(a, new, old)
+
+
+@register("while", infer_shape=_noop_infer)
+def _while(ctx, ins, attrs):
+    sub = attrs["sub_block"]
+    carried = list(attrs["carried_names"])
+    cond_name = attrs["cond_name"]
+    x_names = list(attrs["x_names"])
+    max_iters = attrs.get("maximum_iterations") or 0
+
+    env = dict(zip(x_names, ins["X"]))
+    closure = {n: v for n, v in env.items() if n not in carried}
+    init = tuple(env[n] for n in carried)
+    cond_idx = carried.index(cond_name)
+
+    def run_body(key, vals):
+        e = dict(closure)
+        e.update(zip(carried, vals))
+        c = LowerCtx(key, is_test=ctx.is_test, mesh=ctx.mesh)
+        lower_ops(c, sub.ops, e)
+        return c.key, tuple(e[n] for n in carried)
+
+    if max_iters <= 0:
+        # open-ended loop: XLA While. Not reverse-differentiable — training
+        # loops should set maximum_iterations or use recurrent/StaticRNN.
+        def cond_fn(state):
+            return _scalar_bool(state[1][cond_idx])
+
+        def body_fn(state):
+            return run_body(*state)
+
+        key, final = lax.while_loop(cond_fn, body_fn, (ctx.next_rng(), init))
+    else:
+        # bounded loop: masked scan (differentiable). Iterations past the
+        # condition going false keep the old carry.
+        def scan_body(state, _):
+            key, vals = state
+            active = _scalar_bool(vals[cond_idx])
+            nkey, nvals = run_body(key, vals)
+            sel = tuple(
+                jnp.where(active, nv, v) for nv, v in zip(nvals, vals)
+            )
+            return (nkey, sel), None
+
+        (key, final), _ = lax.scan(
+            scan_body, (ctx.next_rng(), init), None, length=int(max_iters)
+        )
+    ctx.key = key
+    return {"Out": list(final)}
+
+
+@register("conditional_block", infer_shape=_noop_infer)
+def _conditional_block(ctx, ins, attrs):
+    sub = attrs["sub_block"]
+    written = list(attrs["written_names"])
+    x_names = list(attrs["x_names"])
+
+    env = dict(zip(x_names, ins["X"]))
+    conds = [_scalar_bool(c) for c in ins["Cond"]]
+    pred = conds[0]
+    for c in conds[1:]:
+        pred = jnp.logical_and(pred, c)
+
+    prior = tuple(env[n] for n in written)
+
+    def true_fn(key):
+        e = dict(env)
+        c = LowerCtx(key, is_test=ctx.is_test, mesh=ctx.mesh)
+        lower_ops(c, sub.ops, e)
+        return c.key, tuple(e[n].astype(p.dtype) for n, p in zip(written, prior))
+
+    def false_fn(key):
+        return key, prior
+
+    key, outs = lax.cond(pred, true_fn, false_fn, ctx.next_rng())
+    ctx.key = key
+    return {"Out": list(outs)}
+
+
+@register("recurrent", infer_shape=_noop_infer)
+def _recurrent(ctx, ins, attrs):
+    """scan over time. Inputs: X stacked sequence inputs, Boot initial states,
+    C closure (params etc.), SeqLen optional per-row lengths. See layer classes
+    StaticRNN / DynamicRNN (layers/control_flow.py)."""
+    sub = attrs["sub_block"]
+    x_names = list(attrs["x_names"])  # per-step names inside the block
+    pre_names = list(attrs["pre_state_names"])
+    new_names = list(attrs["new_state_names"])
+    out_names = list(attrs["out_names"])
+    closure_names = list(attrs.get("closure_names", []))
+    time_major = bool(attrs.get("time_major", False))
+    reverse = bool(attrs.get("reverse", False))
+
+    seq = [v if time_major else jnp.swapaxes(v, 0, 1) for v in ins.get("X", [])]
+    boot = tuple(ins.get("Boot", []))
+    closure = dict(zip(closure_names, ins.get("C", [])))
+    seqlen = ins.get("SeqLen", [None])[0]
+    if seqlen is not None:
+        seqlen = seqlen.reshape(-1).astype(jnp.int32)
+    T = seq[0].shape[0] if seq else int(attrs["length"])
+    tidx = jnp.arange(T, dtype=jnp.int32)
+
+    def step(carry, scanned):
+        key, states = carry
+        t, xt = scanned
+        e = dict(closure)
+        e.update(zip(pre_names, states))
+        e.update(zip(x_names, xt))
+        c = LowerCtx(key, is_test=ctx.is_test, mesh=ctx.mesh)
+        lower_ops(c, sub.ops, e)
+        new_states = tuple(
+            e[n].astype(s.dtype).reshape(s.shape)
+            for n, s in zip(new_names, states)
+        )
+        outs = tuple(e[n] for n in out_names)
+        if seqlen is not None:
+            active = t < seqlen  # (B,)
+            new_states = tuple(
+                _mask_rows(active, ns, s) for ns, s in zip(new_states, states)
+            )
+            outs = tuple(
+                _mask_rows(active, o, jnp.zeros_like(o)) for o in outs
+            )
+        return (c.key, new_states), outs
+
+    (key, final), ys = lax.scan(
+        step, (ctx.next_rng(), boot), (tidx, tuple(seq)), reverse=reverse
+    )
+    ctx.key = key
+    ys = [y if time_major else jnp.swapaxes(y, 0, 1) for y in ys]
+    return {"Out": list(ys), "FinalState": list(final)}
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays: (buffer[cap, ...], size) pairs
+# ---------------------------------------------------------------------------
+
+
+@register("create_array", infer_shape=_noop_infer)
+def _create_array(ctx, ins, attrs):
+    shape = attrs.get("shape")
+    if not shape:
+        # capacity-less array: first write_to_array creates the buffer
+        return {"Out": [None]}
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    buf = jnp.zeros(tuple(shape), dtype)
+    return {"Out": [(buf, jnp.asarray(0, jnp.int32))]}
+
+
+@register("write_to_array", infer_shape=_noop_infer)
+def _write_to_array(ctx, ins, attrs):
+    """Growable writes carry static capacity bookkeeping from the layer
+    (layers/control_flow.py array_write): ``init_cap`` sizes the buffer of a
+    first write, ``grow_slots`` appends exactly enough rows that the write
+    index (statically known at build time) is in range — arbitrary-index
+    writes land correctly, like the reference write_to_array."""
+    (x,) = ins["X"]
+    (i,) = ins["I"]
+    i = jnp.reshape(i, ()).astype(jnp.int32)
+    arr = ins.get("Array", [None])[0]
+    if arr is None:
+        cap = int(attrs.get("init_cap", 1))
+        buf = jnp.zeros((cap,) + x.shape, x.dtype)
+        start = (i,) + (0,) * x.ndim
+        buf = lax.dynamic_update_slice(buf, x[None], start)
+        size = jnp.maximum(i + 1, 1)
+    else:
+        buf, size = arr
+        grow = int(attrs.get("grow_slots", 0))
+        if grow:
+            pad = jnp.zeros((grow,) + x.shape, buf.dtype)
+            buf = jnp.concatenate([buf, pad], axis=0)
+        start = (i,) + (0,) * x.ndim
+        buf = lax.dynamic_update_slice(buf, x[None].astype(buf.dtype), start)
+        size = jnp.maximum(size, i + 1)
+    return {"Out": [(buf, size)]}
+
+
+@register("read_from_array", infer_shape=_noop_infer)
+def _read_from_array(ctx, ins, attrs):
+    (arr,) = ins["X"]
+    (i,) = ins["I"]
+    buf, _ = arr
+    i = jnp.reshape(i, ()).astype(jnp.int32)
+    return {"Out": [lax.dynamic_index_in_dim(buf, i, 0, keepdims=False)]}
+
+
+@register("lod_array_length", no_grad=True, infer_shape=_noop_infer)
+def _array_length(ctx, ins, attrs):
+    (arr,) = ins["X"]
+    _, size = arr
+    return {"Out": [jnp.reshape(size, (1,)).astype(jnp.int64)]}
+
+
+@register("lod_tensor_to_array", infer_shape=_noop_infer)
+def _lod_tensor_to_array(ctx, ins, attrs):
+    """Padded-dense [B, T, ...] -> time-major array buffer [T, B, ...] with
+    size=T (reference lod_tensor_to_array_op.cc scattered per-rank-table rows;
+    masking replaces the shrinking-batch reorder, SURVEY.md §5.7)."""
+    (x,) = ins["X"]
+    buf = jnp.swapaxes(x, 0, 1)
+    return {"Out": [(buf, jnp.asarray(buf.shape[0], jnp.int32))]}
+
+
+@register("array_to_lod_tensor", infer_shape=_noop_infer)
+def _array_to_lod_tensor(ctx, ins, attrs):
+    (arr,) = ins["X"]
+    buf, _ = arr
+    return {"Out": [jnp.swapaxes(buf, 0, 1)]}
+
+
+@register("shrink_rnn_memory", infer_shape=_noop_infer)
+def _shrink_rnn_memory(ctx, ins, attrs):
+    # reference shrink_memory drops finished rows from the batch; the padded
+    # representation keeps them and masks instead (recurrent op) — identity.
+    (x,) = ins["X"]
+    return {"Out": [x]}
+
+
+@register("max_sequence_len", no_grad=True, infer_shape=_noop_infer)
+def _max_sequence_len(ctx, ins, attrs):
+    (seqlen,) = ins["X"]
+    return {"Out": [jnp.max(seqlen.reshape(-1)).reshape(1).astype(jnp.int64)]}
+
+
+@register("reorder_lod_tensor_by_rank", infer_shape=_noop_infer)
+def _reorder_by_rank(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (rank,) = ins["RankTable"]
+    return {"Out": [jnp.take(x, rank.reshape(-1).astype(jnp.int32), axis=0)]}
+
+
+@register("lod_rank_table", no_grad=True, infer_shape=_noop_infer)
+def _lod_rank_table(ctx, ins, attrs):
+    """Row indices sorted by sequence length, descending (reference
+    lod_rank_table.h). Input is the SeqLen companion vector."""
+    (seqlen,) = ins["X"]
+    order = jnp.argsort(-seqlen.reshape(-1))
+    return {"Out": [order.astype(jnp.int64)]}
+
+
+@register("print", no_grad=False, infer_shape=_noop_infer)
+def _print(ctx, ins, attrs):
+    (x,) = ins["X"]
+    msg = attrs.get("message", "")
+    first_n = int(attrs.get("summarize", 20) or 20)
+    # reference print_op: summarize=-1 means print every element
+    flat = x.reshape(-1) if first_n < 0 else x.reshape(-1)[: max(first_n, 1)]
+    fmt = "%s shape=%s mean={m} first={f}" % (msg, tuple(x.shape))
+    jax.debug.print(fmt, m=jnp.mean(x.astype(jnp.float32)), f=flat)
+    return {"Out": [x]}

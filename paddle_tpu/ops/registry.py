@@ -182,6 +182,37 @@ def _make_generic_grad(fwd_def):
     return lower
 
 
+EMPTY_VAR_NAME = "@EMPTY@"  # reference core.kEmptyVarName
+
+
+def lower_ops(ctx, ops, env):
+    """Lower a list of ops into an env (name -> traced value), rebinding
+    outputs. The single shared interpreter loop for the whole-block executor
+    (executor.py) and for sub-block control-flow ops (while/cond/recurrent in
+    control_flow_ops.py) — the reference's Executor::RunPreparedContext loop
+    (executor.cc:389-396) respectively its nested-Executor reuse inside
+    while_op.cc:36."""
+    for op in ops:
+        opdef = get(op.type)
+        if opdef.skip_exec:
+            continue
+        ins = {}
+        for slot, names in op.inputs.items():
+            if names:
+                ins[slot] = [
+                    env[n] if n != EMPTY_VAR_NAME else None for n in names
+                ]
+        outs = opdef.lower(ctx, ins, op.attrs)
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            for name, val in zip(names, vals):
+                if val is not None and name != EMPTY_VAR_NAME:
+                    env[name] = val
+    return env
+
+
 # ---------------------------------------------------------------------------
 # shape inference (reference: per-op InferShape, operator.cc:705; here derived
 # from the lowering itself with jax.eval_shape)
@@ -203,7 +234,7 @@ def infer_shape(op, block):
     for slot, names in op.inputs.items():
         vals = []
         for name in names:
-            if name == "@EMPTY@":
+            if name == EMPTY_VAR_NAME:
                 vals.append(None)
                 continue
             v = block._var_recursive(name)
@@ -232,7 +263,7 @@ def infer_shape(op, block):
         if vals is None:
             continue
         for name, aval in zip(names, vals):
-            if aval is None or name == "@EMPTY@":
+            if aval is None or name == EMPTY_VAR_NAME:
                 continue
             v = block._var_recursive(name)
             v.shape = tuple(-1 if d == _DYN_SENTINEL else d for d in aval.shape)

@@ -70,7 +70,10 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False, scale=No
     `axis_name`). Returns attention output with the same sharding."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    spec = P(("dp",), None, (axis_name,), None)
+    # batch rides the dp axis when the mesh has one (degrade gracefully on
+    # sp-only meshes, matching sharded_embedding_lookup's guard)
+    batch_axes = ("dp",) if "dp" in mesh.shape else None
+    spec = P(batch_axes, None, (axis_name,), None)
     fn = jax.shard_map(
         functools.partial(
             _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale

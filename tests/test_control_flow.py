@@ -1,0 +1,278 @@
+"""Control-flow tier tests: While, ConditionalBlock, Switch, IfElse,
+StaticRNN, DynamicRNN, tensor arrays.
+
+Modeled on the reference's unittests (test_while_op.py, test_recurrent_op.py,
+test_dyn_rnn.py, test_switch.py, test_array_read_write_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _fresh():
+    main, startup = framework.Program(), framework.Program()
+    return main, startup
+
+
+def run_prog(main, startup, feed, fetch):
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_while_counts_and_accumulates():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=10)
+        acc = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            acc2 = fluid.layers.elementwise_add(
+                acc, fluid.layers.fill_constant([1], "float32", 2.0)
+            )
+            fluid.layers.assign(acc2, acc)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+        (acc_v, i_v) = run_prog(main, startup, {}, [acc.name, i.name])
+    assert i_v[0] == 10
+    np.testing.assert_allclose(acc_v, [20.0], rtol=1e-6)
+
+
+def test_while_bounded_is_differentiable():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        w_param = fluid.layers.create_parameter([4, 4], "float32", name="W")
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=3)
+        h = fluid.layers.elementwise_mul(
+            x, fluid.layers.fill_constant([1], "float32", 1.0)
+        )
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond, maximum_iterations=8)
+        with w.block():
+            h2 = fluid.layers.tanh(fluid.layers.matmul(h, w_param))
+            fluid.layers.assign(h2, h)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    xv = np.random.RandomState(0).randn(2, 4).astype("float32")
+    (loss_v,) = run_prog(main, startup, {"x": xv}, [loss.name])
+    assert np.isfinite(loss_v).all()
+
+
+def test_conditional_block_and_switch():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        step = fluid.layers.fill_constant(shape=[1], dtype="int64", value=7)
+        lr = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        b1 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=5)
+        b2 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=10)
+        sw = fluid.layers.Switch()
+        with sw.case(fluid.layers.less_than(step, b1)):
+            fluid.layers.assign(
+                fluid.layers.fill_constant([1], "float32", 1.0), lr
+            )
+        with sw.case(fluid.layers.less_than(step, b2)):
+            fluid.layers.assign(
+                fluid.layers.fill_constant([1], "float32", 0.1), lr
+            )
+        with sw.default():
+            fluid.layers.assign(
+                fluid.layers.fill_constant([1], "float32", 0.01), lr
+            )
+    (lr_v,) = run_prog(main, startup, {}, [lr.name])
+    np.testing.assert_allclose(lr_v, [0.1], rtol=1e-6)
+
+
+def test_ifelse_batch_select():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+        zero = fluid.layers.fill_constant([1], "float32", 0.0)
+        cond = fluid.layers.greater_than(x, zero)
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            xt = ie.input(x)
+            ie.output(fluid.layers.elementwise_mul(xt, xt))
+        with ie.false_block():
+            xf = ie.input(x)
+            ie.output(fluid.layers.scale(xf, scale=-1.0))
+        out = ie()
+    xv = np.array([[-2.0], [3.0], [0.5], [-1.0]], np.float32)
+    (out_v,) = run_prog(main, startup, {"x": xv}, [out.name])
+    np.testing.assert_allclose(out_v, [[2.0], [9.0], [0.25], [1.0]], rtol=1e-6)
+
+
+def test_static_rnn_matches_numpy():
+    T, B, D, H = 5, 3, 4, 4
+    rng = np.random.RandomState(1)
+    xv = rng.randn(T, B, D).astype("float32")
+
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(
+            name="x", shape=[T, B, D], dtype="float32", append_batch_size=False
+        )
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[H], batch_ref=x, init_value=0.0)
+            nh = fluid.layers.tanh(fluid.layers.elementwise_add(xt, h))
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out = rnn()
+    (out_v,) = run_prog(main, startup, {"x": xv}, [out.name])
+
+    h = np.zeros((B, H), np.float32)
+    expect = []
+    for t in range(T):
+        h = np.tanh(xv[t] + h)
+        expect.append(h)
+    np.testing.assert_allclose(out_v, np.stack(expect), rtol=1e-5, atol=1e-6)
+
+
+def test_dynamic_rnn_masks_finished_rows():
+    B, T, D = 3, 6, 4
+    rng = np.random.RandomState(2)
+    xv = rng.randn(B, T, D).astype("float32")
+    lens = np.array([6, 3, 1], np.int64)
+
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(
+            name="x", shape=[B, T, D], dtype="float32", append_batch_size=False
+        )
+        sl = fluid.layers.data(
+            name="sl", shape=[B], dtype="int64", append_batch_size=False
+        )
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x, seq_len=sl)
+            h = drnn.memory(shape=[D], value=0.0)
+            nh = fluid.layers.tanh(fluid.layers.elementwise_add(xt, h))
+            drnn.update_memory(h, nh)
+            drnn.output(nh)
+        out = drnn()
+        # last valid state per row via sequence_pool LAST
+        last = fluid.layers.sequence_pool(out, "last")
+    (out_v, last_v) = run_prog(
+        main, startup, {"x": xv, "sl": lens}, [out.name, last.name]
+    )
+
+    # numpy reference with masking
+    h = np.zeros((B, D), np.float32)
+    outs = np.zeros((B, T, D), np.float32)
+    for t in range(T):
+        nh = np.tanh(xv[:, t] + h)
+        active = (t < lens)[:, None]
+        h = np.where(active, nh, h)
+        outs[:, t] = np.where(active, nh, 0.0)
+    np.testing.assert_allclose(out_v, outs, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(last_v, h, rtol=1e-5, atol=1e-6)
+    # padding is zero
+    assert np.all(out_v[1, 3:] == 0) and np.all(out_v[2, 1:] == 0)
+
+
+def test_array_write_read_roundtrip():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2, 3], dtype="float32",
+                              append_batch_size=False)
+        i0 = fluid.layers.fill_constant([1], "int64", 0)
+        i1 = fluid.layers.fill_constant([1], "int64", 1)
+        arr = fluid.layers.array_write(x, i0)
+        y = fluid.layers.scale(x, scale=2.0)
+        fluid.layers.array_write(y, i1, array=arr)
+        n = fluid.layers.array_length(arr)
+        r0 = fluid.layers.array_read(arr, i0)
+        r1 = fluid.layers.array_read(arr, i1)
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    n_v, r0_v, r1_v = run_prog(main, startup, {"x": xv}, [n.name, r0.name, r1.name])
+    assert n_v[0] == 2
+    np.testing.assert_allclose(r0_v, xv)
+    np.testing.assert_allclose(r1_v, 2 * xv)
+
+
+def test_array_write_out_of_order():
+    """Non-sequential static indices land in the right slots (reference
+    write_to_array supports arbitrary-index writes)."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              append_batch_size=False)
+        i2 = fluid.layers.fill_constant([1], "int64", 2)
+        i0 = fluid.layers.fill_constant([1], "int64", 0)
+        arr = fluid.layers.array_write(x, i2)
+        y = fluid.layers.scale(x, scale=3.0)
+        fluid.layers.array_write(y, i0, array=arr)
+        n = fluid.layers.array_length(arr)
+        r0 = fluid.layers.array_read(arr, i0)
+        r2 = fluid.layers.array_read(arr, i2)
+    xv = np.array([5.0, 5.0], np.float32)
+    n_v, r0_v, r2_v = run_prog(main, startup, {"x": xv}, [n.name, r0.name, r2.name])
+    assert n_v[0] == 3
+    np.testing.assert_allclose(r0_v, 3 * xv)
+    np.testing.assert_allclose(r2_v, xv)
+
+
+def test_lod_tensor_array_conversions():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[2, 4, 3], dtype="float32",
+                              append_batch_size=False)
+        arr = fluid.layers.lod_tensor_to_array(x)
+        i1 = fluid.layers.fill_constant([1], "int64", 1)
+        step1 = fluid.layers.array_read(arr, i1)  # (B, D) at t=1
+        back = fluid.layers.array_to_lod_tensor(arr)
+    xv = np.random.RandomState(3).randn(2, 4, 3).astype("float32")
+    s1, back_v = run_prog(main, startup, {"x": xv}, [step1.name, back.name])
+    np.testing.assert_allclose(s1, xv[:, 1])
+    np.testing.assert_allclose(back_v, xv)
+
+
+def test_while_with_preallocated_array():
+    """Greedy-decode-style loop writing into a pre-sized array each step."""
+    T = 4
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        arr = fluid.layers.create_array("float32", shape=[T, 2])
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        n = fluid.layers.fill_constant([1], "int64", T)
+        val = fluid.layers.fill_constant([2], "float32", 1.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            v2 = fluid.layers.scale(val, scale=2.0)
+            fluid.layers.assign(v2, val)
+            fluid.layers.array_write(v2, i, array=arr)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+        r = fluid.layers.array_to_lod_tensor(arr)  # (2, T)
+    (r_v,) = run_prog(main, startup, {}, [r.name])
+    np.testing.assert_allclose(r_v.T, [[2, 2], [4, 4], [8, 8], [16, 16]])
+
+
+def test_rank_table_and_reorder():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        sl = fluid.layers.data(name="sl", shape=[4], dtype="int64",
+                               append_batch_size=False)
+        x = fluid.layers.data(name="x", shape=[4, 2], dtype="float32",
+                              append_batch_size=False)
+        table = fluid.layers.lod_rank_table(sl)
+        mx = fluid.layers.max_sequence_len(seq_len=sl)
+        xr = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+    lens = np.array([2, 5, 1, 4], np.int64)
+    xv = np.arange(8, dtype=np.float32).reshape(4, 2)
+    mx_v, xr_v = run_prog(main, startup, {"sl": lens, "x": xv}, [mx.name, xr.name])
+    assert mx_v[0] == 5
+    np.testing.assert_allclose(xr_v, xv[[1, 3, 0, 2]])
