@@ -39,7 +39,18 @@ class Scope:
 
     def __init__(self, seed=0):
         self.vars = {}
-        self.rng_key = jax.random.key(seed)
+        self._seed = seed
+        self._rng_key = None  # lazy: creating a key initializes the backend
+
+    @property
+    def rng_key(self):
+        if self._rng_key is None:
+            self._rng_key = jax.random.key(self._seed)
+        return self._rng_key
+
+    @rng_key.setter
+    def rng_key(self, value):
+        self._rng_key = value
 
     def find_var(self, name):
         return self.vars.get(name)
@@ -277,9 +288,9 @@ class Executor:
         if fetch_list is None:
             fetch_list = []
         scope = scope or global_scope()
-        if scope.rng_key is None or (
-            program.random_seed and not getattr(scope, "_seeded", False)
-        ):
+        # the lazy rng_key property covers the fresh-scope case from the
+        # scope's own seed; only an explicit program.random_seed overrides it
+        if program.random_seed and not getattr(scope, "_seeded", False):
             scope.rng_key = jax.random.key(program.random_seed)
             scope._seeded = True
 

@@ -1,0 +1,37 @@
+"""Virtual-device platform bootstrap shared by tests/conftest.py and the
+driver's dryrun path (__graft_entry__.dryrun_multichip).
+
+The axon sitecustomize force-registers the TPU plugin and overrides
+JAX_PLATFORMS at interpreter start, so setting env vars alone is not enough:
+jax.config must also be flipped before the first backend lookup.
+"""
+
+import os
+import re
+
+
+def force_virtual_cpu_devices(n_devices):
+    """Ensure jax will expose >= n_devices virtual CPU devices.
+
+    Must be called before the first jax backend use (jax.devices() etc.).
+    Returns the exception raised by the platform flip, or None on success —
+    callers can fold it into their own error messages.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d" % n_devices
+        ).strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), "--xla_force_host_platform_device_count=%d" % n_devices
+        )
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:  # backend already initialized on another platform
+        return e
+    return None

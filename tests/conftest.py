@@ -1,20 +1,14 @@
 """Test env: force an 8-device virtual CPU mesh BEFORE any computation, so
 multi-chip SPMD paths compile and run without TPU hardware (the pattern the
-driver's dryrun_multichip also uses).
-
-Note: the axon sitecustomize force-registers the TPU plugin and overrides
-JAX_PLATFORMS at interpreter start, so the env var alone is not enough — we
-must also update jax.config before the first backend lookup.
+driver's dryrun_multichip also uses). Shared bootstrap logic lives in
+paddle_tpu.platform_setup.
 """
 
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from paddle_tpu.platform_setup import force_virtual_cpu_devices
 
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu_devices(8)

@@ -188,7 +188,11 @@ class TestConv2d(OpTest):
 class TestPool2dMax(OpTest):
     def setUp(self):
         self.op_type = "pool2d"
-        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        # well-separated values: finite differencing max() is only valid away
+        # from ties, so keep every pair at least 1/96 apart (>> 2*delta)
+        x = (np.random.permutation(2 * 3 * 4 * 4).astype("float32") / 96.0).reshape(
+            2, 3, 4, 4
+        )
         self.inputs = {"X": x}
         self.attrs = {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
         out = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
