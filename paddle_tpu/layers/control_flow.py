@@ -171,8 +171,10 @@ class While:
     @contextlib.contextmanager
     def block(self):
         self._sub = self._main._create_block()
-        yield
-        self._main._rollback()
+        try:
+            yield
+        finally:
+            self._main._rollback()
         self._complete()
 
     def _complete(self):
@@ -218,8 +220,10 @@ class ConditionalBlock:
     @contextlib.contextmanager
     def block(self):
         self._sub = self._main._create_block()
-        yield
-        self._main._rollback()
+        try:
+            yield
+        finally:
+            self._main._rollback()
         self._complete()
 
     def _complete(self):
@@ -291,14 +295,18 @@ class IfElse:
     @contextlib.contextmanager
     def true_block(self):
         self._in_true = True
-        yield
-        self._in_true = None
+        try:
+            yield
+        finally:
+            self._in_true = None
 
     @contextlib.contextmanager
     def false_block(self):
         self._in_true = False
-        yield
-        self._in_true = None
+        try:
+            yield
+        finally:
+            self._in_true = None
 
     def input(self, x):
         if self._in_true is None:
@@ -354,8 +362,10 @@ class _RNNBase:
     @contextlib.contextmanager
     def _block_ctx(self):
         self._sub = self._main._create_block()
-        yield
-        self._main._rollback()
+        try:
+            yield
+        finally:
+            self._main._rollback()
         self._complete()
 
     def _step_input(self, x, inner_shape):
@@ -730,11 +740,26 @@ def lod_rank_table(x, level=0, seq_len=None):
         outputs={"Out": [out.name]},
     )
     out.stop_gradient = True
+    # remember the length vector so max_sequence_len(rank_table) can resolve
+    # it — the table itself is a row permutation, not lengths
+    out._seq_len_source = src
     return out
 
 
 def max_sequence_len(rank_table=None, seq_len=None):
-    src = seq_len if seq_len is not None else rank_table
+    if seq_len is not None:
+        src = seq_len
+    elif rank_table is not None and getattr(rank_table, "_seq_len_source", None) is not None:
+        # the rank table is a permutation; max() of it would be B-1, not the
+        # max length — resolve back to the length vector it was built from
+        src = rank_table._seq_len_source
+    elif rank_table is not None:
+        raise ValueError(
+            "max_sequence_len needs the sequence-length vector: pass seq_len=, "
+            "or a rank_table produced by lod_rank_table() in this program"
+        )
+    else:
+        raise ValueError("max_sequence_len requires rank_table or seq_len")
     helper = LayerHelper("max_sequence_len")
     out = helper.create_variable_for_type_inference("int64")
     helper.append_op(

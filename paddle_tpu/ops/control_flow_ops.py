@@ -90,8 +90,12 @@ def _while(ctx, ins, attrs):
             key, vals = state
             active = _scalar_bool(vals[cond_idx])
             nkey, nvals = run_body(key, vals)
+            # tree_map: carries may be tensor-array (buffer, size) tuples
             sel = tuple(
-                jnp.where(active, nv, v) for nv, v in zip(nvals, vals)
+                jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(active, a, b), nv, v
+                )
+                for nv, v in zip(nvals, vals)
             )
             return (nkey, sel), None
 
@@ -120,7 +124,12 @@ def _conditional_block(ctx, ins, attrs):
         e = dict(env)
         c = LowerCtx(key, is_test=ctx.is_test, mesh=ctx.mesh)
         lower_ops(c, sub.ops, e)
-        return c.key, tuple(e[n].astype(p.dtype) for n, p in zip(written, prior))
+        # tree_map: written vars may be tensor-array (buffer, size) tuples;
+        # cast each leaf to the prior leaf's dtype so both branches match
+        return c.key, tuple(
+            jax.tree_util.tree_map(lambda v, pl: v.astype(pl.dtype), e[n], p)
+            for n, p in zip(written, prior)
+        )
 
     def false_fn(key):
         return key, prior

@@ -276,3 +276,84 @@ def test_rank_table_and_reorder():
     mx_v, xr_v = run_prog(main, startup, {"sl": lens, "x": xv}, [mx.name, xr.name])
     assert mx_v[0] == 5
     np.testing.assert_allclose(xr_v, xv[[1, 3, 0, 2]])
+
+
+def test_while_bounded_with_array_carry():
+    """maximum_iterations + a tensor-array carry: the masked-scan select must
+    tree_map over (buffer, size) carries, not jnp.where them directly."""
+    T = 6
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        arr = fluid.layers.create_array("float32", shape=[T, 2])
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        n = fluid.layers.fill_constant([1], "int64", 4)
+        val = fluid.layers.fill_constant([2], "float32", 1.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond, maximum_iterations=T)
+        with w.block():
+            v2 = fluid.layers.scale(val, scale=2.0)
+            fluid.layers.assign(v2, val)
+            fluid.layers.array_write(v2, i, array=arr)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+        r = fluid.layers.array_to_lod_tensor(arr)  # (2, T)
+    (r_v,) = run_prog(main, startup, {}, [r.name])
+    # 4 live iterations write 2,4,8,16; slots 4..5 stay zero
+    np.testing.assert_allclose(
+        r_v.T, [[2, 2], [4, 4], [8, 8], [16, 16], [0, 0], [0, 0]]
+    )
+
+
+def test_conditional_block_writes_array():
+    """Writes to a tensor array inside a ConditionalBlock must branch on the
+    (buffer, size) pair, not call .astype on it."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        flag = fluid.layers.data(name="flag", shape=[1], dtype="bool",
+                                 append_batch_size=False)
+        arr = fluid.layers.create_array("float32", shape=[2, 3])
+        i0 = fluid.layers.fill_constant([1], "int64", 0)
+        v = fluid.layers.fill_constant([3], "float32", 7.0)
+        fluid.layers.array_write(
+            fluid.layers.fill_constant([3], "float32", 1.0), i0, array=arr
+        )
+        cb = fluid.layers.ConditionalBlock([flag])
+        with cb.block():
+            fluid.layers.array_write(v, i0, array=arr)
+        out = fluid.layers.array_read(arr, i0)
+    (on,) = run_prog(main, startup, {"flag": np.array([True])}, [out.name])
+    np.testing.assert_allclose(on, [7.0, 7.0, 7.0])
+    (off,) = run_prog(main, startup, {"flag": np.array([False])}, [out.name])
+    np.testing.assert_allclose(off, [1.0, 1.0, 1.0])
+
+
+def test_max_sequence_len_from_rank_table():
+    """Reference signature max_sequence_len(rank_table) must yield the max
+    LENGTH, not the max permutation index."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        sl = fluid.layers.data(name="sl", shape=[4], dtype="int64",
+                               append_batch_size=False)
+        table = fluid.layers.lod_rank_table(sl)
+        mx = fluid.layers.max_sequence_len(table)
+    (mx_v,) = run_prog(main, startup, {"sl": np.array([2, 5, 1, 4], np.int64)},
+                       [mx.name])
+    assert mx_v[0] == 5
+
+
+def test_block_exception_rolls_back():
+    """An exception inside While.block()/ConditionalBlock.block() must restore
+    the current block so later layers don't append into the orphaned sub-block."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        n = fluid.layers.fill_constant([1], "int64", 3)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with pytest.raises(RuntimeError):
+            with w.block():
+                raise RuntimeError("boom")
+        assert main.current_block_idx == 0
+        out = fluid.layers.fill_constant([1], "float32", 5.0)
+    (v,) = run_prog(main, startup, {}, [out.name])
+    np.testing.assert_allclose(v, [5.0])
