@@ -5,4 +5,5 @@ from . import core_ops  # noqa: F401 — registration side effects
 from . import sequence_ops  # noqa: F401 — registration side effects
 from . import parallel_ops  # noqa: F401 — registration side effects
 from . import control_flow_ops  # noqa: F401 — registration side effects
+from . import loss_ops  # noqa: F401 — registration side effects
 from .registry import OPS, get, is_registered, register

@@ -1,0 +1,593 @@
+"""Structured-prediction, ranking, and sampled losses.
+
+Reference analogs (all under paddle/fluid/operators/):
+- linear_chain_crf_op.cc / crf_decoding_op.cc — CRF log-likelihood + Viterbi
+- warpctc_op.cc — CTC loss (reference binds libwarpctc; here a pure-JAX
+  log-domain alpha recursion the MXU/VPU handle directly)
+- ctc_align_op.cc — greedy-decode collapse (merge repeats, drop blanks)
+- nce_op.cc — noise-contrastive estimation with uniform/log-uniform samplers
+- hierarchical_sigmoid_op.cc + math/matrix_bit_code.h — hsigmoid over the
+  implicit complete binary tree (SimpleCode)
+- bpr_loss_op.cc, margin_rank_loss_op.cc, rank_loss_op.cc,
+  modified_huber_loss_op.cc, cos_sim_op.cc
+- edit_distance_op.cc — batched Levenshtein
+- metrics/precision_recall_op.cc — streaming per-class TP/FP/TN/FN
+
+Sequence inputs use the padded-dense [B, T, ...] + SeqLen convention
+(sequence_ops.py); the reference's LoD-scattered layout is SURVEY.md §5.7.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _softplus(x):
+    return jax.nn.softplus(x)
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+
+
+def _crf_split_transition(transition):
+    """reference linear_chain_crf_op.h: row 0 start weights, row 1 end
+    weights, rows 2.. the (D, D) transition matrix."""
+    return transition[0], transition[1], transition[2:]
+
+
+@register("linear_chain_crf")
+def _linear_chain_crf(ctx, ins, attrs):
+    """Outputs the NEGATIVE log likelihood per sequence (the reference's
+    LogLikelihood output is the minimization target, linear_chain_crf_op.h),
+    plus Alpha/EmissionExps/TransitionExps for API parity."""
+    (emission,) = ins["Emission"]  # [B, T, D] float
+    (transition,) = ins["Transition"]  # [D+2, D]
+    (label,) = ins["Label"]  # [B, T, 1] int
+    (seqlen,) = ins["SeqLen"]  # [B]
+    B, T, D = emission.shape
+    label = label.reshape(B, T).astype(jnp.int32)
+    seqlen = seqlen.reshape(-1).astype(jnp.int32)
+    start, end, trans = _crf_split_transition(transition)
+
+    e = emission.astype(jnp.float32)
+    t_steps = jnp.arange(T, dtype=jnp.int32)
+
+    # forward (log-alpha) recursion, masked past each row's length
+    def step(alpha, sc):
+        t, e_t = sc
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) + e_t
+        active = (t < seqlen).reshape(B, 1)
+        alpha = jnp.where(active, nxt, alpha)
+        return alpha, alpha
+
+    alpha0 = start[None] + e[:, 0]
+    alpha_last, alphas = lax.scan(
+        step, alpha0, (t_steps[1:], jnp.swapaxes(e, 0, 1)[1:])
+    )
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, D]
+    log_z = jax.nn.logsumexp(alpha_last + end[None], axis=1)  # [B]
+
+    # gold-path score
+    emit_sc = jnp.take_along_axis(e, label[:, :, None], axis=2).reshape(B, T)
+    t_mask = t_steps[None, :] < seqlen[:, None]
+    emit_score = jnp.sum(jnp.where(t_mask, emit_sc, 0.0), axis=1)
+    pair_sc = trans[label[:, :-1], label[:, 1:]]  # [B, T-1]
+    pair_mask = (t_steps[None, 1:] < seqlen[:, None])
+    trans_score = jnp.sum(jnp.where(pair_mask, pair_sc, 0.0), axis=1)
+    last_idx = jnp.maximum(seqlen - 1, 0)
+    last_tag = jnp.take_along_axis(label, last_idx[:, None], axis=1).reshape(B)
+    score = start[label[:, 0]] + emit_score + trans_score + end[last_tag]
+
+    nll = (log_z - score).reshape(B, 1)
+    return {
+        "LogLikelihood": [nll],
+        "Alpha": [jnp.swapaxes(alphas, 0, 1)],
+        "EmissionExps": [jnp.exp(e)],
+        "TransitionExps": [jnp.exp(transition.astype(jnp.float32))],
+    }
+
+
+@register("crf_decoding", no_grad=True)
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (reference crf_decoding_op.h). With a Label input the
+    output marks per-position correctness instead (the reference behavior used
+    by chunk evaluation)."""
+    (emission,) = ins["Emission"]
+    (transition,) = ins["Transition"]
+    (seqlen,) = ins["SeqLen"]
+    B, T, D = emission.shape
+    seqlen = seqlen.reshape(-1).astype(jnp.int32)
+    start, end, trans = _crf_split_transition(transition)
+    e = emission.astype(jnp.float32)
+    t_steps = jnp.arange(T, dtype=jnp.int32)
+
+    def step(carry, sc):
+        t, e_t = sc
+        delta = carry
+        cand = delta[:, :, None] + trans[None]  # [B, D_prev, D]
+        best_prev = jnp.argmax(cand, axis=1).astype(jnp.int32)
+        nxt = jnp.max(cand, axis=1) + e_t
+        active = (t < seqlen).reshape(B, 1)
+        delta = jnp.where(active, nxt, delta)
+        # inactive rows point back at themselves so backtrace passes through
+        self_ptr = jnp.broadcast_to(jnp.arange(D, dtype=jnp.int32), (B, D))
+        best_prev = jnp.where(active, best_prev, self_ptr)
+        return delta, best_prev
+
+    delta0 = start[None] + e[:, 0]
+    delta_last, back = lax.scan(
+        step, delta0, (t_steps[1:], jnp.swapaxes(e, 0, 1)[1:])
+    )  # back: [T-1, B, D]
+    last_tag = jnp.argmax(delta_last + end[None], axis=1).astype(jnp.int32)
+
+    def backstep(tag, ptr):
+        prev = jnp.take_along_axis(ptr, tag[:, None], axis=1).reshape(B)
+        return prev, tag
+
+    _, path_rev = lax.scan(backstep, last_tag, back, reverse=True)
+    first_tag = _  # tag at t=0 after full backtrace
+    path = jnp.concatenate([first_tag[None], path_rev], axis=0)  # [T, B]
+    path = jnp.swapaxes(path, 0, 1)  # [B, T]
+    t_mask = t_steps[None, :] < seqlen[:, None]
+    path = jnp.where(t_mask, path, 0)
+
+    label = ins.get("Label", [None])[0]
+    if label is not None:
+        lbl = label.reshape(B, T).astype(jnp.int32)
+        path = jnp.where(t_mask, (path == lbl).astype(jnp.int32), 0)
+    return {"ViterbiPath": [path[:, :, None].astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+
+@register("warpctc")
+def _warpctc(ctx, ins, attrs):
+    """CTC loss, log-domain alpha recursion over the blank-extended label
+    (reference warpctc_op.cc via libwarpctc; Graves 2006 eq. 6-8)."""
+    (logits,) = ins["Logits"]  # [B, T, C]
+    (label,) = ins["Label"]  # [B, L, 1] int
+    (logits_len,) = ins["LogitsLength"]
+    (label_len,) = ins["LabelLength"]
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = bool(attrs.get("norm_by_times", False))
+
+    B, T, C = logits.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+    label = label.reshape(B, L).astype(jnp.int32)
+    logits_len = logits_len.reshape(-1).astype(jnp.int32)
+    label_len = label_len.reshape(-1).astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=2)
+
+    NEG = jnp.float32(-1e30)
+    s_idx = jnp.arange(S, dtype=jnp.int32)
+    # extended sequence: even slots blank, odd slots label[s//2]
+    lab_idx = jnp.minimum(jnp.broadcast_to(s_idx[None, :] // 2, (B, S)), L - 1)
+    ext = jnp.where(
+        s_idx[None, :] % 2 == 0, blank, jnp.take_along_axis(label, lab_idx, axis=1)
+    )  # [B, S]
+    ext_valid = s_idx[None, :] < (2 * label_len[:, None] + 1)
+
+    # skip-transition allowed where ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    def emit(t):
+        return jnp.take_along_axis(logp[:, t], ext, axis=1)  # [B, S]
+
+    a0 = jnp.full((B, S), NEG)
+    a0 = a0.at[:, 0].set(logp[:, 0, blank])
+    first_lab = jnp.take_along_axis(logp[:, 0], ext[:, 1:2], axis=1).reshape(B)
+    a0 = a0.at[:, 1].set(jnp.where(label_len > 0, first_lab, NEG))
+
+    def lse2(a, b):
+        return jnp.logaddexp(a, b)
+
+    def step(alpha, t):
+        sh1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        sh2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        acc = lse2(alpha, sh1)
+        acc = jnp.where(can_skip, lse2(acc, sh2), acc)
+        nxt = acc + emit(t)
+        nxt = jnp.where(ext_valid, nxt, NEG)
+        active = (t < logits_len).reshape(B, 1)
+        return jnp.where(active, nxt, alpha), None
+
+    alpha, _ = lax.scan(step, a0, jnp.arange(1, T, dtype=jnp.int32))
+
+    end1 = 2 * label_len  # final blank slot
+    end2 = jnp.maximum(2 * label_len - 1, 0)  # final label slot
+    ll = jnp.logaddexp(
+        jnp.take_along_axis(alpha, end1[:, None], axis=1).reshape(B),
+        jnp.where(
+            label_len > 0,
+            jnp.take_along_axis(alpha, end2[:, None], axis=1).reshape(B),
+            NEG,
+        ),
+    )
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(logits_len.astype(jnp.float32), 1.0)
+    return {"Loss": [loss.reshape(B, 1)]}
+
+
+@register("ctc_align", no_grad=True)
+def _ctc_align(ctx, ins, attrs):
+    """Collapse repeats then drop blanks (reference ctc_align_op.cc). Output
+    stays padded [B, T, 1] with an OutLen companion; removed slots are filled
+    with padding_value."""
+    (x,) = ins["Input"]  # [B, T, 1] int tokens
+    (seqlen,) = ins["SeqLen"]
+    blank = int(attrs.get("blank", 0))
+    pad_val = int(attrs.get("padding_value", 0))
+    B, T = x.shape[0], x.shape[1]
+    tok = x.reshape(B, T).astype(jnp.int32)
+    seqlen = seqlen.reshape(-1).astype(jnp.int32)
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    valid = t_idx[None, :] < seqlen[:, None]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), tok[:, :-1]], axis=1)
+    keep = (tok != blank) & (tok != prev) & valid
+    # stable-compact kept tokens to the front of each row
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compacted = jnp.take_along_axis(tok, order, axis=1)
+    out_len = keep.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(t_idx[None, :] < out_len[:, None], compacted, pad_val)
+    return {"Output": [out[:, :, None].astype(x.dtype)], "OutLen": [out_len]}
+
+
+# ---------------------------------------------------------------------------
+# sampled losses
+# ---------------------------------------------------------------------------
+
+
+def _log_uniform_probs(C):
+    k = jnp.arange(C, dtype=jnp.float32)
+    return (jnp.log(k + 2.0) - jnp.log(k + 1.0)) / jnp.log(C + 1.0)
+
+
+def _draw_samples(key, sampler, C, S):
+    if sampler == "log_uniform":
+        u = jax.random.uniform(key, (S,))
+        # inverse CDF of P(k) ∝ log((k+2)/(k+1)): k = floor((C+1)^u) - 1
+        s = jnp.floor(jnp.exp(u * jnp.log(C + 1.0))).astype(jnp.int32) - 1
+        return jnp.clip(s, 0, C - 1)
+    return jax.random.randint(key, (S,), 0, C)
+
+
+@register("nce", stochastic=True)
+def _nce(ctx, ins, attrs):
+    """NCE logistic loss with shared negative samples (reference nce_op.h:
+    uniform or log-uniform ("custom_dist" unsupported) sampler)."""
+    (x,) = ins["Input"]  # [B, D]
+    (label,) = ins["Label"]  # [B, num_true]
+    (w,) = ins["Weight"]  # [C, D]
+    bias = ins.get("Bias", [None])[0]  # [C]
+    C = int(attrs["num_total_classes"])
+    S = int(attrs.get("num_neg_samples", 10))
+    sampler = attrs.get("sampler", "uniform")
+    B = x.shape[0]
+    label = label.reshape(B, -1).astype(jnp.int32)
+    num_true = label.shape[1]
+
+    if sampler == "log_uniform":
+        probs = _log_uniform_probs(C)
+    else:
+        probs = jnp.full((C,), 1.0 / C)
+
+    neg = _draw_samples(ctx.next_rng(), sampler, C, S)  # [S]
+
+    # gather only the sampled rows of W — never the full [B, C] logits
+    pos_logit = jnp.einsum("bd,btd->bt", x, w[label])  # [B, num_true]
+    neg_logit = jnp.einsum("bd,sd->bs", x, w[neg])  # [B, S]
+    if bias is not None:
+        pos_logit = pos_logit + bias.reshape(-1)[label]
+        neg_logit = neg_logit + bias.reshape(-1)[neg][None, :]
+
+    # logistic NCE: subtract log expected count under the noise distribution
+    pos_adj = pos_logit - jnp.log(S * probs[label] + 1e-12)
+    neg_adj = neg_logit - jnp.log(S * probs[neg][None, :] + 1e-12)
+    cost = jnp.sum(_softplus(-pos_adj), axis=1) / num_true + jnp.sum(
+        _softplus(neg_adj), axis=1
+    )
+    return {
+        "Cost": [cost.reshape(B, 1)],
+        "SampleLogits": [jnp.concatenate([pos_adj, neg_adj], axis=1)],
+        "SampleLabels": [
+            jnp.concatenate(
+                [label, jnp.broadcast_to(neg[None, :], (B, S))], axis=1
+            ).astype(jnp.int64)
+        ],
+    }
+
+
+@register("hierarchical_sigmoid")
+def _hsigmoid(ctx, ins, attrs):
+    """Complete-binary-tree hsigmoid (reference hierarchical_sigmoid_op.h +
+    math/matrix_bit_code.h SimpleCode: c = label + C, index_j = (c>>(j+1))-1,
+    bit_j = (c>>j)&1, path length = highest set bit)."""
+    (x,) = ins["X"]  # [B, D]
+    (w,) = ins["W"]  # [C-1, D]
+    (label,) = ins["Label"]  # [B, 1]
+    bias = ins.get("Bias", [None])[0]  # [C-1]
+    C = int(attrs["num_classes"])
+    B, D = x.shape
+    label = label.reshape(B).astype(jnp.int32)
+    c = label + C
+    max_len = max(int.bit_length(2 * C - 1) - 1, 1)
+    j = jnp.arange(max_len, dtype=jnp.int32)  # [J]
+    length = jnp.floor(jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)
+    on_path = j[None, :] < length[:, None]  # [B, J]
+    idx = jnp.clip((c[:, None] >> (j[None, :] + 1)) - 1, 0, C - 2)
+    bit = ((c[:, None] >> j[None, :]) & 1).astype(jnp.float32)
+    t = jnp.einsum("bd,bjd->bj", x, w[idx])
+    if bias is not None:
+        t = t + bias.reshape(-1)[idx]
+    pre = jnp.where(on_path, t, 0.0)
+    cost = jnp.sum(jnp.where(on_path, _softplus(t) - bit * t, 0.0), axis=1)
+    return {"Cost": [cost.reshape(B, 1)], "PreOut": [pre]}
+
+
+@register("sampling_id", no_grad=True, stochastic=True)
+def _sampling_id(ctx, ins, attrs):
+    """Sample a column index per row from a probability matrix (reference
+    sampling_id_op.cc)."""
+    (x,) = ins["X"]  # [B, C] probabilities
+    key = ctx.next_rng()
+    ids = jax.random.categorical(key, jnp.log(x + 1e-20), axis=1)
+    return {"Out": [ids.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# ranking / misc losses
+# ---------------------------------------------------------------------------
+
+
+@register("bpr_loss")
+def _bpr_loss(ctx, ins, attrs):
+    """Bayesian personalized ranking (reference bpr_loss_op.h): mean over
+    j != label of softplus(x_j - x_label)."""
+    (x,) = ins["X"]  # [B, C]
+    (label,) = ins["Label"]  # [B, 1]
+    B, C = x.shape
+    lbl = label.reshape(B, 1).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, lbl, axis=1)  # [B, 1]
+    diff = _softplus(x - pos)  # softplus(0)=log2 at j=label, subtracted below
+    cost = (jnp.sum(diff, axis=1) - _softplus(jnp.zeros(()))) / (C - 1)
+    return {"Cost": [cost.reshape(B, 1)]}
+
+
+@register("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    (x1,) = ins["X1"]
+    (x2,) = ins["X2"]
+    (label,) = ins["Label"]  # +1: x1 ranks higher, -1: x2
+    margin = float(attrs.get("margin", 0.0))
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    """RankNet pairwise loss (reference rank_loss_op.cc): o = left-right,
+    C = softplus(o) - label*o."""
+    (label,) = ins["Label"]
+    (left,) = ins["Left"]
+    (right,) = ins["Right"]
+    o = left - right
+    return {"Out": [_softplus(o) - label * o]}
+
+
+@register("modified_huber_loss")
+def _modified_huber_loss(ctx, ins, attrs):
+    """reference modified_huber_loss_op.h: y in {0,1} mapped to ±1; z=y*x;
+    quadratic in [-1, inf), linear below."""
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    yy = 2.0 * y - 1.0
+    z = yy * x
+    out = jnp.where(z < -1.0, -4.0 * z, jnp.square(jnp.maximum(0.0, 1.0 - z)))
+    return {"Out": [out], "IntermediateVal": [z]}
+
+
+@register("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    """reference cos_sim_op.h; Y may have 1 row (broadcast over the batch)."""
+    (x,) = ins["X"]  # [B, D]
+    (y,) = ins["Y"]  # [B, D] or [1, D]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=1, keepdims=True))
+    dot = jnp.sum(x * y, axis=1, keepdims=True)
+    out = dot / (xn * yn + 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+# ---------------------------------------------------------------------------
+# evaluation ops
+# ---------------------------------------------------------------------------
+
+
+@register("edit_distance", no_grad=True)
+def _edit_distance(ctx, ins, attrs):
+    """Batched Levenshtein distance (reference edit_distance_op.h), DP row
+    recursion scanned over hypothesis positions."""
+    (hyp,) = ins["Hyps"]  # [B, T1, 1] int
+    (ref,) = ins["Refs"]  # [B, T2, 1] int
+    (hyp_len,) = ins["HypsLen"]
+    (ref_len,) = ins["RefsLen"]
+    normalized = bool(attrs.get("normalized", True))
+    B, T1 = hyp.shape[0], hyp.shape[1]
+    T2 = ref.shape[1]
+    hyp = hyp.reshape(B, T1).astype(jnp.int32)
+    ref = ref.reshape(B, T2).astype(jnp.int32)
+    hyp_len = hyp_len.reshape(-1).astype(jnp.int32)
+    ref_len = ref_len.reshape(-1).astype(jnp.int32)
+
+    j_idx = jnp.arange(T2 + 1, dtype=jnp.float32)
+    row0 = jnp.broadcast_to(j_idx, (B, T2 + 1))
+
+    def step(row, sc):
+        i, h_i = sc  # i: 1-based hyp position, h_i: [B]
+        sub_cost = (ref != h_i[:, None]).astype(jnp.float32)  # [B, T2]
+        # new_row[0] = i; new_row[j] = min(row[j]+1, new_row[j-1]+1, row[j-1]+sub)
+        del_c = row[:, 1:] + 1.0
+        sub_c = row[:, :-1] + sub_cost
+
+        def inner(prev, cols):
+            d, s = cols
+            cur = jnp.minimum(jnp.minimum(d, prev + 1.0), s)
+            return cur, cur
+
+        init = jnp.full((B,), i, jnp.float32)
+        _, rest = lax.scan(
+            inner, init, (jnp.swapaxes(del_c, 0, 1), jnp.swapaxes(sub_c, 0, 1))
+        )
+        new_row = jnp.concatenate([init[:, None], jnp.swapaxes(rest, 0, 1)], axis=1)
+        active = (i <= hyp_len.astype(jnp.float32)).reshape(B, 1)
+        row = jnp.where(active, new_row, row)
+        return row, None
+
+    i_steps = jnp.arange(1, T1 + 1, dtype=jnp.float32)
+    final, _ = lax.scan(step, row0, (i_steps, jnp.swapaxes(hyp, 0, 1).astype(jnp.float32)))
+    dist = jnp.take_along_axis(final, ref_len[:, None], axis=1).reshape(B)
+    if normalized:
+        dist = dist / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
+    return {
+        "Out": [dist.reshape(B, 1)],
+        "SequenceNum": [jnp.asarray([B], jnp.int64)],
+    }
+
+
+@register("precision_recall", no_grad=True)
+def _precision_recall(ctx, ins, attrs):
+    """Streaming macro/micro precision/recall/F1 (reference
+    metrics/precision_recall_op.h). States are per-class [TP, FP, TN, FN]."""
+    (idx,) = ins["Indices"]  # [B, 1] predicted class
+    (labels,) = ins["Labels"]  # [B, 1]
+    states = ins.get("StatesInfo", [None])[0]  # [C, 4] accumulated
+    C = int(attrs["class_number"])
+    B = idx.shape[0]
+    pred = jax.nn.one_hot(idx.reshape(B).astype(jnp.int32), C)
+    true = jax.nn.one_hot(labels.reshape(B).astype(jnp.int32), C)
+    tp = jnp.sum(pred * true, axis=0)
+    fp = jnp.sum(pred * (1 - true), axis=0)
+    fn = jnp.sum((1 - pred) * true, axis=0)
+    tn = jnp.sum((1 - pred) * (1 - true), axis=0)
+    batch = jnp.stack([tp, fp, tn, fn], axis=1)  # [C, 4]
+    acc = batch if states is None else batch + states
+
+    def metrics(st):
+        tp_, fp_, _, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_ + 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_ + 1e-12), 0.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / (prec + rec + 1e-12), 0.0)
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        stp, sfp, sfn = tp_.sum(), fp_.sum(), fn_.sum()
+        mprec = jnp.where(stp + sfp > 0, stp / (stp + sfp + 1e-12), 0.0)
+        mrec = jnp.where(stp + sfn > 0, stp / (stp + sfn + 1e-12), 0.0)
+        mf1 = jnp.where(
+            mprec + mrec > 0, 2 * mprec * mrec / (mprec + mrec + 1e-12), 0.0
+        )
+        return jnp.concatenate([macro, jnp.stack([mprec, mrec, mf1])])
+
+    return {
+        "BatchMetrics": [metrics(batch)],
+        "AccumMetrics": [metrics(acc)],
+        "AccumStatesInfo": [acc],
+    }
+
+
+# ---------------------------------------------------------------------------
+# proximal optimizers (reference optimizers/proximal_gd_op.h,
+# proximal_adagrad_op.h)
+# ---------------------------------------------------------------------------
+
+
+def _prox(p, lr, l1, l2):
+    return (
+        jnp.sign(p) * jnp.maximum(jnp.abs(p) - lr * l1, 0.0) / (1.0 + lr * l2)
+    )
+
+
+@register("proximal_gd", no_grad=True)
+def _proximal_gd(ctx, ins, attrs):
+    (p,) = ins["Param"]
+    (g,) = ins["Grad"]
+    (lr,) = ins["LearningRate"]
+    l1, l2 = float(attrs.get("l1", 0.0)), float(attrs.get("l2", 0.0))
+    lr = lr.reshape(())
+    return {"ParamOut": [_prox(p - lr * g, lr, l1, l2)]}
+
+
+@register("proximal_adagrad", no_grad=True)
+def _proximal_adagrad(ctx, ins, attrs):
+    (p,) = ins["Param"]
+    (g,) = ins["Grad"]
+    (m,) = ins["Moment"]
+    (lr,) = ins["LearningRate"]
+    l1, l2 = float(attrs.get("l1", 0.0)), float(attrs.get("l2", 0.0))
+    m_out = m + jnp.square(g)
+    lr = lr.reshape(())
+    # grad step scales by lr/sqrt(moment), but the l1/l2 shrinkage uses the
+    # plain scalar lr (reference proximal_adagrad_op.h)
+    prox_param = p - lr * g / jnp.sqrt(m_out + 1e-10)
+    return {"ParamOut": [_prox(prox_param, lr, l1, l2)], "MomentOut": [m_out]}
+
+
+@register("average_accumulates", no_grad=True)
+def _average_accumulates(ctx, ins, attrs):
+    """Sliding-window parameter-sum accumulation for ModelAverage (reference
+    operators/average_accumulates_op.h; kMaxNumAccumulates window shifting)."""
+    (p,) = ins["Param"]
+    sum_1, sum_2, sum_3 = ins["Sums"]
+    num_acc, old_num_acc, num_upd = [c.reshape(()) for c in ins["Counters"]]
+    avg_window = float(attrs.get("average_window", 0.0))
+    min_w = int(attrs.get("min_average_window", 10000))
+    max_w = int(attrs.get("max_average_window", 10000))
+    K_MAX = 16384
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    sum_1 = sum_1 + p
+
+    fold = num_upd % K_MAX == 0
+    sum_2 = jnp.where(fold, sum_2 + sum_1, sum_2)
+    sum_1 = jnp.where(fold, jnp.zeros_like(sum_1), sum_1)
+
+    window = jnp.minimum(
+        jnp.asarray(max_w, num_upd.dtype),
+        (num_upd.astype(jnp.float32) * avg_window).astype(num_upd.dtype),
+    )
+    shift = (num_acc >= min_w) & (num_acc >= window)
+    sum_3 = jnp.where(shift, sum_1 + sum_2, sum_3)
+    sum_1 = jnp.where(shift, jnp.zeros_like(sum_1), sum_1)
+    sum_2 = jnp.where(shift, jnp.zeros_like(sum_2), sum_2)
+    old_num_acc = jnp.where(shift, num_acc, old_num_acc)
+    num_acc = jnp.where(shift, jnp.zeros_like(num_acc), num_acc)
+
+    return {
+        "SumsOut": [sum_1, sum_2, sum_3],
+        "CountersOut": [
+            num_acc.reshape(1),
+            old_num_acc.reshape(1),
+            num_upd.reshape(1),
+        ],
+    }
+
+
+@register("average_apply", no_grad=True)
+def _average_apply(ctx, ins, attrs):
+    """Swap a parameter for its windowed average, backing up the live value
+    (ModelAverage.apply; reference optimizer.py _add_average_apply_op)."""
+    (p,) = ins["Param"]
+    sum_1, sum_2, sum_3 = ins["Sums"]
+    num_acc, old_num_acc = [c.reshape(()) for c in ins["Counters"]]
+    total = (num_acc + old_num_acc).astype(p.dtype)
+    avg = (sum_1 + sum_2 + sum_3) / jnp.maximum(total, 1.0)
+    return {"ParamOut": [avg.astype(p.dtype)], "Backup": [p]}

@@ -8,6 +8,8 @@ variable names, which the executor turns into donated in-place buffer updates
 on TPU (executor.py).
 """
 
+import contextlib
+
 import numpy as np
 
 from . import framework
@@ -37,6 +39,10 @@ __all__ = [
     "FtrlOptimizer",
     "AdadeltaOptimizer",
     "ModelAverage",
+    "ProximalGD",
+    "ProximalAdagrad",
+    "ProximalGDOptimizer",
+    "ProximalAdagradOptimizer",
     "LarsMomentum",
     "LarsMomentumOptimizer",
 ]
@@ -584,17 +590,172 @@ class FtrlOptimizer(Optimizer):
         )
 
 
-class ModelAverage(Optimizer):
-    """Sliding-window parameter averaging (reference optimizer.py
-    ModelAverage). Round-1 scope: accumulates sums so apply()/restore() work
-    for inference-time averaging of recent checkpoints."""
+class ProximalGDOptimizer(Optimizer):
+    """reference optimizer.py ProximalGDOptimizer → optimizers/proximal_gd_op.cc"""
 
-    def __init__(self, average_window_rate, min_average_window=10000, max_average_window=10000, **kwargs):
-        super().__init__(0.0, **kwargs)
-        raise NotImplementedError(
-            "ModelAverage lands with the checkpoint/EMA tier; "
-            "use optimizer state checkpointing meanwhile"
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "proximal_gd"
+        self._l1, self._l2 = l1, l2
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="proximal_gd",
+            inputs={
+                "Param": [p.name],
+                "Grad": [g.name],
+                "LearningRate": [self._create_param_lr(param_and_grad).name],
+            },
+            outputs={"ParamOut": [p.name]},
+            attrs={"l1": self._l1, "l2": self._l2},
         )
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """reference optimizer.py ProximalAdagradOptimizer →
+    optimizers/proximal_adagrad_op.cc"""
+
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.type = "proximal_adagrad"
+        self._l1, self._l2 = l1, l2
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        moment = self._get_accumulator(self._moment_acc_str, p)
+        return block.append_op(
+            type="proximal_adagrad",
+            inputs={
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment": [moment.name],
+                "LearningRate": [self._create_param_lr(param_and_grad).name],
+            },
+            outputs={"ParamOut": [p.name], "MomentOut": [moment.name]},
+            attrs={"l1": self._l1, "l2": self._l2},
+        )
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window parameter averaging (reference optimizer.py ModelAverage
+    → operators/average_accumulates_op.cc). Construct AFTER minimize();
+    accumulation ops are appended to the main program for every parameter,
+    and ``with model_average.apply(exe):`` swaps averaged weights in (restored
+    on exit unless need_restore=False)."""
+
+    def __init__(
+        self,
+        average_window_rate,
+        min_average_window=10000,
+        max_average_window=10000,
+        **kwargs,
+    ):
+        super().__init__(0.0, **kwargs)
+        self.type = "model_average"
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.params_grads = [
+            (p, None)
+            for p in default_main_program().global_block().all_parameters()
+        ]
+        self.helper = LayerHelper(self.__class__.__name__)
+        block = default_main_program().global_block()
+        for p, _ in self.params_grads:
+            self._append_average_accumulate_op(block, p)
+
+    def _append_average_accumulate_op(self, block, param):
+        sums = [
+            self._add_accumulator("sum_%d" % i, param) for i in (1, 2, 3)
+        ]
+        counters = [
+            self._add_accumulator(n, param, dtype="int64", shape=[1])
+            for n in ("num_accumulates", "old_num_accumulates", "num_updates")
+        ]
+        names = [v.name for v in sums] + [v.name for v in counters]
+        with default_main_program()._optimized_guard([param, None]):
+            block.append_op(
+                type="average_accumulates",
+                inputs={
+                    "Param": [param.name],
+                    "Sums": names[:3],
+                    "Counters": names[3:],
+                },
+                outputs={"SumsOut": names[:3], "CountersOut": names[3:]},
+                attrs={
+                    "average_window": self.average_window,
+                    "min_average_window": self.min_average_window,
+                    "max_average_window": self.max_average_window,
+                },
+            )
+
+    def _build_swap_program(self, to_average):
+        prog = framework.Program()
+        with framework.program_guard(prog):
+            block = prog.global_block()
+            for p, _ in self.params_grads:
+                # mirror vars by name so the shared scope resolves them
+                for v in [p] + [
+                    self._get_accumulator("sum_%d" % i, p) for i in (1, 2, 3)
+                ] + [
+                    self._get_accumulator(n, p)
+                    for n in ("num_accumulates", "old_num_accumulates")
+                ] + [self._backup_var(p)]:
+                    if v.name not in block.vars:
+                        block.create_var(
+                            name=v.name,
+                            shape=v.shape,
+                            dtype=v.dtype,
+                            persistable=True,
+                        )
+                if to_average:
+                    block.append_op(
+                        type="average_apply",
+                        inputs={
+                            "Param": [p.name],
+                            "Sums": [
+                                self._get_accumulator("sum_%d" % i, p).name
+                                for i in (1, 2, 3)
+                            ],
+                            "Counters": [
+                                self._get_accumulator(n, p).name
+                                for n in ("num_accumulates", "old_num_accumulates")
+                            ],
+                        },
+                        outputs={
+                            "ParamOut": [p.name],
+                            "Backup": [self._backup_var(p).name],
+                        },
+                    )
+                else:
+                    block.append_op(
+                        type="assign",
+                        inputs={"X": [self._backup_var(p).name]},
+                        outputs={"Out": [p.name]},
+                    )
+        return prog
+
+    def _backup_var(self, param):
+        return self._add_accumulator("restore_backup", param)
+
+    @contextlib.contextmanager
+    def apply(self, executor, need_restore=True):
+        executor.run(self._build_swap_program(to_average=True))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor):
+        executor.run(self._build_swap_program(to_average=False))
 
 
 # short aliases matching fluid.optimizer public names
@@ -608,3 +769,5 @@ Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 LarsMomentum = LarsMomentumOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer

@@ -1,0 +1,569 @@
+"""Structured/ranking/sampled loss tier tests (reference unittests:
+test_linear_chain_crf_op.py, test_crf_decoding_op.py, test_warpctc_op.py,
+test_ctc_align_op.py, test_nce.py, test_hsigmoid_op.py, test_bpr_loss_op.py,
+test_margin_rank_loss_op.py, test_rank_loss_op.py, test_modified_huber_loss_op.py,
+test_cos_sim_op.py, test_edit_distance_op.py, test_precision_recall_op.py,
+test_proximal_gd_op.py, test_proximal_adagrad_op.py)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+
+from op_test import OpTest
+
+
+def run_prog(main, startup, feed, fetch, seed=0):
+    scope = Scope(seed=seed)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def _fresh():
+    return framework.Program(), framework.Program()
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+
+def _crf_brute_force(emission, transition, label, lens):
+    """Enumerate all paths: returns per-seq negative log likelihood."""
+    B, T, D = emission.shape
+    start, end, trans = transition[0], transition[1], transition[2:]
+    nll = np.zeros((B,))
+    for b in range(B):
+        L = lens[b]
+        scores = []
+        for path in itertools.product(range(D), repeat=L):
+            s = start[path[0]] + end[path[-1]]
+            s += sum(emission[b, t, path[t]] for t in range(L))
+            s += sum(trans[path[t - 1], path[t]] for t in range(1, L))
+            scores.append(s)
+        log_z = np.logaddexp.reduce(scores)
+        gold = label[b, :L]
+        s = start[gold[0]] + end[gold[L - 1]]
+        s += sum(emission[b, t, gold[t]] for t in range(L))
+        s += sum(trans[gold[t - 1], gold[t]] for t in range(1, L))
+        nll[b] = log_z - s
+    return nll
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(5)
+    B, T, D = 2, 3, 3
+    emission = rng.randn(B, T, D).astype("float32")
+    transition = (rng.randn(D + 2, D) * 0.5).astype("float32")
+    label = rng.randint(0, D, (B, T, 1)).astype("int64")
+    lens = np.array([3, 2], np.int64)
+
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        em = fluid.layers.data(name="em", shape=[B, T, D], dtype="float32",
+                               append_batch_size=False)
+        em._len_name = "lens"
+        main.global_block().create_var(name="lens", shape=(B,), dtype="int64")
+        lb = fluid.layers.data(name="lb", shape=[B, T, 1], dtype="int64",
+                               append_batch_size=False)
+        crf = fluid.layers.linear_chain_crf(
+            em, lb, param_attr=fluid.ParamAttr(name="crfw"))
+    # feed the transition parameter directly for a deterministic check
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        scope.set_var("crfw", transition)
+        (nll,) = exe.run(
+            main, feed={"em": emission, "lb": label, "lens": lens},
+            fetch_list=[crf.name])
+    want = _crf_brute_force(emission, transition, label.reshape(B, T), lens)
+    np.testing.assert_allclose(np.asarray(nll).reshape(-1), want, rtol=2e-4, atol=2e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(7)
+    B, T, D = 2, 4, 3
+    emission = rng.randn(B, T, D).astype("float32")
+    transition = (rng.randn(D + 2, D) * 0.5).astype("float32")
+    lens = np.array([4, 2], np.int64)
+
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        em = fluid.layers.data(name="em", shape=[B, T, D], dtype="float32",
+                               append_batch_size=False)
+        em._len_name = "lens"
+        main.global_block().create_var(name="lens", shape=(B,), dtype="int64")
+        transition_var = main.global_block().create_var(
+            name="crfw2", shape=(D + 2, D), dtype="float32")
+        path = fluid.layers.crf_decoding(em, param_attr="crfw2")
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        scope.set_var("crfw2", transition)
+        (got,) = exe.run(main, feed={"em": emission, "lens": lens},
+                         fetch_list=[path.name])
+    got = np.asarray(got).reshape(B, T)
+
+    start, end, trans = transition[0], transition[1], transition[2:]
+    for b in range(B):
+        L = lens[b]
+        best, best_s = None, -np.inf
+        for p in itertools.product(range(D), repeat=L):
+            s = start[p[0]] + end[p[-1]]
+            s += sum(emission[b, t, p[t]] for t in range(L))
+            s += sum(trans[p[t - 1], p[t]] for t in range(1, L))
+            if s > best_s:
+                best, best_s = p, s
+        np.testing.assert_array_equal(got[b, :L], np.array(best))
+        assert (got[b, L:] == 0).all()
+
+
+def test_crf_trains_end_to_end():
+    """Tiny tagging model: NLL decreases and grads flow through the scan."""
+    rng = np.random.RandomState(0)
+    B, T, D, F = 4, 5, 3, 8
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data(name="feat", shape=[B, T, F], dtype="float32",
+                                 append_batch_size=False)
+        feat._len_name = "lens"
+        main.global_block().create_var(name="lens", shape=(B,), dtype="int64")
+        lb = fluid.layers.data(name="lb", shape=[B, T, 1], dtype="int64",
+                               append_batch_size=False)
+        em = fluid.layers.fc(feat, size=D, num_flatten_dims=2)
+        em._len_name = "lens"
+        crf = fluid.layers.linear_chain_crf(
+            em, lb, param_attr=fluid.ParamAttr(name="crfw3"))
+        loss = fluid.layers.mean(crf)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    feats = rng.randn(B, T, F).astype("float32")
+    labels = rng.randint(0, D, (B, T, 1)).astype("int64")
+    lens = np.array([5, 3, 4, 5], np.int64)
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(15):
+            (lv,) = exe.run(
+                main, feed={"feat": feats, "lb": labels, "lens": lens},
+                fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+
+def _ctc_brute_force(logp, label, blank):
+    """Sum probability over all T-length paths collapsing to label."""
+    T, C = logp.shape
+
+    def collapse(path):
+        out, prev = [], None
+        for s in path:
+            if s != prev and s != blank:
+                out.append(s)
+            prev = s
+        return tuple(out)
+
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(label):
+            total = np.logaddexp(total, sum(logp[t, path[t]] for t in range(T)))
+    return -total
+
+
+def test_warpctc_matches_brute_force():
+    rng = np.random.RandomState(3)
+    T, C = 4, 3
+    logits = rng.randn(1, T, C).astype("float32")
+    label = np.array([[[1], [2]]], np.int64)  # [1, 2, 1]
+
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        lg = fluid.layers.data(name="lg", shape=[1, T, C], dtype="float32",
+                               append_batch_size=False)
+        lg._len_name = "lg_len"
+        main.global_block().create_var(name="lg_len", shape=(1,), dtype="int64")
+        lb = fluid.layers.data(name="lb", shape=[1, 2, 1], dtype="int64",
+                               append_batch_size=False)
+        lb._len_name = "lb_len"
+        main.global_block().create_var(name="lb_len", shape=(1,), dtype="int64")
+        loss = fluid.layers.warpctc(lg, lb, blank=0)
+    (lv,) = run_prog(
+        main, startup,
+        {"lg": logits, "lb": label,
+         "lg_len": np.array([T], np.int64), "lb_len": np.array([2], np.int64)},
+        [loss.name])
+    logp = logits[0] - np.log(np.exp(logits[0]).sum(1, keepdims=True))
+    want = _ctc_brute_force(logp, [1, 2], blank=0)
+    np.testing.assert_allclose(np.asarray(lv).reshape(()), want, rtol=1e-4)
+
+
+def test_ctc_greedy_decoder_collapses():
+    B, T, C = 2, 5, 4
+    probs = np.zeros((B, T, C), np.float32)
+    # row 0 argmax sequence: [1, 1, 0, 2, 2] -> [1, 2]
+    for t, c in enumerate([1, 1, 0, 2, 2]):
+        probs[0, t, c] = 1.0
+    # row 1 (len 3): [3, 0, 3] -> [3, 3]
+    for t, c in enumerate([3, 0, 3, 0, 0]):
+        probs[1, t, c] = 1.0
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[B, T, C], dtype="float32",
+                              append_batch_size=False)
+        x._len_name = "xl"
+        main.global_block().create_var(name="xl", shape=(B,), dtype="int64")
+        out = fluid.layers.ctc_greedy_decoder(x, blank=0)
+    (o,) = run_prog(main, startup,
+                    {"x": probs, "xl": np.array([5, 3], np.int64)}, [out.name])
+    o = np.asarray(o).reshape(B, T)
+    np.testing.assert_array_equal(o[0, :2], [1, 2])
+    assert (o[0, 2:] == 0).all()
+    np.testing.assert_array_equal(o[1, :2], [3, 3])
+
+
+# ---------------------------------------------------------------------------
+# sampled losses
+# ---------------------------------------------------------------------------
+
+
+def test_nce_trains():
+    rng = np.random.RandomState(1)
+    B, D, C = 8, 16, 50
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        cost = fluid.layers.nce(
+            input=x, label=y, num_total_classes=C, num_neg_samples=5,
+            sampler="log_uniform")
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    xs = rng.randn(B, D).astype("float32")
+    ys = rng.randint(0, C, (B, 1)).astype("int64")
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = [
+            float(np.asarray(exe.run(main, feed={"x": xs, "y": ys},
+                                     fetch_list=[loss.name])[0]).reshape(()))
+            for _ in range(60)
+        ]
+    # negatives are resampled every step, so compare windowed averages
+    assert np.mean(losses[-15:]) < np.mean(losses[:15])
+    assert np.isfinite(losses).all()
+
+
+def test_hsigmoid_matches_manual():
+    """C=4 complete tree: path of label l is the bits of l+4."""
+    rng = np.random.RandomState(2)
+    B, D, C = 3, 5, 4
+    x = rng.randn(B, D).astype("float32")
+    w = rng.randn(C - 1, D).astype("float32")
+    label = np.array([[0], [2], [3]], np.int64)
+
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[B, D], dtype="float32",
+                               append_batch_size=False)
+        yv = fluid.layers.data(name="y", shape=[B, 1], dtype="int64",
+                               append_batch_size=False)
+        cost = fluid.layers.hsigmoid(
+            xv, yv, C, param_attr=fluid.ParamAttr(name="hsw"), bias_attr=False)
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        scope.set_var("hsw", w)
+        (got,) = exe.run(main, feed={"x": x, "y": label}, fetch_list=[cost.name])
+    got = np.asarray(got).reshape(-1)
+
+    def softplus(v):
+        return np.log1p(np.exp(-abs(v))) + max(v, 0)
+
+    want = np.zeros(B)
+    for b in range(B):
+        c = int(label[b, 0]) + C
+        j = 0
+        while (c >> (j + 1)) > 0:
+            idx = (c >> (j + 1)) - 1
+            bit = (c >> j) & 1
+            t = float(x[b] @ w[idx])
+            want[b] += softplus(t) - bit * t
+            j += 1
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_hsigmoid_trains():
+    rng = np.random.RandomState(4)
+    B, D, C = 8, 10, 16
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        cost = fluid.layers.hsigmoid(x, y, C)
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(0.1).minimize(loss)
+    xs = rng.randn(B, D).astype("float32")
+    ys = rng.randint(0, C, (B, 1)).astype("int64")
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = [
+            float(np.asarray(exe.run(main, feed={"x": xs, "y": ys},
+                                     fetch_list=[loss.name])[0]).reshape(()))
+            for _ in range(15)
+        ]
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# ranking / misc losses (OpTest numeric checks)
+# ---------------------------------------------------------------------------
+
+
+class TestCosSim(OpTest):
+    def setUp(self):
+        self.op_type = "cos_sim"
+        x = np.random.rand(4, 6).astype("float32") + 0.1
+        y = np.random.rand(4, 6).astype("float32") + 0.1
+        xn = np.linalg.norm(x, axis=1, keepdims=True)
+        yn = np.linalg.norm(y, axis=1, keepdims=True)
+        out = (x * y).sum(1, keepdims=True) / (xn * yn)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out, "XNorm": xn, "YNorm": yn}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], max_relative_error=0.02,
+                        numeric_grad_delta=5e-3)
+
+
+class TestRankLoss(OpTest):
+    def setUp(self):
+        self.op_type = "rank_loss"
+        left = np.random.rand(5, 1).astype("float32")
+        right = np.random.rand(5, 1).astype("float32")
+        label = np.random.randint(0, 2, (5, 1)).astype("float32")
+        o = left - right
+        out = np.log1p(np.exp(o)) - label * o
+        self.inputs = {"Label": label, "Left": left, "Right": right}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["Left", "Right"], max_relative_error=0.02,
+                        numeric_grad_delta=5e-3)
+
+
+class TestMarginRankLoss(OpTest):
+    def setUp(self):
+        self.op_type = "margin_rank_loss"
+        x1 = np.random.rand(6, 1).astype("float32")
+        x2 = np.random.rand(6, 1).astype("float32")
+        label = np.where(np.random.rand(6, 1) > 0.5, 1.0, -1.0).astype("float32")
+        margin = 0.1
+        out = np.maximum(0.0, -label * (x1 - x2) + margin)
+        self.inputs = {"Label": label, "X1": x1, "X2": x2}
+        self.attrs = {"margin": margin}
+        self.outputs = {"Out": out, "Activated": (out > 0).astype("float32")}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestBprLoss(OpTest):
+    def setUp(self):
+        self.op_type = "bpr_loss"
+        B, C = 4, 5
+        x = np.random.rand(B, C).astype("float32")
+        label = np.random.randint(0, C, (B, 1)).astype("int64")
+        cost = np.zeros((B, 1), "float32")
+        for b in range(B):
+            pos = x[b, label[b, 0]]
+            s = sum(np.log1p(np.exp(x[b, j] - pos))
+                    for j in range(C) if j != label[b, 0])
+            cost[b, 0] = s / (C - 1)
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Cost": cost}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X"], max_relative_error=0.02, numeric_grad_delta=5e-3)
+
+
+class TestModifiedHuberLoss(OpTest):
+    def setUp(self):
+        self.op_type = "modified_huber_loss"
+        x = (np.random.rand(8, 1).astype("float32") - 0.5) * 4
+        y = np.random.randint(0, 2, (8, 1)).astype("float32")
+        z = (2 * y - 1) * x
+        out = np.where(z < -1, -4.0 * z, np.square(np.maximum(0, 1 - z)))
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out.astype("float32"), "IntermediateVal": z}
+
+    def test_output(self):
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# evaluation ops
+# ---------------------------------------------------------------------------
+
+
+def _levenshtein(a, b):
+    dp = np.arange(len(b) + 1, dtype=float)
+    for i, ca in enumerate(a, 1):
+        prev = dp.copy()
+        dp[0] = i
+        for j, cb in enumerate(b, 1):
+            dp[j] = min(prev[j] + 1, dp[j - 1] + 1, prev[j - 1] + (ca != cb))
+    return dp[len(b)]
+
+
+def test_edit_distance():
+    hyps = np.array([[[1], [2], [3], [0]], [[4], [4], [0], [0]]], np.int64)
+    refs = np.array([[[1], [3], [3]], [[4], [5], [6]]], np.int64)
+    hyp_len = np.array([3, 2], np.int64)
+    ref_len = np.array([3, 3], np.int64)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        h = fluid.layers.data(name="h", shape=[2, 4, 1], dtype="int64",
+                              append_batch_size=False)
+        h._len_name = "hl"
+        main.global_block().create_var(name="hl", shape=(2,), dtype="int64")
+        r = fluid.layers.data(name="r", shape=[2, 3, 1], dtype="int64",
+                              append_batch_size=False)
+        r._len_name = "rl"
+        main.global_block().create_var(name="rl", shape=(2,), dtype="int64")
+        dist, seq_num = fluid.layers.edit_distance(h, r, normalized=False)
+    (d, n) = run_prog(main, startup,
+                      {"h": hyps, "r": refs, "hl": hyp_len, "rl": ref_len},
+                      [dist.name, seq_num.name])
+    d = np.asarray(d).reshape(-1)
+    want = [
+        _levenshtein([1, 2, 3], [1, 3, 3]),
+        _levenshtein([4, 4], [4, 5, 6]),
+    ]
+    np.testing.assert_allclose(d, want)
+    assert np.asarray(n).reshape(())[()] == 2
+
+
+def test_precision_recall():
+    idx = np.array([[0], [1], [1], [2]], np.int64)
+    lbl = np.array([[0], [1], [2], [2]], np.int64)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        iv = fluid.layers.data(name="i", shape=[4, 1], dtype="int64",
+                               append_batch_size=False)
+        lv = fluid.layers.data(name="l", shape=[4, 1], dtype="int64",
+                               append_batch_size=False)
+        bm = main.global_block().create_var(name="bm", dtype="float32")
+        am = main.global_block().create_var(name="am", dtype="float32")
+        st = main.global_block().create_var(name="st", dtype="float32")
+        main.global_block().append_op(
+            type="precision_recall",
+            inputs={"Indices": ["i"], "Labels": ["l"]},
+            outputs={"BatchMetrics": ["bm"], "AccumMetrics": ["am"],
+                     "AccumStatesInfo": ["st"]},
+            attrs={"class_number": 3},
+        )
+    (bmv, stv) = run_prog(main, startup, {"i": idx, "l": lbl}, ["bm", "st"])
+    bmv, stv = np.asarray(bmv), np.asarray(stv)
+    # class 0: tp=1 fp=0 fn=0; class 1: tp=1 fp=1 fn=0; class 2: tp=1 fp=0 fn=1
+    np.testing.assert_allclose(stv[:, 0], [1, 1, 1])
+    np.testing.assert_allclose(stv[:, 1], [0, 1, 0])
+    np.testing.assert_allclose(stv[:, 3], [0, 0, 1])
+    macro_p = (1.0 + 0.5 + 1.0) / 3
+    macro_r = (1.0 + 1.0 + 0.5) / 3
+    np.testing.assert_allclose(bmv[0], macro_p, rtol=1e-5)
+    np.testing.assert_allclose(bmv[1], macro_r, rtol=1e-5)
+    # micro: tp=3, fp=1, fn=1
+    np.testing.assert_allclose(bmv[3], 3 / 4, rtol=1e-5)
+    np.testing.assert_allclose(bmv[4], 3 / 4, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# proximal optimizers + ModelAverage
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("opt_cls", ["ProximalGD", "ProximalAdagrad"])
+def test_proximal_optimizers_train(opt_cls):
+    rng = np.random.RandomState(0)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        getattr(fluid.optimizer, opt_cls)(0.05, l1=1e-4, l2=1e-4).minimize(loss)
+    w = rng.randn(4, 1).astype("float32")
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(25):
+            xs = rng.randn(16, 4).astype("float32")
+            (lv,) = exe.run(main, feed={"x": xs, "y": xs @ w},
+                            fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0] * 0.6
+
+
+def test_model_average_apply_restore():
+    rng = np.random.RandomState(0)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="w_ma"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(0.5, min_average_window=2,
+                                          max_average_window=4)
+    w = rng.randn(4, 1).astype("float32")
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        seen = []
+        for _ in range(5):
+            xs = rng.randn(8, 4).astype("float32")
+            exe.run(main, feed={"x": xs, "y": xs @ w}, fetch_list=[loss.name])
+            seen.append(np.asarray(scope.find_var("w_ma")).copy())
+        live = np.asarray(scope.find_var("w_ma")).copy()
+        with ma.apply(exe):
+            avg = np.asarray(scope.find_var("w_ma")).copy()
+            # the averaged weights differ from the live ones and are a mean of
+            # recently-seen values (within their range)
+            assert not np.allclose(avg, live)
+            stacked = np.stack(seen)
+            assert (avg >= stacked.min(0) - 1e-6).all()
+            assert (avg <= stacked.max(0) + 1e-6).all()
+        restored = np.asarray(scope.find_var("w_ma"))
+        np.testing.assert_allclose(restored, live)
