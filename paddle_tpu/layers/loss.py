@@ -112,20 +112,18 @@ def ctc_greedy_decoder(input, blank, name=None):
     layers/nn.py ctc_greedy_decoder = topk + ctc_align_op)."""
     from .nn import topk
 
+    from .sequence import _new_len_var
+
     helper = LayerHelper("ctc_greedy_decoder", **locals())
     _, ids = topk(input, k=1)
     out = helper.create_variable_for_type_inference("int64")
-    out_len_name = out.name + "@LEN"
-    helper.main_program.current_block().create_var(
-        name=out_len_name, shape=(-1,), dtype="int32"
-    )
+    out_len_name = _new_len_var(helper, out)
     helper.append_op(
         type="ctc_align",
         inputs={"Input": [ids.name], "SeqLen": [seq_len_of(input)]},
         outputs={"Output": [out.name], "OutLen": [out_len_name]},
         attrs={"blank": blank, "padding_value": 0},
     )
-    out._len_name = out_len_name
     out.stop_gradient = True
     return out
 
@@ -147,6 +145,8 @@ def nce(
     """Noise-contrastive estimation (reference layers/nn.py nce → nce_op.cc)."""
     if custom_dist is not None:
         raise NotImplementedError("nce custom_dist sampler is not supported")
+    if sample_weight is not None:
+        raise NotImplementedError("nce sample_weight is not supported")
     helper = LayerHelper("nce", **locals())
     dim = input.shape[-1]
     num_neg_samples = int(num_neg_samples or 10)

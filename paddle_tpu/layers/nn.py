@@ -72,6 +72,8 @@ __all__ = [
     "autoincreased_step_counter",
     "ring_attention",
     "distributed_embedding",
+    "beam_search",
+    "beam_search_decode",
 ]
 
 
@@ -1114,3 +1116,81 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
         counter._step_counter_initialized = True
         counter.stop_gradient = True
     return counter
+
+
+def beam_search(
+    pre_ids,
+    pre_scores,
+    ids,
+    scores,
+    beam_size,
+    end_id,
+    level=0,
+    name=None,
+    return_parent_idx=False,
+):
+    """One beam-search expansion step (reference layers/nn.py beam_search →
+    beam_search_op.cc). Dense [batch*beam] layout: instead of the reference's
+    LoD-encoded parentage this also produces a flat parent_idx tensor —
+    gather decoder state with it each step (selected_ids._parent_idx holds
+    the Variable when return_parent_idx is False).
+
+    First step: initialize pre_scores as [0, -inf, ..., -inf] per source so
+    identical initial beams don't crowd the beam (see decode_ops.py)."""
+    helper = LayerHelper("beam_search", **locals())
+    selected_ids = helper.create_variable_for_type_inference("int64")
+    selected_scores = helper.create_variable_for_type_inference("float32")
+    parent_idx = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="beam_search",
+        inputs={
+            "pre_ids": [pre_ids.name],
+            "pre_scores": [pre_scores.name],
+            "ids": [ids.name],
+            "scores": [scores.name],
+        },
+        outputs={
+            "selected_ids": [selected_ids.name],
+            "selected_scores": [selected_scores.name],
+            "parent_idx": [parent_idx.name],
+        },
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level},
+    )
+    selected_ids.stop_gradient = True
+    selected_scores.stop_gradient = True
+    parent_idx.stop_gradient = True
+    selected_ids._parent_idx = parent_idx
+    if return_parent_idx:
+        return selected_ids, selected_scores, parent_idx
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None, parents=None):
+    """Backtrack per-step beam selections into full hypotheses (reference
+    layers/nn.py beam_search_decode → beam_search_decode_op.cc). `ids` and
+    `scores` are tensor arrays written once per step; pass the parents array
+    (of beam_search parent_idx writes) to follow beam reordering. Returns
+    (sentence_ids [B, beam, T] best-first, sentence_scores [B, beam]); the
+    ids Variable carries per-hypothesis lengths in ._hyp_len."""
+    helper = LayerHelper("beam_search_decode", **locals())
+    sentence_ids = helper.create_variable_for_type_inference("int64")
+    sentence_scores = helper.create_variable_for_type_inference("float32")
+    hyp_len = helper.create_variable_for_type_inference("int32")
+    inputs = {"Ids": [ids.name], "Scores": [scores.name]}
+    if parents is not None:
+        inputs["Parents"] = [parents.name]
+    helper.append_op(
+        type="beam_search_decode",
+        inputs=inputs,
+        outputs={
+            "SentenceIds": [sentence_ids.name],
+            "SentenceScores": [sentence_scores.name],
+            "SentenceLength": [hyp_len.name],
+        },
+        attrs={"beam_size": beam_size, "end_id": end_id},
+    )
+    sentence_ids.stop_gradient = True
+    sentence_scores.stop_gradient = True
+    hyp_len.stop_gradient = True
+    sentence_ids._hyp_len = hyp_len
+    return sentence_ids, sentence_scores

@@ -20,6 +20,18 @@ __all__ = [
     "sequence_last_step",
     "sequence_reverse",
     "sequence_expand",
+    "sequence_expand_as",
+    "sequence_pad",
+    "sequence_unpad",
+    "sequence_mask",
+    "sequence_concat",
+    "sequence_slice",
+    "sequence_erase",
+    "sequence_reshape",
+    "sequence_scatter",
+    "sequence_enumerate",
+    "im2sequence",
+    "row_conv",
 ]
 
 
@@ -260,3 +272,234 @@ def sequence_expand(x, y, ref_level=-1, name=None):
         attrs={"ref_level": ref_level},
     )
     return _propagate(out, y)
+
+
+def _new_len_var(helper, out):
+    """Create the `<out>@LEN` companion var (before the op that writes it is
+    appended, so shape inference can resolve it) and attach it."""
+    len_name = out.name + "@LEN"
+    helper.main_program.current_block().create_var(
+        name=len_name, shape=(-1,), dtype="int32"
+    )
+    out._len_name = len_name
+    return len_name
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """reference layers/nn.py sequence_pad → sequence_pad_op.cc. Returns
+    (padded, lengths); the padded-dense rep makes this mostly a pad-value
+    fill plus optional capacity change."""
+    helper = LayerHelper("sequence_pad", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    # the op's Length output (clamped to the capacity) becomes the companion,
+    # NOT the input lengths — they diverge when maxlen truncates
+    len_name = _new_len_var(helper, out)
+    helper.append_op(
+        type="sequence_pad",
+        inputs={
+            "X": [x.name],
+            "PadValue": [pad_value.name],
+            "SeqLen": [seq_len_of(x)],
+        },
+        outputs={"Out": [out.name], "Length": [len_name]},
+        attrs={"padded_length": -1 if maxlen is None else int(maxlen)},
+    )
+    return out, helper.main_program.current_block().var(len_name)
+
+
+def sequence_unpad(x, length, name=None):
+    """reference layers/nn.py sequence_unpad → sequence_unpad_op.cc; output
+    carries `length` as its ragged companion."""
+    helper = LayerHelper("sequence_unpad", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_unpad",
+        inputs={"X": [x.name], "Length": [length.name]},
+        outputs={"Out": [out.name]},
+    )
+    out._len_name = length.name
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """reference layers/nn.py sequence_mask → sequence_mask_op.cc. maxlen is
+    required (static shapes under XLA)."""
+    if maxlen is None:
+        raise ValueError("sequence_mask requires maxlen under the XLA lowering")
+    helper = LayerHelper("sequence_mask", **locals())
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="sequence_mask",
+        inputs={"X": [x.name]},
+        outputs={"Y": [out.name]},
+        attrs={"maxlen": int(maxlen), "out_dtype": dtype},
+    )
+    out.stop_gradient = True
+    return out
+
+
+def sequence_concat(input, name=None):
+    """reference layers/nn.py sequence_concat → sequence_concat_op.cc:
+    per-row concatenation along time."""
+    helper = LayerHelper("sequence_concat", **locals())
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    len_name = _new_len_var(helper, out)
+    helper.append_op(
+        type="sequence_concat",
+        inputs={
+            "X": [v.name for v in input],
+            "SeqLen": [seq_len_of(v) for v in input],
+        },
+        outputs={"Out": [out.name], "OutLen": [len_name]},
+    )
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    """reference layers/nn.py sequence_expand_as → sequence_expand_as_op.cc."""
+    helper = LayerHelper("sequence_expand_as", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_expand_as",
+        inputs={"X": [x.name], "Y": [y.name], "SeqLen": [seq_len_of(y)]},
+        outputs={"Out": [out.name]},
+    )
+    out._len_name = seq_len_of(y)
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    """reference layers/nn.py sequence_slice → sequence_slice_op.h."""
+    helper = LayerHelper("sequence_slice", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    len_name = _new_len_var(helper, out)
+    helper.append_op(
+        type="sequence_slice",
+        inputs={
+            "X": [input.name],
+            "Offset": [offset.name],
+            "Length": [length.name],
+        },
+        outputs={"Out": [out.name], "OutLen": [len_name]},
+    )
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    """reference sequence_erase_op.cc: drop listed tokens, re-compact."""
+    helper = LayerHelper("sequence_erase", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    len_name = _new_len_var(helper, out)
+    helper.append_op(
+        type="sequence_erase",
+        inputs={"X": [input.name], "SeqLen": [seq_len_of(input)]},
+        outputs={"Out": [out.name], "OutLen": [len_name]},
+        attrs={"tokens": list(tokens)},
+    )
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    """reference sequence_reshape_op.cc."""
+    helper = LayerHelper("sequence_reshape", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    len_name = _new_len_var(helper, out)
+    helper.append_op(
+        type="sequence_reshape",
+        inputs={"X": [input.name], "SeqLen": [seq_len_of(input)]},
+        outputs={"Out": [out.name], "OutLen": [len_name]},
+        attrs={"new_dim": int(new_dim)},
+    )
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """reference sequence_scatter_op.cc."""
+    helper = LayerHelper("sequence_scatter", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_scatter",
+        inputs={
+            "X": [input.name],
+            "Ids": [index.name],
+            "Updates": [updates.name],
+            "SeqLen": [seq_len_of(index)],
+        },
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """reference sequence_enumerate_op.cc: sliding id windows."""
+    helper = LayerHelper("sequence_enumerate", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_enumerate",
+        inputs={"X": [input.name], "SeqLen": [seq_len_of(input)]},
+        outputs={"Out": [out.name]},
+        attrs={"win_size": int(win_size), "pad_value": int(pad_value)},
+    )
+    out._len_name = seq_len_of(input)
+    return out
+
+
+def im2sequence(
+    input,
+    filter_size=1,
+    stride=1,
+    padding=0,
+    input_image_size=None,
+    out_stride=1,
+    name=None,
+):
+    """Image → patch sequence (reference layers/nn.py im2sequence →
+    im2sequence_op.cc). Output rows all share length out_h*out_w, emitted as
+    a fill_constant_batch_size_like companion."""
+    from .tensor import fill_constant_batch_size_like
+
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    helper = LayerHelper("im2sequence", **locals())
+    kernels = _pair(filter_size)
+    strides = _pair(stride)
+    pads = padding if isinstance(padding, (list, tuple)) and len(padding) == 4 else _pair(padding) * 2
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="im2sequence",
+        inputs={"X": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={"kernels": kernels, "strides": strides, "paddings": list(pads)},
+    )
+    h, w = input.shape[2], input.shape[3]
+    oh = (h + pads[0] + pads[2] - kernels[0]) // strides[0] + 1
+    ow = (w + pads[1] + pads[3] - kernels[1]) // strides[1] + 1
+    lens = fill_constant_batch_size_like(
+        input, shape=[-1], dtype="int32", value=oh * ow
+    )
+    out._len_name = lens.name
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead convolution (reference layers/nn.py row_conv →
+    row_conv_op.cc)."""
+    helper = LayerHelper("row_conv", **locals())
+    dtype = helper.input_dtype()
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[future_context_size + 1, d], dtype=dtype
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="row_conv",
+        inputs={
+            "X": [input.name],
+            "Filter": [w.name],
+            "SeqLen": [seq_len_of(input)],
+        },
+        outputs={"Out": [out.name]},
+    )
+    out._len_name = seq_len_of(input)
+    return helper.append_activation(out)

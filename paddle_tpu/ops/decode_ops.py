@@ -1,0 +1,133 @@
+"""Beam-search decode ops.
+
+Reference analogs: paddle/fluid/operators/beam_search_op.cc (select beam_size
+best candidates per source from each beam's top-K expansions, retiring beams
+that emit end_id) and beam_search_decode_op.cc (walk the per-step selection
+arrays backward to reconstruct full hypotheses).
+
+TPU-first redesign: the reference threads parentage through LoD levels on
+CPU-side tensors; here beams live in a dense [batch*beam, ...] layout and
+`beam_search` emits an explicit ParentIdx tensor (flat indices into the
+batch*beam axis). Callers gather their decoder state with it each step and
+write ids/scores/parents into tensor arrays; `beam_search_decode` backtracks
+those arrays inside the same XLA computation — no host round-trips in the
+decode loop.
+
+First-step convention: all beams of a source start identical, so initialize
+pre_scores to [0, -inf, -inf, ...] per source (kInitialScore trick, matching
+the reference's single-active-beam initial LoD).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _noop_infer(op, block):
+    """Tensor-array inputs are (buffer, size) pairs that flat var metadata
+    cannot describe; output shapes come from the first trace."""
+    return None
+
+NEG_INF = -1e9
+
+
+@register("beam_search", no_grad=True)
+def _beam_search(ctx, ins, attrs):
+    (pre_ids,) = ins["pre_ids"]  # [N, 1] int
+    (pre_scores,) = ins["pre_scores"]  # [N, 1] float
+    (ids,) = ins["ids"]  # [N, K] int candidate tokens per beam
+    (scores,) = ins["scores"]  # [N, K] float ACCUMULATED scores
+    beam_size = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    n, k = ids.shape
+    b = n // beam_size
+
+    pre_id = pre_ids.reshape(n).astype(jnp.int32)
+    pre_score = pre_scores.reshape(n).astype(jnp.float32)
+    finished = pre_id == end_id
+
+    col = jnp.arange(k, dtype=jnp.int32)[None, :]
+    # a finished beam contributes exactly one candidate: (end_id, pre_score)
+    cand_scores = jnp.where(
+        finished[:, None],
+        jnp.where(col == 0, pre_score[:, None], NEG_INF),
+        scores.astype(jnp.float32),
+    )
+    cand_ids = jnp.where(finished[:, None], end_id, ids.astype(jnp.int32))
+
+    flat_scores = cand_scores.reshape(b, beam_size * k)
+    flat_ids = cand_ids.reshape(b, beam_size * k)
+    top_scores, top_idx = lax.top_k(flat_scores, beam_size)  # [B, beam]
+    sel_ids = jnp.take_along_axis(flat_ids, top_idx, axis=1)
+    parent_beam = top_idx // k
+    parent_global = parent_beam + jnp.arange(b, dtype=jnp.int32)[:, None] * beam_size
+
+    return {
+        "selected_ids": [sel_ids.reshape(n, 1).astype(jnp.int64)],
+        "selected_scores": [top_scores.reshape(n, 1)],
+        "parent_idx": [parent_global.reshape(n)],
+    }
+
+
+@register("beam_search_decode", no_grad=True, infer_shape=_noop_infer)
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack (ids, parents) step arrays into [B, beam, T] hypotheses,
+    best beam first per source."""
+    (ids_arr,) = ins["Ids"]  # tensor array: (buffer [T, N, 1], size)
+    (scores_arr,) = ins["Scores"]  # (buffer [T, N, 1], size)
+    parents_in = ins.get("Parents", [None])[0]  # (buffer [T, N], size) | None
+    beam_size = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+
+    ids_buf, size = ids_arr
+    scores_buf, _ = scores_arr
+    t_cap, n = ids_buf.shape[0], ids_buf.shape[1]
+    b = n // beam_size
+    ids_buf = ids_buf.reshape(t_cap, n).astype(jnp.int32)
+    scores_buf = scores_buf.reshape(t_cap, n).astype(jnp.float32)
+    if parents_in is None:
+        parents_buf = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int32)[None, :], (t_cap, n)
+        )
+    else:
+        parents_buf = parents_in[0].reshape(t_cap, n).astype(jnp.int32)
+
+    size = jnp.asarray(size, jnp.int32).reshape(())
+    t_idx = jnp.arange(t_cap, dtype=jnp.int32)
+
+    # walk backward from the last valid step; steps >= size pass through
+    def back(carry, sc):
+        beam_idx = carry  # [N] flat slot each output row currently tracks
+        t, step_ids, step_parents = sc
+        valid = t < size
+        tok = jnp.where(valid, step_ids[beam_idx], end_id)
+        nxt = jnp.where(valid, step_parents[beam_idx], beam_idx)
+        return nxt, tok
+
+    init = jnp.arange(n, dtype=jnp.int32)
+    _, toks = lax.scan(
+        back, init, (t_idx, ids_buf, parents_buf), reverse=True
+    )  # toks: [T, N]
+    seq = jnp.swapaxes(toks, 0, 1).reshape(b, beam_size, t_cap)
+
+    last = jnp.maximum(size - 1, 0)
+    final_scores = scores_buf[last].reshape(b, beam_size)
+
+    # rank beams best-first per source
+    order = jnp.argsort(-final_scores, axis=1)
+    seq = jnp.take_along_axis(seq, order[:, :, None], axis=1)
+    final_scores = jnp.take_along_axis(final_scores, order, axis=1)
+
+    # hypothesis length: position of first end_id (inclusive) among the VALID
+    # steps, else size (backtracking fills steps >= size with end_id)
+    is_end = (seq == end_id) & (t_idx[None, None, :] < size)
+    first_end = jnp.argmax(is_end, axis=2).astype(jnp.int32)
+    has_end = is_end.any(axis=2)
+    lens = jnp.where(has_end, first_end + 1, size)
+
+    return {
+        "SentenceIds": [seq.astype(jnp.int64)],
+        "SentenceScores": [final_scores],
+        "SentenceLength": [lens],
+    }

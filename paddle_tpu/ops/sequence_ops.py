@@ -281,3 +281,249 @@ def _gru_unit(ctx, ins, attrs):
     # reference gru_unit_op.h:116: h = u*(c - h_prev) + h_prev
     h_new = (1 - u) * h_prev + u * c
     return {"Hidden": [h_new], "ResetHiddenPrev": [r * h_prev], "Gate": [jnp.concatenate([u, r, c], -1)]}
+
+
+# ---------------------------------------------------------------------------
+# padding / reshaping / editing ops (reference sequence_ops/: sequence_pad_op,
+# sequence_unpad_op, sequence_mask_op, sequence_concat_op,
+# sequence_expand_as_op, sequence_slice_op, sequence_erase_op,
+# sequence_reshape_op, sequence_scatter_op, sequence_enumerate_op,
+# im2sequence_op.cc, row_conv_op.cc). In the padded-dense representation
+# several of these become masked gathers instead of LoD re-packing.
+# ---------------------------------------------------------------------------
+
+
+@register("sequence_pad")
+def _sequence_pad(ctx, ins, attrs):
+    """Already-padded rep: adjust capacity to padded_length and fill padding
+    with PadValue (reference sequence_pad_op.cc also emits Length)."""
+    (x,) = ins["X"]
+    (pad_value,) = ins["PadValue"]
+    (seqlen,) = ins["SeqLen"]
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    maxlen = int(attrs.get("padded_length", -1))
+    t = x.shape[1]
+    if maxlen > 0 and maxlen != t:
+        if maxlen < t:
+            x = x[:, :maxlen]
+            # rows longer than the new capacity are truncated; keep Length
+            # consistent with the data actually present (the reference op
+            # rejects padded_length < max length outright — lengths here are
+            # runtime values, so clamping is the static-shape equivalent)
+            lens = jnp.minimum(lens, maxlen)
+        else:
+            pad_shape = (x.shape[0], maxlen - t) + x.shape[2:]
+            x = jnp.concatenate([x, jnp.zeros(pad_shape, x.dtype)], axis=1)
+    t = x.shape[1]
+    m = (jnp.arange(t)[None, :] < lens[:, None])
+    mexp = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    out = jnp.where(mexp, x, pad_value.reshape((1,) * x.ndim).astype(x.dtype))
+    return {"Out": [out], "Length": [lens.astype(jnp.int64)]}
+
+
+@register("sequence_unpad")
+def _sequence_unpad(ctx, ins, attrs):
+    """Inverse of sequence_pad: zero out padding and re-attach Length as the
+    SeqLen companion (layer side)."""
+    (x,) = ins["X"]
+    (length,) = ins["Length"]
+    lens = length.reshape(-1).astype(jnp.int32)
+    return {"Out": [_masked(x, lens)]}
+
+
+@register("sequence_mask", no_grad=True)
+def _sequence_mask(ctx, ins, attrs):
+    (x,) = ins["X"]  # lengths
+    maxlen = int(attrs.get("maxlen", -1))
+    dtype = jnp.dtype(attrs.get("out_dtype", "int64"))
+    lens = x.reshape(-1).astype(jnp.int32)
+    if maxlen <= 0:
+        raise ValueError(
+            "sequence_mask requires a static maxlen in the XLA lowering"
+        )
+    m = jnp.arange(maxlen)[None, :] < lens[:, None]
+    return {"Y": [m.astype(dtype)]}
+
+
+@register("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    """Concatenate along time per row (reference sequence_concat_op.cc):
+    row b = x1[b,:l1] ++ x2[b,:l2] ++ ..., then padding."""
+    xs = ins["X"]
+    lens_list = [l.reshape(-1).astype(jnp.int32) for l in ins["SeqLen"]]
+    b = xs[0].shape[0]
+    t_out = sum(x.shape[1] for x in xs)
+    pos = jnp.arange(t_out, dtype=jnp.int32)[None, :]  # [1, T_out]
+    out = jnp.zeros((b, t_out) + xs[0].shape[2:], xs[0].dtype)
+    offset = jnp.zeros((b, 1), jnp.int32)
+    for x, lens in zip(xs, lens_list):
+        # positions [offset, offset+len) take x[pos - offset]
+        rel = pos - offset
+        inside = (rel >= 0) & (rel < lens[:, None])
+        src = jnp.clip(rel, 0, x.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1
+        )
+        sel = inside.reshape(inside.shape + (1,) * (x.ndim - 2))
+        out = jnp.where(sel, gathered, out)
+        offset = offset + lens[:, None]
+    return {"Out": [out], "OutLen": [offset.reshape(-1)]}
+
+
+@register("sequence_expand_as")
+def _sequence_expand_as(ctx, ins, attrs):
+    """Each row of X repeated along a time axis to Y's length (reference
+    sequence_expand_as_op.cc), padding-masked."""
+    (x,) = ins["X"]
+    (seqlen,) = ins["SeqLen"]  # lengths of Y
+    (y,) = ins["Y"]
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    t = y.shape[1]
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+    return {"Out": [_masked(out, lens)]}
+
+
+@register("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    """Per-row [offset, offset+length) slice (reference
+    sequence_slice_op.h), re-compacted to position 0 of each row."""
+    (x,) = ins["X"]
+    (offset,) = ins["Offset"]
+    (length,) = ins["Length"]
+    off = offset.reshape(-1).astype(jnp.int32)
+    ln = length.reshape(-1).astype(jnp.int32)
+    t = x.shape[1]
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    src = jnp.clip(pos + off[:, None], 0, t - 1)
+    gathered = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1
+    )
+    inside = pos < ln[:, None]
+    out = jnp.where(
+        inside.reshape(inside.shape + (1,) * (x.ndim - 2)),
+        gathered,
+        jnp.zeros((), x.dtype),
+    )
+    return {"Out": [out], "OutLen": [ln]}
+
+
+@register("sequence_erase")
+def _sequence_erase(ctx, ins, attrs):
+    """Drop listed tokens and re-compact each row (reference
+    sequence_erase_op.cc)."""
+    (x,) = ins["X"]  # [B, T] or [B, T, 1] int
+    (seqlen,) = ins["SeqLen"]
+    tokens = list(attrs.get("tokens", []))
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    squeeze = x.ndim == 3
+    v = x.reshape(x.shape[:2]) if squeeze else x
+    b, t = v.shape
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    keep = pos < lens[:, None]
+    for tok in tokens:
+        keep = keep & (v != tok)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compacted = jnp.take_along_axis(v, order, axis=1)
+    out_len = keep.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(pos < out_len[:, None], compacted, 0)
+    if squeeze:
+        out = out[:, :, None]
+    return {"Out": [out.astype(x.dtype)], "OutLen": [out_len]}
+
+
+@register("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    """Regroup each row's (len, d) payload as (len*d/new_dim, new_dim)
+    (reference sequence_reshape_op.cc; lengths must divide evenly)."""
+    (x,) = ins["X"]
+    (seqlen,) = ins["SeqLen"]
+    new_dim = int(attrs["new_dim"])
+    b, t, d = x.shape
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    xm = _masked(x, lens)
+    out = xm.reshape(b, t * d // new_dim, new_dim)
+    out_len = lens * d // new_dim
+    return {"Out": [out], "OutLen": [out_len]}
+
+
+@register("sequence_scatter")
+def _sequence_scatter(ctx, ins, attrs):
+    """out[b, ids[b, j]] += updates[b, j] for valid j (reference
+    sequence_scatter_op.cc)."""
+    (x,) = ins["X"]  # [B, N]
+    (ids,) = ins["Ids"]  # [B, L] or [B, L, 1]
+    (upd,) = ins["Updates"]  # same layout as ids
+    (seqlen,) = ins["SeqLen"]  # lengths of ids
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    b = x.shape[0]
+    iv = ids.reshape(b, -1).astype(jnp.int32)
+    uv = upd.reshape(b, -1).astype(x.dtype)
+    l = iv.shape[1]
+    valid = jnp.arange(l, dtype=jnp.int32)[None, :] < lens[:, None]
+    uv = jnp.where(valid, uv, 0.0)
+    iv = jnp.where(valid, iv, 0)
+    rows = jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[:, None], iv.shape)
+    return {"Out": [x.at[rows, iv].add(uv)]}
+
+
+@register("sequence_enumerate", no_grad=True)
+def _sequence_enumerate(ctx, ins, attrs):
+    """Sliding windows of ids (reference sequence_enumerate_op.cc): out[b,t]
+    = [x[b,t], ..., x[b,t+w-1]], pad_value past the row length."""
+    (x,) = ins["X"]  # [B, T] or [B, T, 1]
+    (seqlen,) = ins["SeqLen"]
+    win = int(attrs["win_size"])
+    pad = int(attrs.get("pad_value", 0))
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    squeeze = x.ndim == 3
+    v = x.reshape(x.shape[:2]) if squeeze else x
+    b, t = v.shape
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    cols = []
+    for k in range(win):
+        src = jnp.clip(pos + k, 0, t - 1)
+        g = jnp.take_along_axis(v, src, axis=1)
+        ok = (pos + k) < lens[:, None]
+        cols.append(jnp.where(ok, g, pad))
+    out = jnp.stack(cols, axis=2)  # [B, T, win]
+    valid = pos < lens[:, None]
+    out = jnp.where(valid[:, :, None], out, pad)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    """Image → patch sequence (reference im2sequence_op.cc): each output row
+    is the flattened kernel window, row-major over (out_h, out_w)."""
+    (x,) = ins["X"]  # [B, C, H, W]
+    kh, kw = [int(k) for k in attrs["kernels"]]
+    sh, sw = [int(s) for s in attrs.get("strides", [1, 1])]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0, 0, 0])]
+    patches = lax.conv_general_dilated_patches(
+        x,
+        filter_shape=(kh, kw),
+        window_strides=(sh, sw),
+        padding=[(pads[0], pads[2]), (pads[1], pads[3])],
+    )  # [B, C*kh*kw, OH, OW]
+    b, ckk, oh, ow = patches.shape
+    out = jnp.moveaxis(patches.reshape(b, ckk, oh * ow), 1, 2)
+    return {"Out": [out]}
+
+
+@register("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead (row) convolution (reference row_conv_op.cc):
+    out[b,t] = sum_{k<future_ctx} x[b,t+k] * filter[k]."""
+    (x,) = ins["X"]  # [B, T, D]
+    (w,) = ins["Filter"]  # [future_ctx, D]
+    (seqlen,) = ins["SeqLen"]
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    xm = _masked(x, lens)
+    t = x.shape[1]
+    out = jnp.zeros_like(xm)
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :, None]
+    for k in range(w.shape[0]):
+        shifted = jnp.roll(xm, -k, axis=1)
+        ok = (pos + k) < t
+        out = out + jnp.where(ok, shifted, 0.0) * w[k][None, None, :]
+    return {"Out": [_masked(out, lens)]}

@@ -1,0 +1,365 @@
+"""Tests for the padding/reshaping sequence ops and beam-search decode
+(reference unittests: test_sequence_pad_op.py, test_sequence_unpad_op.py,
+test_sequence_mask.py, test_sequence_concat.py, test_sequence_expand_as.py,
+test_sequence_slice_op.py, test_sequence_erase_op.py,
+test_sequence_reshape.py, test_sequence_scatter_op.py,
+test_sequence_enumerate_op.py, test_im2sequence_op.py, test_row_conv_op.py,
+test_beam_search_op.py, test_beam_search_decode_op.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _fresh():
+    return framework.Program(), framework.Program()
+
+
+def run_prog(main, startup, feed, fetch, seed=0):
+    scope = Scope(seed=seed)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def _seq_data(name, shape, dtype, main, lens_name):
+    v = fluid.layers.data(name=name, shape=shape, dtype=dtype,
+                          append_batch_size=False)
+    main.global_block().create_var(name=lens_name, shape=(shape[0],),
+                                   dtype="int64")
+    v._len_name = lens_name
+    return v
+
+
+def test_sequence_pad_unpad_roundtrip():
+    B, T, D = 3, 4, 2
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, T, D).astype("float32")
+    lens = np.array([4, 2, 3], np.int64)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = _seq_data("x", [B, T, D], "float32", main, "xl")
+        pad_v = fluid.layers.fill_constant([1], "float32", -1.0)
+        padded, length = fluid.layers.sequence_pad(xv, pad_v)
+        unpadded = fluid.layers.sequence_unpad(padded, length)
+    (p, l, u) = run_prog(main, startup, {"x": x, "xl": lens},
+                         [padded.name, length.name, unpadded.name])
+    p, u = np.asarray(p), np.asarray(u)
+    np.testing.assert_array_equal(np.asarray(l).reshape(-1), lens)
+    for b in range(B):
+        np.testing.assert_allclose(p[b, :lens[b]], x[b, :lens[b]])
+        assert (p[b, lens[b]:] == -1.0).all()
+        np.testing.assert_allclose(u[b, :lens[b]], x[b, :lens[b]])
+        assert (u[b, lens[b]:] == 0.0).all()
+
+
+def test_sequence_mask():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        lv = fluid.layers.data(name="l", shape=[3], dtype="int64",
+                               append_batch_size=False)
+        m = fluid.layers.sequence_mask(lv, maxlen=5, dtype="float32")
+    (mv,) = run_prog(main, startup, {"l": np.array([2, 5, 0], np.int64)},
+                     [m.name])
+    want = np.array([[1, 1, 0, 0, 0], [1, 1, 1, 1, 1], [0, 0, 0, 0, 0]],
+                    np.float32)
+    np.testing.assert_array_equal(np.asarray(mv), want)
+
+
+def test_sequence_concat():
+    B = 2
+    x1 = np.arange(8, dtype=np.float32).reshape(B, 4)[:, :, None] * 0 + \
+        np.arange(8, dtype=np.float32).reshape(B, 4, 1)
+    x2 = 100 + np.arange(6, dtype=np.float32).reshape(B, 3, 1)
+    l1 = np.array([2, 4], np.int64)
+    l2 = np.array([3, 1], np.int64)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        a = _seq_data("a", [B, 4, 1], "float32", main, "al")
+        b = _seq_data("b", [B, 3, 1], "float32", main, "bl")
+        c = fluid.layers.sequence_concat([a, b])
+        cl = main.global_block().var(c._len_name)
+    (cv, clv) = run_prog(main, startup,
+                         {"a": x1, "b": x2, "al": l1, "bl": l2},
+                         [c.name, c._len_name])
+    cv = np.asarray(cv).reshape(B, 7)
+    np.testing.assert_array_equal(np.asarray(clv).reshape(-1), [5, 5])
+    np.testing.assert_allclose(cv[0, :5], [0, 1, 100, 101, 102])
+    np.testing.assert_allclose(cv[1, :5], [4, 5, 6, 7, 103])
+    assert (cv[:, 5:] == 0).all()
+
+
+def test_sequence_expand_as():
+    B, D = 2, 3
+    x = np.arange(6, dtype=np.float32).reshape(B, D)
+    y = np.zeros((B, 4, 1), np.float32)
+    lens = np.array([3, 2], np.int64)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[B, D], dtype="float32",
+                               append_batch_size=False)
+        yv = _seq_data("y", [B, 4, 1], "float32", main, "yl")
+        out = fluid.layers.sequence_expand_as(xv, yv)
+    (ov,) = run_prog(main, startup, {"x": x, "y": y, "yl": lens}, [out.name])
+    ov = np.asarray(ov)
+    assert ov.shape == (B, 4, D)
+    np.testing.assert_allclose(ov[0, :3], np.tile(x[0], (3, 1)))
+    assert (ov[0, 3:] == 0).all()
+    np.testing.assert_allclose(ov[1, :2], np.tile(x[1], (2, 1)))
+
+
+def test_sequence_slice():
+    B, T, D = 2, 5, 2
+    x = np.arange(B * T * D, dtype=np.float32).reshape(B, T, D)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = _seq_data("x", [B, T, D], "float32", main, "xl")
+        off = fluid.layers.data(name="off", shape=[B, 1], dtype="int64",
+                                append_batch_size=False)
+        ln = fluid.layers.data(name="ln", shape=[B, 1], dtype="int64",
+                               append_batch_size=False)
+        out = fluid.layers.sequence_slice(xv, off, ln)
+    (ov, olv) = run_prog(
+        main, startup,
+        {"x": x, "xl": np.array([5, 4], np.int64),
+         "off": np.array([[1], [0]], np.int64),
+         "ln": np.array([[2], [3]], np.int64)},
+        [out.name, out._len_name])
+    ov = np.asarray(ov)
+    np.testing.assert_array_equal(np.asarray(olv).reshape(-1), [2, 3])
+    np.testing.assert_allclose(ov[0, :2], x[0, 1:3])
+    np.testing.assert_allclose(ov[1, :3], x[1, 0:3])
+    assert (ov[0, 2:] == 0).all()
+
+
+def test_sequence_erase():
+    B, T = 2, 6
+    x = np.array([[2, 1, 2, 3, 2, 0], [5, 2, 2, 6, 0, 0]], np.int64)[:, :, None]
+    lens = np.array([5, 4], np.int64)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = _seq_data("x", [B, T, 1], "int64", main, "xl")
+        out = fluid.layers.sequence_erase(xv, tokens=[2])
+    (ov, olv) = run_prog(main, startup, {"x": x, "xl": lens},
+                         [out.name, out._len_name])
+    ov = np.asarray(ov).reshape(B, T)
+    np.testing.assert_array_equal(np.asarray(olv).reshape(-1), [2, 2])
+    np.testing.assert_array_equal(ov[0, :2], [1, 3])
+    np.testing.assert_array_equal(ov[1, :2], [5, 6])
+
+
+def test_sequence_reshape():
+    B, T, D = 2, 4, 4
+    x = np.arange(B * T * D, dtype=np.float32).reshape(B, T, D)
+    lens = np.array([4, 2], np.int64)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = _seq_data("x", [B, T, D], "float32", main, "xl")
+        out = fluid.layers.sequence_reshape(xv, new_dim=2)
+    (ov, olv) = run_prog(main, startup, {"x": x, "xl": lens},
+                         [out.name, out._len_name])
+    ov = np.asarray(ov)
+    assert ov.shape == (B, 8, 2)
+    np.testing.assert_array_equal(np.asarray(olv).reshape(-1), [8, 4])
+    np.testing.assert_allclose(ov[0].reshape(-1), x[0].reshape(-1))
+    np.testing.assert_allclose(ov[1, :4].reshape(-1), x[1, :2].reshape(-1))
+
+
+def test_sequence_scatter():
+    B, N, L = 2, 6, 3
+    x = np.zeros((B, N), np.float32)
+    ids = np.array([[1, 3, 1], [0, 5, 0]], np.int64)[:, :, None]
+    upd = np.array([[1.0, 2.0, 4.0], [7.0, 8.0, 9.0]], np.float32)[:, :, None]
+    lens = np.array([3, 2], np.int64)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[B, N], dtype="float32",
+                               append_batch_size=False)
+        iv = _seq_data("i", [B, L, 1], "int64", main, "il")
+        uv = fluid.layers.data(name="u", shape=[B, L, 1], dtype="float32",
+                               append_batch_size=False)
+        out = fluid.layers.sequence_scatter(xv, iv, uv)
+    (ov,) = run_prog(main, startup,
+                     {"x": x, "i": ids, "u": upd, "il": lens}, [out.name])
+    ov = np.asarray(ov)
+    np.testing.assert_allclose(ov[0], [0, 5, 0, 2, 0, 0])  # 1+4 at idx 1
+    np.testing.assert_allclose(ov[1], [7, 0, 0, 0, 0, 8])  # third update masked
+
+
+def test_sequence_enumerate():
+    B, T = 2, 4
+    x = np.array([[1, 2, 3, 4], [5, 6, 7, 0]], np.int64)
+    lens = np.array([4, 3], np.int64)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = _seq_data("x", [B, T], "int64", main, "xl")
+        out = fluid.layers.sequence_enumerate(xv, win_size=2, pad_value=0)
+    (ov,) = run_prog(main, startup, {"x": x, "xl": lens}, [out.name])
+    ov = np.asarray(ov)
+    np.testing.assert_array_equal(
+        ov[0], [[1, 2], [2, 3], [3, 4], [4, 0]])
+    np.testing.assert_array_equal(
+        ov[1], [[5, 6], [6, 7], [7, 0], [0, 0]])
+
+
+def test_im2sequence():
+    B, C, H, W = 1, 1, 4, 4
+    x = np.arange(16, dtype=np.float32).reshape(B, C, H, W)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[B, C, H, W], dtype="float32",
+                               append_batch_size=False)
+        out = fluid.layers.im2sequence(xv, filter_size=2, stride=2)
+    (ov,) = run_prog(main, startup, {"x": x}, [out.name])
+    ov = np.asarray(ov)
+    assert ov.shape == (1, 4, 4)
+    np.testing.assert_allclose(ov[0, 0], [0, 1, 4, 5])
+    np.testing.assert_allclose(ov[0, 3], [10, 11, 14, 15])
+
+
+def test_row_conv():
+    B, T, D = 2, 5, 3
+    rng = np.random.RandomState(1)
+    x = rng.randn(B, T, D).astype("float32")
+    lens = np.array([5, 3], np.int64)
+    future = 2
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = _seq_data("x", [B, T, D], "float32", main, "xl")
+        out = fluid.layers.row_conv(
+            xv, future_context_size=future,
+            param_attr=fluid.ParamAttr(name="rc_w"))
+    w = rng.randn(future + 1, D).astype("float32")
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        scope.set_var("rc_w", w)
+        (ov,) = exe.run(main, feed={"x": x, "xl": lens}, fetch_list=[out.name])
+    ov = np.asarray(ov)
+    xm = x.copy()
+    xm[1, 3:] = 0
+    want = np.zeros_like(x)
+    for b in range(B):
+        for t in range(lens[b]):
+            for kk in range(future + 1):
+                if t + kk < T:
+                    want[b, t] += xm[b, t + kk] * w[kk]
+    np.testing.assert_allclose(ov, want, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+
+def test_beam_search_step():
+    """2 sources × beam 2, K=2 candidates; second source has a finished beam."""
+    pre_ids = np.array([[1], [2], [7], [3]], np.int64)  # beam 0 of src 1 ended
+    end_id = 7
+    pre_scores = np.array([[-1.0], [-2.0], [-0.5], [-3.0]], np.float32)
+    ids = np.array([[4, 5], [5, 6], [4, 5], [6, 4]], np.int64)
+    # accumulated candidate scores
+    scores = np.array(
+        [[-1.1, -1.9], [-2.2, -2.4], [-9.0, -9.0], [-3.1, -4.0]], np.float32)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        pi = fluid.layers.data(name="pi", shape=[4, 1], dtype="int64",
+                               append_batch_size=False)
+        ps = fluid.layers.data(name="ps", shape=[4, 1], dtype="float32",
+                               append_batch_size=False)
+        iv = fluid.layers.data(name="i", shape=[4, 2], dtype="int64",
+                               append_batch_size=False)
+        sv = fluid.layers.data(name="s", shape=[4, 2], dtype="float32",
+                               append_batch_size=False)
+        sel_ids, sel_scores, parent = fluid.layers.beam_search(
+            pi, ps, iv, sv, beam_size=2, end_id=end_id,
+            return_parent_idx=True)
+    (si, ss, pr) = run_prog(
+        main, startup, {"pi": pre_ids, "ps": pre_scores, "i": ids, "s": scores},
+        [sel_ids.name, sel_scores.name, parent.name])
+    si = np.asarray(si).reshape(-1)
+    ss = np.asarray(ss).reshape(-1)
+    pr = np.asarray(pr).reshape(-1)
+    # source 0: best two of {-1.1:4, -1.9:5 (beam0), -2.2:5, -2.4:6 (beam1)}
+    np.testing.assert_array_equal(si[:2], [4, 5])
+    np.testing.assert_allclose(ss[:2], [-1.1, -1.9])
+    np.testing.assert_array_equal(pr[:2], [0, 0])
+    # source 1: finished beam keeps (end_id, -0.5); then -3.1:6 from beam 3
+    np.testing.assert_array_equal(si[2:], [end_id, 6])
+    np.testing.assert_allclose(ss[2:], [-0.5, -3.1])
+    np.testing.assert_array_equal(pr[2:], [2, 3])
+
+
+def test_beam_search_full_decode_loop():
+    """Greedy-checkable decode: vocab transition scores force the sequence
+    [2, 3, 1] then end. While-loop with arrays + beam_search_decode."""
+    V, BEAM, B, MAXT = 5, 2, 1, 4
+    END = 4
+    # hand-built next-token log-probs by current token
+    trans = np.full((V, V), -10.0, np.float32)
+    trans[0, 2] = -0.1  # start(0) -> 2
+    trans[2, 3] = -0.2
+    trans[3, 1] = -0.3
+    trans[1, END] = -0.05
+    trans[END, END] = 0.0
+
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        table = fluid.layers.data(name="tr", shape=[V, V], dtype="float32",
+                                  append_batch_size=False)
+        n = B * BEAM
+        pre_ids = fluid.layers.fill_constant([n, 1], "int64", 0)
+        # kInitialScore trick: only beam 0 live at step 0
+        pre_scores = fluid.layers.fill_constant([n, 1], "float32", 0.0)
+        neg = fluid.layers.fill_constant([n, 1], "float32", -1e9)
+        beam_pos = fluid.layers.fill_constant([n, 1], "int64", 0)
+        # build [0, -inf] per source
+        import numpy as _np
+        init_mask = fluid.layers.assign(
+            _np.array([[0.0] if i % BEAM == 0 else [-1e9] for i in range(n)],
+                      _np.float32))
+        pre_scores = init_mask
+
+        ids_arr = fluid.layers.create_array("int64", shape=[MAXT, n, 1])
+        scores_arr = fluid.layers.create_array("float32", shape=[MAXT, n, 1])
+        parents_arr = fluid.layers.create_array("int32", shape=[MAXT, n])
+
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        tmax = fluid.layers.fill_constant([1], "int64", MAXT)
+        cond = fluid.layers.less_than(i, tmax)
+        w = fluid.layers.While(cond)
+        with w.block():
+            # candidate scores for each beam: trans[pre_id] + pre_score
+            flat_pre = fluid.layers.reshape(pre_ids, [n])
+            cand = fluid.layers.gather(table, flat_pre)  # [n, V]
+            acc = fluid.layers.elementwise_add(
+                cand, fluid.layers.reshape(pre_scores, [n, 1]))
+            topk_scores, topk_idx = fluid.layers.topk(acc, k=BEAM)
+            sel_ids, sel_scores, parent = fluid.layers.beam_search(
+                pre_ids, pre_scores, topk_idx, topk_scores,
+                beam_size=BEAM, end_id=END, return_parent_idx=True)
+            fluid.layers.array_write(sel_ids, i, array=ids_arr)
+            fluid.layers.array_write(sel_scores, i, array=scores_arr)
+            fluid.layers.array_write(parent, i, array=parents_arr)
+            fluid.layers.assign(sel_ids, pre_ids)
+            fluid.layers.assign(sel_scores, pre_scores)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, tmax, cond=cond)
+
+        sent_ids, sent_scores = fluid.layers.beam_search_decode(
+            ids_arr, scores_arr, beam_size=BEAM, end_id=END,
+            parents=parents_arr)
+    (siv, ssv, hl) = run_prog(
+        main, startup, {"tr": trans},
+        [sent_ids.name, sent_scores.name, sent_ids._hyp_len.name])
+    siv = np.asarray(siv).reshape(B, BEAM, MAXT)
+    hl = np.asarray(hl).reshape(B, BEAM)
+    # best hypothesis: 2, 3, 1, END
+    np.testing.assert_array_equal(siv[0, 0], [2, 3, 1, END])
+    assert hl[0, 0] == 4
+    best = np.asarray(ssv).reshape(B, BEAM)[0, 0]
+    np.testing.assert_allclose(best, -0.65, atol=1e-5)
