@@ -36,6 +36,7 @@ _UNARY_OPS = [
     "hard_shrink",
     "cumsum",
     "sign",
+    "log_softmax",
 ]
 
 __all__ = list(_UNARY_OPS) + ["uniform_random", "gaussian_random"]

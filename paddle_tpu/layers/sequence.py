@@ -456,11 +456,14 @@ def im2sequence(
     """Image → patch sequence (reference layers/nn.py im2sequence →
     im2sequence_op.cc). Output rows all share length out_h*out_w, emitted as
     a fill_constant_batch_size_like companion."""
+    from .nn import _pair
     from .tensor import fill_constant_batch_size_like
 
-    def _pair(v):
-        return [v, v] if isinstance(v, int) else list(v)
-
+    if input_image_size is not None or out_stride != 1:
+        raise NotImplementedError(
+            "im2sequence per-image real sizes (input_image_size/out_stride) "
+            "are not supported; patch geometry is static under XLA"
+        )
     helper = LayerHelper("im2sequence", **locals())
     kernels = _pair(filter_size)
     strides = _pair(stride)

@@ -317,8 +317,14 @@ def _sequence_pad(ctx, ins, attrs):
     t = x.shape[1]
     m = (jnp.arange(t)[None, :] < lens[:, None])
     mexp = m.reshape(m.shape + (1,) * (x.ndim - 2))
-    out = jnp.where(mexp, x, pad_value.reshape((1,) * x.ndim).astype(x.dtype))
-    return {"Out": [out], "Length": [lens.astype(jnp.int64)]}
+    # PadValue: scalar, or feature-shaped (broadcast over batch and time) —
+    # reference sequence_pad_op.cc accepts both
+    if pad_value.size == 1:
+        pv = pad_value.reshape((1,) * x.ndim)
+    else:
+        pv = pad_value.reshape((1, 1) + tuple(pad_value.shape))
+    out = jnp.where(mexp, x, pv.astype(x.dtype))
+    return {"Out": [out], "Length": [lens]}
 
 
 @register("sequence_unpad")

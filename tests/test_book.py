@@ -1,0 +1,209 @@
+"""Book-suite end-to-end tests (reference tests/book/: fit_a_line,
+word2vec, understand_sentiment, label_semantic_roles). Each trains a few
+iterations on synthetic data, asserts the loss falls, and — following the
+reference template — round-trips save/load_inference_model where it applies.
+(recognize_digits ≈ tests/test_mnist.py; machine_translation has its own
+file; image_classification ≈ the resnet/vgg model-zoo tests.)"""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _fresh():
+    return framework.Program(), framework.Program()
+
+
+def test_fit_a_line_with_inference_roundtrip():
+    """reference tests/book/test_fit_a_line.py: linear regression, save the
+    inference model, reload it, same predictions."""
+    rng = np.random.RandomState(0)
+    main, startup = _fresh()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+
+    w = rng.randn(13, 1).astype("float32")
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(50):
+            xs = rng.randn(32, 13).astype("float32")
+            (lv,) = exe.run(main, feed={"x": xs, "y": xs @ w},
+                            fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(())))
+        assert losses[-1] < losses[0] * 0.2
+
+        xs = rng.randn(4, 13).astype("float32")
+        infer = fluid.io.get_inference_program([pred], main_program=main)
+        (want,) = exe.run(infer, feed={"x": xs}, fetch_list=[pred.name])
+
+        with tempfile.TemporaryDirectory() as d:
+            fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                          main_program=main)
+            scope2 = Scope(seed=1)
+            with scope_guard(scope2):
+                prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+                (got,) = exe.run(prog, feed={feeds[0]: xs},
+                                 fetch_list=[f.name for f in fetches])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+def test_word2vec_nce_and_hsigmoid():
+    """reference tests/book/test_word2vec.py (N-gram LM); trained twice, with
+    the NCE head and the hsigmoid head."""
+    rng = np.random.RandomState(3)
+    V, E, N, B = 40, 16, 4, 32
+
+    def build(head):
+        main, startup = _fresh()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            words = [
+                fluid.layers.data(name="w%d" % i, shape=[1], dtype="int64")
+                for i in range(N)
+            ]
+            target = fluid.layers.data(name="t", shape=[1], dtype="int64")
+            embs = [
+                fluid.layers.embedding(
+                    w, size=[V, E], param_attr=fluid.ParamAttr(name="emb"))
+                for w in words
+            ]
+            concat = fluid.layers.concat(embs, axis=1)
+            hidden = fluid.layers.fc(concat, size=32, act="relu")
+            if head == "nce":
+                cost = fluid.layers.nce(hidden, target, num_total_classes=V,
+                                        num_neg_samples=8)
+            else:
+                cost = fluid.layers.hsigmoid(hidden, target, num_classes=V)
+            loss = fluid.layers.mean(cost)
+            fluid.optimizer.Adam(0.02).minimize(loss)
+        return main, startup, loss
+
+    # synthetic corpus: target deterministically follows the context
+    ws = rng.randint(0, V, (B, N)).astype("int64")
+    t = ((ws.sum(1) * 7 + 3) % V).astype("int64")
+    feed = {"w%d" % i: ws[:, i:i + 1] for i in range(N)}
+    feed["t"] = t[:, None]
+
+    for head in ("nce", "hsigmoid"):
+        main, startup, loss = build(head)
+        scope = Scope(seed=0)
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            losses = [
+                float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss.name])[0]).reshape(()))
+                for _ in range(60)
+            ]
+        assert np.isfinite(losses).all(), head
+        assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.7, (
+            head, losses[:3], losses[-3:])
+
+
+def test_understand_sentiment_conv():
+    """reference tests/book/test_understand_sentiment.py convolution net:
+    embedding → parallel sequence_conv_pool windows → softmax."""
+    from paddle_tpu.nets import sequence_conv_pool
+
+    rng = np.random.RandomState(5)
+    V, B, T = 30, 16, 12
+    main, startup = _fresh()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="words", shape=[B, T, 1], dtype="int64",
+                                  append_batch_size=False)
+        main.global_block().create_var(name="wlen", shape=(B,), dtype="int64")
+        words._len_name = "wlen"
+        label = fluid.layers.data(name="label", shape=[B, 1], dtype="int64",
+                                  append_batch_size=False)
+        emb = fluid.layers.embedding(words, size=[V, 24])
+        emb._len_name = "wlen"
+        conv3 = sequence_conv_pool(emb, num_filters=16, filter_size=3,
+                                   act="tanh", pool_type="max")
+        conv4 = sequence_conv_pool(emb, num_filters=16, filter_size=4,
+                                   act="tanh", pool_type="max")
+        logits = fluid.layers.fc([conv3, conv4], size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    # sentiment = whether token 7 appears
+    ws = rng.randint(0, V, (B, T, 1)).astype("int64")
+    lens = rng.randint(5, T + 1, (B,)).astype("int64")
+    lab = np.zeros((B, 1), np.int64)
+    for b in range(B):
+        ws[b, lens[b]:] = 0
+        lab[b, 0] = int((ws[b, :lens[b], 0] == 7).any())
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        vals = [
+            exe.run(main, feed={"words": ws, "wlen": lens, "label": lab},
+                    fetch_list=[loss.name, acc.name])
+            for _ in range(40)
+        ]
+    losses = [float(np.asarray(v[0]).reshape(())) for v in vals]
+    accs = [float(np.asarray(v[1]).reshape(())) for v in vals]
+    assert losses[-1] < losses[0] * 0.5
+    assert accs[-1] >= 0.9
+
+
+def test_label_semantic_roles_crf():
+    """reference tests/book/test_label_semantic_roles.py, reduced: embedding →
+    bi-GRU → CRF; decodes with the trained transition after training."""
+    rng = np.random.RandomState(11)
+    V, B, T, TAGS = 25, 8, 7, 5
+    main, startup = _fresh()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="words", shape=[B, T, 1], dtype="int64",
+                                  append_batch_size=False)
+        main.global_block().create_var(name="wlen", shape=(B,), dtype="int64")
+        words._len_name = "wlen"
+        tags = fluid.layers.data(name="tags", shape=[B, T, 1], dtype="int64",
+                                 append_batch_size=False)
+        emb = fluid.layers.embedding(words, size=[V, 16])
+        emb._len_name = "wlen"
+        proj = fluid.layers.fc(emb, size=24 * 3, num_flatten_dims=2)
+        proj._len_name = "wlen"
+        gru = fluid.layers.dynamic_gru(proj, size=24)
+        emission = fluid.layers.fc(gru, size=TAGS, num_flatten_dims=2)
+        emission._len_name = "wlen"
+        crf_cost = fluid.layers.linear_chain_crf(
+            emission, tags, param_attr=fluid.ParamAttr(name="crfw"))
+        loss = fluid.layers.mean(crf_cost)
+        decode = fluid.layers.crf_decoding(emission, param_attr="crfw")
+        fluid.optimizer.Adam(0.02).minimize(loss)
+
+    ws = rng.randint(0, V, (B, T, 1)).astype("int64")
+    tg = (ws % TAGS).astype("int64")  # tag deterministic from word
+    lens = rng.randint(3, T + 1, (B,)).astype("int64")
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(80):
+            (lv,) = exe.run(
+                main, feed={"words": ws, "tags": tg, "wlen": lens},
+                fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(())))
+        (dv,) = exe.run(main, feed={"words": ws, "tags": tg, "wlen": lens},
+                        fetch_list=[decode.name])
+    assert losses[-1] < losses[0] * 0.3
+    dv = np.asarray(dv).reshape(B, T)
+    acc = np.mean([
+        np.mean(dv[b, :lens[b]] == tg[b, :lens[b], 0]) for b in range(B)
+    ])
+    assert acc > 0.9, acc

@@ -1,0 +1,103 @@
+"""Book-style machine-translation test (reference
+tests/book/test_machine_translation.py): train the attention seq2seq on a
+synthetic copy task until the loss falls, then run beam-search inference
+sharing the trained parameters and check the decoded output."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.models import machine_translation as mt
+
+VOCAB = 12
+T = 5
+B = 8
+START, END = 0, 1
+
+
+def _make_batch(rng):
+    """copy task: trg = <s> src, label = src </s>"""
+    lens = rng.randint(2, T + 1, (B,))
+    src = np.zeros((B, T, 1), np.int64)
+    trg = np.zeros((B, T + 1, 1), np.int64)
+    lab = np.zeros((B, T + 1, 1), np.int64)
+    for b in range(B):
+        toks = rng.randint(2, VOCAB, (lens[b],))
+        src[b, :lens[b], 0] = toks
+        trg[b, 0, 0] = START
+        trg[b, 1:lens[b] + 1, 0] = toks
+        lab[b, :lens[b], 0] = toks
+        lab[b, lens[b], 0] = END
+    return src, trg, lab, lens.astype(np.int64), (lens + 1).astype(np.int64)
+
+
+def _build_train():
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        src = fluid.layers.data(name="src", shape=[B, T, 1], dtype="int64",
+                                append_batch_size=False)
+        main.global_block().create_var(name="src_len", shape=(B,), dtype="int64")
+        src._len_name = "src_len"
+        trg = fluid.layers.data(name="trg", shape=[B, T + 1, 1], dtype="int64",
+                                append_batch_size=False)
+        lab = fluid.layers.data(name="lab", shape=[B, T + 1, 1], dtype="int64",
+                                append_batch_size=False)
+        trg_len = fluid.layers.data(name="trg_len", shape=[B], dtype="int64",
+                                    append_batch_size=False)
+        loss = mt.train_model(src, trg, lab, trg_len, VOCAB)
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _build_infer(beam_size=3):
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        src = fluid.layers.data(name="src", shape=[B, T, 1], dtype="int64",
+                                append_batch_size=False)
+        main.global_block().create_var(name="src_len", shape=(B,), dtype="int64")
+        src._len_name = "src_len"
+        ids, scores = mt.infer_model(
+            src, VOCAB, beam_size=beam_size, max_out_len=T + 1,
+            start_id=START, end_id=END)
+    return main, ids, scores
+
+
+def test_machine_translation_train_and_beam_decode():
+    rng = np.random.RandomState(7)
+    train_main, startup, loss = _build_train()
+    infer_main, ids, scores = _build_infer()
+
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        src, trg, lab, src_len, trg_len = _make_batch(rng)
+        losses = []
+        for _ in range(150):
+            (lv,) = exe.run(
+                train_main,
+                feed={"src": src, "trg": trg, "lab": lab,
+                      "src_len": src_len, "trg_len": trg_len},
+                fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(())))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+        # beam decode the training batch (memorized copy task)
+        (si, ss, hl) = exe.run(
+            infer_main, feed={"src": src, "src_len": src_len},
+            fetch_list=[ids.name, scores.name, ids._hyp_len.name])
+    si = np.asarray(si)  # [B, beam, T+1]
+    hl = np.asarray(hl)
+    assert si.shape[:2] == (B, 3)
+    assert np.isfinite(np.asarray(ss)).all()
+    # top hypothesis of each source reproduces the source tokens
+    correct = 0
+    for b in range(B):
+        want = list(src[b, :src_len[b], 0]) + [END]
+        got = list(si[b, 0, :hl[b, 0]])
+        if got == want:
+            correct += 1
+    assert correct >= B // 2, "only %d/%d copied correctly\n%s" % (
+        correct, B, si[:, 0])

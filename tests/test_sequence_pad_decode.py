@@ -314,15 +314,9 @@ def test_beam_search_full_decode_loop():
         n = B * BEAM
         pre_ids = fluid.layers.fill_constant([n, 1], "int64", 0)
         # kInitialScore trick: only beam 0 live at step 0
-        pre_scores = fluid.layers.fill_constant([n, 1], "float32", 0.0)
-        neg = fluid.layers.fill_constant([n, 1], "float32", -1e9)
-        beam_pos = fluid.layers.fill_constant([n, 1], "int64", 0)
-        # build [0, -inf] per source
-        import numpy as _np
-        init_mask = fluid.layers.assign(
-            _np.array([[0.0] if i % BEAM == 0 else [-1e9] for i in range(n)],
-                      _np.float32))
-        pre_scores = init_mask
+        pre_scores = fluid.layers.assign(
+            np.array([[0.0] if i % BEAM == 0 else [-1e9] for i in range(n)],
+                     np.float32))
 
         ids_arr = fluid.layers.create_array("int64", shape=[MAXT, n, 1])
         scores_arr = fluid.layers.create_array("float32", shape=[MAXT, n, 1])
