@@ -4,8 +4,9 @@ All public layer functions are re-exported flat, so user code written as
 `fluid.layers.fc(...)` works unchanged against `paddle_tpu.layers`.
 """
 
-from . import control_flow, io, loss, metric_op, nn, ops, sequence, tensor
+from . import control_flow, detection, io, loss, metric_op, nn, ops, sequence, tensor
 from .control_flow import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
@@ -18,6 +19,7 @@ from . import learning_rate_scheduler
 
 __all__ = (
     control_flow.__all__
+    + detection.__all__
     + io.__all__
     + loss.__all__
     + metric_op.__all__

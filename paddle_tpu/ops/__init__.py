@@ -7,4 +7,5 @@ from . import parallel_ops  # noqa: F401 — registration side effects
 from . import control_flow_ops  # noqa: F401 — registration side effects
 from . import loss_ops  # noqa: F401 — registration side effects
 from . import decode_ops  # noqa: F401 — registration side effects
+from . import detection_ops  # noqa: F401 — registration side effects
 from .registry import OPS, get, is_registered, register

@@ -1,0 +1,860 @@
+"""Detection ops (reference paddle/fluid/operators/detection/ + roi_pool/
+roi_align/yolov3_loss at operators/ top level — 35 files, §2.5 of SURVEY.md).
+
+TPU-first notes: everything is fixed-shape. Variable-count results (NMS
+keeps, proposals) come out as fixed-capacity tensors padded with -1 plus an
+explicit count (the reference used LoD); selection loops (NMS, bipartite
+match) are lax.scan/fori_loop with masking, not data-dependent host loops.
+RoIs ride as padded [B, R, 4] + RoisLen instead of LoD.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# plain float, NOT a jnp array: module-level device values would
+# initialize the jax backend at import time, freezing the platform
+# before tests/drivers can flip it to CPU (see platform_setup.py)
+NEG = -1e9
+
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """reference prior_box_op.h:25 ExpandAspectRatios (starts from 1.0)."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+@register("prior_box", no_grad=True)
+def _prior_box(ctx, ins, attrs):
+    """SSD prior boxes (reference detection/prior_box_op.h:33-190). Output
+    Boxes/Variances are [H, W, num_priors, 4]."""
+    (feat,) = ins["Input"]  # [B, C, H, W]
+    (image,) = ins["Image"]  # [B, C, IH, IW]
+    min_sizes = [float(v) for v in attrs["min_sizes"]]
+    max_sizes = [float(v) for v in attrs.get("max_sizes", [])]
+    ars = _expand_aspect_ratios(
+        [float(v) for v in attrs.get("aspect_ratios", [1.0])],
+        bool(attrs.get("flip", False)),
+    )
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(attrs.get("clip", False))
+    mmao = bool(attrs.get("min_max_aspect_ratios_order", False))
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = float(attrs.get("step_w", 0.0)) or iw / fw
+    step_h = float(attrs.get("step_h", 0.0)) or ih / fh
+    offset = float(attrs.get("offset", 0.5))
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w  # [W]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h  # [H]
+
+    # per-cell prior (w/2, h/2) list in the reference's emission order
+    half_sizes = []
+    for s, mn in enumerate(min_sizes):
+        if mmao:
+            half_sizes.append((mn / 2.0, mn / 2.0))
+            if max_sizes:
+                m = (mn * max_sizes[s]) ** 0.5 / 2.0
+                half_sizes.append((m, m))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                half_sizes.append((mn * ar**0.5 / 2.0, mn / ar**0.5 / 2.0))
+        else:
+            for ar in ars:
+                half_sizes.append((mn * ar**0.5 / 2.0, mn / ar**0.5 / 2.0))
+            if max_sizes:
+                m = (mn * max_sizes[s]) ** 0.5 / 2.0
+                half_sizes.append((m, m))
+    hw = jnp.asarray([p[0] for p in half_sizes], jnp.float32)  # [P]
+    hh = jnp.asarray([p[1] for p in half_sizes], jnp.float32)
+
+    gx = cx[None, :, None]  # [1, W, 1]
+    gy = cy[:, None, None]  # [H, 1, 1]
+    full = (fh, fw, hw.shape[0])
+    boxes = jnp.stack(
+        [
+            jnp.broadcast_to((gx - hw) / iw, full),
+            jnp.broadcast_to((gy - hh) / ih, full),
+            jnp.broadcast_to((gx + hw) / iw, full),
+            jnp.broadcast_to((gy + hh) / ih, full),
+        ],
+        axis=-1,
+    )  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (fh, fw, hw.shape[0], 4)
+    )
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register("density_prior_box", no_grad=True)
+def _density_prior_box(ctx, ins, attrs):
+    """reference detection/density_prior_box_op.h: dense grid of square
+    priors per (fixed_size, density) pair, shifted within the cell."""
+    (feat,) = ins["Input"]
+    (image,) = ins["Image"]
+    fixed_sizes = [float(v) for v in attrs["fixed_sizes"]]
+    fixed_ratios = [float(v) for v in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(v) for v in attrs["densities"]]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(attrs.get("clip", False))
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = float(attrs.get("step_w", 0.0)) or iw / fw
+    step_h = float(attrs.get("step_h", 0.0)) or ih / fh
+    offset = float(attrs.get("offset", 0.5))
+
+    # per-cell (dx, dy, w/2, h/2) in emission order
+    entries = []
+    for s, fs in enumerate(fixed_sizes):
+        density = densities[s]
+        shift = step_w / density
+        for ar in fixed_ratios:
+            bw = fs * ar**0.5
+            bh = fs / ar**0.5
+            for di in range(density):
+                for dj in range(density):
+                    dx = -step_w / 2.0 + shift / 2.0 + dj * shift
+                    dy = -step_h / 2.0 + shift / 2.0 + di * shift
+                    entries.append((dx, dy, bw / 2.0, bh / 2.0))
+    dx = jnp.asarray([e[0] for e in entries], jnp.float32)
+    dy = jnp.asarray([e[1] for e in entries], jnp.float32)
+    hw = jnp.asarray([e[2] for e in entries], jnp.float32)
+    hh = jnp.asarray([e[3] for e in entries], jnp.float32)
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    gx = cx[None, :, None] + dx
+    gy = cy[:, None, None] + dy
+    full = (fh, fw, hw.shape[0])
+    boxes = jnp.stack(
+        [
+            jnp.broadcast_to((gx - hw) / iw, full),
+            jnp.broadcast_to((gy - hh) / ih, full),
+            jnp.broadcast_to((gx + hw) / iw, full),
+            jnp.broadcast_to((gy + hh) / ih, full),
+        ],
+        axis=-1,
+    )
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (fh, fw, hw.shape[0], 4)
+    )
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register("anchor_generator", no_grad=True)
+def _anchor_generator(ctx, ins, attrs):
+    """reference detection/anchor_generator_op.h: RPN anchors in input-image
+    coordinates, [H, W, num_anchors, 4]."""
+    (feat,) = ins["Input"]
+    sizes = [float(v) for v in attrs["anchor_sizes"]]
+    ratios = [float(v) for v in attrs["aspect_ratios"]]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(v) for v in attrs["stride"]]
+    offset = float(attrs.get("offset", 0.5))
+    fh, fw = feat.shape[2], feat.shape[3]
+
+    hs = []
+    for r in ratios:
+        for s in sizes:
+            area = stride[0] * stride[1]
+            area_ratios = area / r
+            base_w = round(area_ratios**0.5)
+            base_h = round(base_w * r)
+            scale_w = s / stride[0]
+            scale_h = s / stride[1]
+            hs.append((scale_w * base_w / 2.0, scale_h * base_h / 2.0))
+    hw = jnp.asarray([p[0] for p in hs], jnp.float32)
+    hh = jnp.asarray([p[1] for p in hs], jnp.float32)
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * stride[1]
+    gx = cx[None, :, None]
+    gy = cy[:, None, None]
+    full = (fh, fw, hw.shape[0])
+    anchors = jnp.stack(
+        [
+            jnp.broadcast_to(gx - hw + 0.0, full),
+            jnp.broadcast_to(gy - hh + 0.0, full),
+            jnp.broadcast_to(gx + hw - 1.0, full),
+            jnp.broadcast_to(gy + hh - 1.0, full),
+        ],
+        axis=-1,
+    )
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (fh, fw, hw.shape[0], 4)
+    )
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+def _center_size(box, normalized):
+    """(x1,y1,x2,y2) -> (cx, cy, w, h); +1 when unnormalized (reference
+    box_coder_op.h pixel convention)."""
+    plus = 0.0 if normalized else 1.0
+    w = box[..., 2] - box[..., 0] + plus
+    h = box[..., 3] - box[..., 1] + plus
+    cx = (box[..., 0] + box[..., 2]) / 2.0
+    cy = (box[..., 1] + box[..., 3]) / 2.0
+    return cx, cy, w, h
+
+
+@register("box_coder", no_grad=True)
+def _box_coder(ctx, ins, attrs):
+    """reference detection/box_coder_op.h. encode: [row,4]x[col,4]->[row,col,4];
+    decode: target [row,col,4] (or [row,4] broadcast) -> [row,col,4]."""
+    (prior,) = ins["PriorBox"]  # [col, 4]
+    (target,) = ins["TargetBox"]
+    pb_var = ins.get("PriorBoxVar", [None])[0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    normalized = bool(attrs.get("box_normalized", True))
+
+    pcx, pcy, pw, ph = _center_size(prior, normalized)  # [col]
+    if pb_var is not None:
+        v = pb_var  # [col, 4]
+    else:
+        v = None
+
+    if code_type == "encode_center_size":
+        tcx, tcy, tw, th = _center_size(target, normalized)  # [row]
+        ex = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        ey = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ew = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        eh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ex, ey, ew, eh], axis=-1)  # [row, col, 4]
+        if v is not None:
+            out = out / v[None, :, :]
+    else:  # decode_center_size
+        t = target if target.ndim == 3 else target[:, None, :]
+        if v is not None:
+            t = t * v[None, :, :]
+        dcx = t[..., 0] * pw[None, :] + pcx[None, :]
+        dcy = t[..., 1] * ph[None, :] + pcy[None, :]
+        dw = jnp.exp(t[..., 2]) * pw[None, :]
+        dh = jnp.exp(t[..., 3]) * ph[None, :]
+        plus = 0.0 if normalized else 1.0
+        out = jnp.stack(
+            [
+                dcx - dw / 2.0,
+                dcy - dh / 2.0,
+                dcx + dw / 2.0 - plus,
+                dcy + dh / 2.0 - plus,
+            ],
+            axis=-1,
+        )
+    return {"OutputBox": [out]}
+
+
+def _iou_matrix(a, b, normalized=True):
+    """pairwise IoU: a [..., N, 4], b [..., M, 4] -> [..., N, M]."""
+    plus = 0.0 if normalized else 1.0
+    ax1, ay1, ax2, ay2 = [a[..., i] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[..., i] for i in range(4)]
+    ix1 = jnp.maximum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.maximum(ay1[..., :, None], by1[..., None, :])
+    ix2 = jnp.minimum(ax2[..., :, None], bx2[..., None, :])
+    iy2 = jnp.minimum(ay2[..., :, None], by2[..., None, :])
+    iw = jnp.maximum(ix2 - ix1 + plus, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + plus, 0.0)
+    inter = iw * ih
+    area_a = (ax2 - ax1 + plus) * (ay2 - ay1 + plus)
+    area_b = (bx2 - bx1 + plus) * (by2 - by1 + plus)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register("iou_similarity", no_grad=True)
+def _iou_similarity(ctx, ins, attrs):
+    """reference detection/iou_similarity_op.h."""
+    (x,) = ins["X"]  # [N, 4]
+    (y,) = ins["Y"]  # [M, 4]
+    normalized = bool(attrs.get("box_normalized", True))
+    return {"Out": [_iou_matrix(x, y, normalized)]}
+
+
+def _bipartite_match_single(dist):
+    """Greedy global-max matching (reference bipartite_match_op.cc:65-139):
+    repeatedly take the largest entry among unmatched rows/cols. Returns
+    (col->row indices [M] int32 with -1, col dists [M])."""
+    n, m = dist.shape
+
+    def body(state, _):
+        d, col_idx, col_dist = state
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        ok = d[i, j] > 1e-6
+        col_idx = jnp.where(
+            ok, col_idx.at[j].set(i.astype(jnp.int32)), col_idx
+        )
+        col_dist = jnp.where(ok, col_dist.at[j].set(d[i, j]), col_dist)
+        # retire row i and column j
+        d = jnp.where(ok, d.at[i, :].set(NEG).at[:, j].set(NEG), d)
+        return (d, col_idx, col_dist), None
+
+    init = (
+        dist.astype(jnp.float32),
+        jnp.full((m,), -1, jnp.int32),
+        jnp.zeros((m,), jnp.float32),
+    )
+    (d, col_idx, col_dist), _ = lax.scan(body, init, None, length=min(n, m))
+    return col_idx, col_dist
+
+
+@register("bipartite_match", no_grad=True)
+def _bipartite_match(ctx, ins, attrs):
+    (dist,) = ins["DistMat"]  # [B, N, M] or [N, M]
+    match_type = attrs.get("match_type", "bipartite")
+    overlap_threshold = float(attrs.get("dist_threshold", 0.5))
+    batched = dist.ndim == 3
+    d = dist if batched else dist[None]
+
+    idx, dst = jax.vmap(_bipartite_match_single)(d)
+    if match_type == "per_prediction":
+        # additionally match unmatched cols to their argmax row if above the
+        # threshold (reference ArgMaxMatch, bipartite_match_op.cc:141)
+        am = jnp.argmax(d, axis=1).astype(jnp.int32)  # [B, M]
+        amd = jnp.max(d, axis=1)
+        take = (idx == -1) & (amd >= overlap_threshold)
+        idx = jnp.where(take, am, idx)
+        dst = jnp.where(take, amd, dst)
+    if not batched:
+        idx, dst = idx[0], dst[0]
+    return {"ColToRowMatchIndices": [idx], "ColToRowMatchDist": [dst]}
+
+
+@register("target_assign", no_grad=True)
+def _target_assign(ctx, ins, attrs):
+    """reference detection/target_assign_op.h: out[i,j] = X[i, match[i,j]]
+    where match >= 0 else mismatch_value; weights 1/0 alike."""
+    (x,) = ins["X"]  # [B, N, K] (gt rows per image, padded)
+    (match,) = ins["MatchIndices"]  # [B, M] int32
+    mismatch = attrs.get("mismatch_value", 0)
+    neg = ins.get("NegIndices", [None])[0]
+    m = match.astype(jnp.int32)
+    safe = jnp.maximum(m, 0)
+    out = jnp.take_along_axis(x, safe[:, :, None], axis=1)  # [B, M, K]
+    matched = (m >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch, x.dtype))
+    w = matched.astype(jnp.float32)
+    if neg is not None:
+        # rows listed in NegIndices also get weight 1 (classification targets
+        # for mined negatives), padded entries are -1
+        nmask = jnp.zeros(match.shape, jnp.float32)
+        ni = neg.reshape(neg.shape[0], -1).astype(jnp.int32)
+        valid = ni >= 0
+        rows = jnp.broadcast_to(
+            jnp.arange(match.shape[0], dtype=jnp.int32)[:, None], ni.shape
+        )
+        nmask = nmask.at[rows, jnp.maximum(ni, 0)].max(
+            valid.astype(jnp.float32)
+        )
+        w = jnp.maximum(w, nmask[:, :, None])
+    return {"Out": [out], "OutWeight": [w]}
+
+
+@register("mine_hard_examples", no_grad=True)
+def _mine_hard_examples(ctx, ins, attrs):
+    """reference detection/mine_hard_examples_op.cc (max_negative mining):
+    pick the top neg_pos_ratio * num_pos unmatched priors by loss. Output is
+    fixed [B, M] of selected negative prior indices, -1 padded."""
+    (cls_loss,) = ins["ClsLoss"]  # [B, M, 1] or [B, M]
+    (match,) = ins["MatchIndices"]  # [B, M]
+    loc_loss = ins.get("LocLoss", [None])[0]
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_dist_threshold = float(attrs.get("neg_dist_threshold", 0.5))
+    b, m = match.shape
+    loss = cls_loss.reshape(b, m)
+    if loc_loss is not None and bool(attrs.get("mining_type_hard", False)):
+        loss = loss + loc_loss.reshape(b, m)
+    matched = match >= 0
+    num_pos = matched.sum(axis=1)  # [B]
+    num_neg = jnp.minimum(
+        (num_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32),
+        m - num_pos,
+    )
+    cand = jnp.where(matched, NEG, loss)
+    order = jnp.argsort(-cand, axis=1).astype(jnp.int32)  # best-loss first
+    rank = jnp.arange(m, dtype=jnp.int32)[None, :]
+    sel = jnp.where(rank < num_neg[:, None], order, -1)
+    return {"NegIndices": [sel]}
+
+
+def _nms_single_class(boxes, scores, iou_thr, score_thr, top_k, normalized):
+    """Iterative NMS: top_k rounds of pick-max + suppress. Returns
+    (scores_kept [top_k], idx [top_k]) with -1/-inf padding."""
+    s = jnp.where(scores > score_thr, scores, NEG)
+
+    def body(state, _):
+        s_cur = state
+        i = jnp.argmax(s_cur)
+        ok = s_cur[i] > NEG / 2
+        iou = _iou_matrix(boxes[i][None], boxes, normalized)[0]
+        keep_score = s_cur[i]
+        s_new = jnp.where(iou > iou_thr, NEG, s_cur)
+        s_new = s_new.at[i].set(NEG)
+        s_new = jnp.where(ok, s_new, s_cur)
+        return s_new, (
+            jnp.where(ok, keep_score, NEG),
+            jnp.where(ok, i.astype(jnp.int32), -1),
+        )
+
+    _, (kept_scores, kept_idx) = lax.scan(body, s, None, length=top_k)
+    return kept_scores, kept_idx
+
+
+@register("multiclass_nms", no_grad=True)
+def _multiclass_nms(ctx, ins, attrs):
+    """reference detection/multiclass_nms_op.cc. Output is fixed-shape
+    [B, keep_top_k, 6] (label, score, x1, y1, x2, y2) padded with -1, plus
+    OutLen (the reference encodes counts in LoD)."""
+    (bboxes,) = ins["BBoxes"]  # [B, M, 4]
+    (scores,) = ins["Scores"]  # [B, C, M]
+    bg = int(attrs.get("background_label", 0))
+    score_thr = float(attrs.get("score_threshold", 0.0))
+    nms_thr = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    normalized = bool(attrs.get("normalized", True))
+    b, c, m = scores.shape
+    top_k = min(nms_top_k, m) if nms_top_k > 0 else m
+    if keep_top_k <= 0:
+        keep_top_k = c * top_k
+
+    def per_image(boxes_i, scores_i):
+        def per_class(cls_scores):
+            return _nms_single_class(
+                boxes_i, cls_scores, nms_thr, score_thr, top_k, normalized
+            )
+
+        ks, ki = jax.vmap(per_class)(scores_i)  # [C, top_k]
+        cls_ids = jnp.broadcast_to(
+            jnp.arange(c, dtype=jnp.int32)[:, None], (c, top_k)
+        )
+        # drop background detections
+        ks = jnp.where(cls_ids == bg, NEG, ks)
+        flat_s = ks.reshape(-1)
+        flat_i = ki.reshape(-1)
+        flat_c = cls_ids.reshape(-1)
+        k = min(keep_top_k, flat_s.shape[0])
+        top_s, sel = lax.top_k(flat_s, k)
+        sel_box = boxes_i[jnp.maximum(flat_i[sel], 0)]
+        sel_cls = flat_c[sel]
+        valid = top_s > NEG / 2
+        det = jnp.concatenate(
+            [
+                jnp.where(valid, sel_cls, -1).astype(jnp.float32)[:, None],
+                jnp.where(valid, top_s, -1.0)[:, None],
+                jnp.where(valid[:, None], sel_box, -1.0),
+            ],
+            axis=1,
+        )  # [k, 6]
+        return det, valid.sum().astype(jnp.int32)
+
+    det, cnt = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [det], "OutLen": [cnt]}
+
+
+@register("polygon_box_transform", no_grad=True)
+def _polygon_box_transform(ctx, ins, attrs):
+    """reference detection/polygon_box_transform_op.cc: at active cells
+    (input > 0 means offset), output = 4*grid_coord + input offset."""
+    (x,) = ins["Input"]  # [B, 8k, H, W] offsets
+    b, c, h, w = x.shape
+    gx = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype)[None, :], (h, w))
+    gy = jnp.broadcast_to(jnp.arange(h, dtype=x.dtype)[:, None], (h, w))
+    grid = jnp.stack([gx, gy], 0)  # [2, H, W]
+    grid_full = jnp.tile(grid, (c // 2, 1, 1))  # [C, H, W] alternating x/y
+    return {"Output": [jnp.where(x != 0, 4.0 * grid_full[None] + x, 0.0)]}
+
+
+# ---------------------------------------------------------------------------
+# RoI ops (reference operators/roi_pool_op.h, roi_align_op.h). RoIs are
+# padded [B, R, 4] + RoisLen; batch mapping is positional, replacing LoD.
+# ---------------------------------------------------------------------------
+
+
+@register("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    (x,) = ins["X"]  # [B, C, H, W]
+    (rois,) = ins["ROIs"]  # [B, R, 4]
+    (rois_len,) = ins["RoisLen"]
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    b, c_dim, h, w = x.shape
+    r = rois.shape[1]
+
+    def one_roi(feat, roi):
+        x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        bin_h = rh.astype(jnp.float32) / ph
+        bin_w = rw.astype(jnp.float32) / pw
+
+        ys = jnp.arange(h, dtype=jnp.int32)
+        xs = jnp.arange(w, dtype=jnp.int32)
+        # bin index of each pixel, -1 outside the roi
+        yy = jnp.floor((ys - y1) / bin_h).astype(jnp.int32)
+        xx = jnp.floor((xs - x1) / bin_w).astype(jnp.int32)
+        y_in = (ys >= y1) & (ys <= y2)
+        x_in = (xs >= x1) & (xs <= x2)
+        yy = jnp.clip(yy, 0, ph - 1)
+        xx = jnp.clip(xx, 0, pw - 1)
+        bin_idx = yy[:, None] * pw + xx[None, :]  # [H, W]
+        inside = y_in[:, None] & x_in[None, :]
+        onehot = jax.nn.one_hot(
+            jnp.where(inside, bin_idx, ph * pw), ph * pw + 1, dtype=feat.dtype
+        )[..., : ph * pw]  # [H, W, ph*pw]
+        vals = jnp.where(
+            onehot > 0, feat[:, :, :, None], jnp.asarray(NEG, feat.dtype)
+        )  # [C, H, W, ph*pw]
+        pooled = jnp.max(vals, axis=(1, 2))  # [C, ph*pw]
+        pooled = jnp.where(pooled <= NEG / 2, 0.0, pooled)
+        return pooled.reshape(c_dim, ph, pw)
+
+    def per_image(feat, rois_i, n_i):
+        out = jax.vmap(lambda rr: one_roi(feat, rr))(rois_i)  # [R, C, ph, pw]
+        valid = (jnp.arange(r) < n_i).reshape(r, 1, 1, 1)
+        return jnp.where(valid, out, 0.0)
+
+    out = jax.vmap(per_image)(x, rois, rois_len.reshape(-1))
+    return {"Out": [out]}
+
+
+@register("roi_align")
+def _roi_align(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (rois,) = ins["ROIs"]
+    (rois_len,) = ins["RoisLen"]
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    sampling = int(attrs.get("sampling_ratio", -1))
+    # XLA needs a static sampling count; the reference's adaptive
+    # ceil(roi/bin) becomes a fixed default of 2 (detectron convention)
+    s = sampling if sampling > 0 else 2
+    b, c_dim, h, w = x.shape
+    r = rois.shape[1]
+
+    def bilinear(feat, yy, xx):
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1, x1 = y0 + 1, x0 + 1
+        wy1 = yy - y0
+        wx1 = xx - x0
+        y0c = jnp.clip(y0, 0, h - 1)
+        y1c = jnp.clip(y1, 0, h - 1)
+        x0c = jnp.clip(x0, 0, w - 1)
+        x1c = jnp.clip(x1, 0, w - 1)
+        v = (
+            feat[:, y0c, x0c] * (1 - wy1) * (1 - wx1)
+            + feat[:, y1c, x0c] * wy1 * (1 - wx1)
+            + feat[:, y0c, x1c] * (1 - wy1) * wx1
+            + feat[:, y1c, x1c] * wy1 * wx1
+        )
+        inb = (yy >= -1) & (yy <= h) & (xx >= -1) & (xx <= w)
+        return jnp.where(inb, v, 0.0)
+
+    def one_roi(feat, roi):
+        x1 = roi[0] * scale
+        y1 = roi[1] * scale
+        x2 = roi[2] * scale
+        y2 = roi[3] * scale
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        py = jnp.arange(ph, dtype=jnp.float32)
+        px = jnp.arange(pw, dtype=jnp.float32)
+        sy = jnp.arange(s, dtype=jnp.float32)
+        # sample grid [ph, s] x [pw, s]
+        yy = y1 + py[:, None] * bin_h + (sy[None, :] + 0.5) * bin_h / s
+        xx = x1 + px[:, None] * bin_w + (sy[None, :] + 0.5) * bin_w / s
+        yv = yy.reshape(-1)  # [ph*s]
+        xv = xx.reshape(-1)  # [pw*s]
+        grid_y = jnp.repeat(yv, pw * s)
+        grid_x = jnp.tile(xv, ph * s)
+        vals = bilinear(feat, grid_y, grid_x)  # [C, ph*s*pw*s]
+        vals = vals.reshape(c_dim, ph, s, pw, s)
+        return vals.mean(axis=(2, 4))
+
+    def per_image(feat, rois_i, n_i):
+        out = jax.vmap(lambda rr: one_roi(feat, rr))(rois_i)
+        valid = (jnp.arange(r) < n_i).reshape(r, 1, 1, 1)
+        return jnp.where(valid, out, 0.0)
+
+    out = jax.vmap(per_image)(x, rois, rois_len.reshape(-1))
+    return {"Out": [out]}
+
+
+@register("yolov3_loss")
+def _yolov3_loss(ctx, ins, attrs):
+    """reference operators/yolov3_loss_op.h: per-anchor sigmoid xy + raw wh
+    regression, BCE objectness with ignore threshold, BCE class loss. Targets
+    built by assigning each gt box to its best shape-matched anchor at the
+    gt's grid cell."""
+    (x,) = ins["X"]  # [B, A*(5+cls), H, W]
+    (gtbox,) = ins["GTBox"]  # [B, G, 4] relative (cx, cy, w, h)
+    (gtlabel,) = ins["GTLabel"]  # [B, G]
+    anchors = [float(v) for v in attrs["anchors"]]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    b, ch, h, w = x.shape
+    a = len(anchors) // 2
+    g = gtbox.shape[1]
+    aw = jnp.asarray(anchors[0::2], jnp.float32)  # anchor widths (pixels)
+    ah = jnp.asarray(anchors[1::2], jnp.float32)
+    in_w = w * 32.0  # downsample factor 32, reference yolov3_loss_op.h
+    in_h = h * 32.0
+
+    p = x.reshape(b, a, 5 + class_num, h, w)
+    px = jax.nn.sigmoid(p[:, :, 0])
+    py = jax.nn.sigmoid(p[:, :, 1])
+    pw_ = p[:, :, 2]
+    ph_ = p[:, :, 3]
+    pobj = jax.nn.sigmoid(p[:, :, 4])
+    pcls = jax.nn.sigmoid(p[:, :, 5:])  # [B, A, cls, H, W]
+
+    valid_gt = (gtbox[..., 2] > 1e-6) & (gtbox[..., 3] > 1e-6)  # [B, G]
+    # best anchor per gt by shape IoU (centered boxes)
+    gw = gtbox[..., 2] * in_w  # [B, G]
+    gh = gtbox[..., 3] * in_h
+    inter = jnp.minimum(gw[..., None], aw) * jnp.minimum(gh[..., None], ah)
+    union = gw[..., None] * gh[..., None] + aw * ah - inter
+    best_a = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [B, G]
+
+    gi = jnp.clip((gtbox[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gtbox[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    tx = gtbox[..., 0] * w - gi
+    ty = gtbox[..., 1] * h - gj
+    tw = jnp.log(jnp.maximum(gw / aw[best_a], 1e-9))
+    th = jnp.log(jnp.maximum(gh / ah[best_a], 1e-9))
+    # loss weight: bigger boxes get smaller weight (2 - w*h), ref scale
+    box_w = 2.0 - gtbox[..., 2] * gtbox[..., 3]
+
+    # scatter gt targets into [B, A, H, W] grids
+    def scatter(vals, fill=0.0):
+        buf = jnp.full((b, a, h, w), fill, jnp.float32)
+        bi = jnp.broadcast_to(jnp.arange(b)[:, None], (b, g))
+        return buf.at[bi, best_a, gj, gi].set(
+            jnp.where(valid_gt, vals, buf[bi, best_a, gj, gi])
+        )
+
+    obj_mask = scatter(jnp.ones((b, g)), 0.0)
+    tx_t, ty_t = scatter(tx), scatter(ty)
+    tw_t, th_t = scatter(tw), scatter(th)
+    w_t = scatter(box_w)
+
+    # class target one-hot [B, A, cls, H, W]
+    cls_buf = jnp.zeros((b, a, class_num, h, w), jnp.float32)
+    bi = jnp.broadcast_to(jnp.arange(b)[:, None], (b, g))
+    lab = jnp.clip(gtlabel.astype(jnp.int32), 0, class_num - 1)
+    cls_buf = cls_buf.at[bi, best_a, lab, gj, gi].set(
+        jnp.where(valid_gt, 1.0, cls_buf[bi, best_a, lab, gj, gi])
+    )
+
+    # ignore mask: predicted boxes with IoU > thresh vs any gt are not
+    # penalized as background
+    grid_x = (jnp.arange(w, dtype=jnp.float32) + 0.0)[None, None, None, :]
+    grid_y = (jnp.arange(h, dtype=jnp.float32) + 0.0)[None, None, :, None]
+    bx = (px + grid_x) / w
+    by = (py + grid_y) / h
+    bw = jnp.exp(pw_) * aw[None, :, None, None] / in_w
+    bh = jnp.exp(ph_) * ah[None, :, None, None] / in_h
+    pred_boxes = jnp.stack(
+        [bx - bw / 2, by - bh / 2, bx + bw / 2, by + bh / 2], axis=-1
+    ).reshape(b, a * h * w, 4)
+    gt_corners = jnp.stack(
+        [
+            gtbox[..., 0] - gtbox[..., 2] / 2,
+            gtbox[..., 1] - gtbox[..., 3] / 2,
+            gtbox[..., 0] + gtbox[..., 2] / 2,
+            gtbox[..., 1] + gtbox[..., 3] / 2,
+        ],
+        axis=-1,
+    )  # [B, G, 4]
+    iou = _iou_matrix(pred_boxes, gt_corners)  # [B, A*H*W, G]
+    iou = jnp.where(valid_gt[:, None, :], iou, 0.0)
+    best_iou = iou.max(axis=2).reshape(b, a, h, w)
+    noobj_mask = (best_iou < ignore_thresh).astype(jnp.float32) * (1 - obj_mask)
+
+    def bce(pred, tgt, mask):
+        pred = jnp.clip(pred, 1e-7, 1 - 1e-7)
+        return -(tgt * jnp.log(pred) + (1 - tgt) * jnp.log(1 - pred)) * mask
+
+    loss_xy = (
+        bce(px, tx_t, obj_mask * w_t) + bce(py, ty_t, obj_mask * w_t)
+    ).sum(axis=(1, 2, 3))
+    loss_wh = (
+        jnp.square(pw_ - tw_t) * obj_mask * w_t
+        + jnp.square(ph_ - th_t) * obj_mask * w_t
+    ).sum(axis=(1, 2, 3))
+    loss_obj = (
+        bce(pobj, obj_mask, obj_mask) + bce(pobj, obj_mask, noobj_mask)
+    ).sum(axis=(1, 2, 3))
+    loss_cls = bce(pcls, cls_buf, obj_mask[:, :, None]).sum(axis=(1, 2, 3, 4))
+    return {"Loss": [loss_xy + loss_wh + loss_obj + loss_cls]}
+
+
+@register("generate_proposals", no_grad=True)
+def _generate_proposals(ctx, ins, attrs):
+    """reference detection/generate_proposals_op.cc: decode anchor deltas,
+    clip to the image, filter small boxes, topk + NMS. Fixed-capacity output
+    [B, post_nms_topN, 4] + count (reference emits LoD)."""
+    (scores,) = ins["Scores"]  # [B, A, H, W]
+    (deltas,) = ins["BboxDeltas"]  # [B, A*4, H, W]
+    (im_info,) = ins["ImInfo"]  # [B, 3] (h, w, scale)
+    (anchors,) = ins["Anchors"]  # [H, W, A, 4]
+    variances = ins.get("Variances", [None])[0]
+    pre_n = int(attrs.get("pre_nms_topN", 256))
+    post_n = int(attrs.get("post_nms_topN", 64))
+    nms_thr = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.0))
+    b, a, h, w = scores.shape
+
+    anc = anchors.reshape(h * w * a, 4)
+    var = variances.reshape(h * w * a, 4) if variances is not None else None
+
+    def per_image(sc, dl, info):
+        s = jnp.transpose(sc, (1, 2, 0)).reshape(-1)  # HWA order
+        d = dl.reshape(a, 4, h, w)
+        d = jnp.transpose(d, (2, 3, 0, 1)).reshape(-1, 4)
+        if var is not None:
+            d = d * var
+        pcx, pcy, pw_, ph_ = _center_size(anc, True)
+        cx = d[:, 0] * pw_ + pcx
+        cy = d[:, 1] * ph_ + pcy
+        bw = jnp.exp(jnp.minimum(d[:, 2], 10.0)) * pw_
+        bh = jnp.exp(jnp.minimum(d[:, 3], 10.0)) * ph_
+        boxes = jnp.stack(
+            [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2], axis=1
+        )
+        boxes = jnp.clip(
+            boxes,
+            0.0,
+            jnp.stack([info[1] - 1, info[0] - 1, info[1] - 1, info[0] - 1]),
+        )
+        ok = (
+            (boxes[:, 2] - boxes[:, 0] >= min_size)
+            & (boxes[:, 3] - boxes[:, 1] >= min_size)
+        )
+        s = jnp.where(ok, s, NEG)
+        k = min(pre_n, s.shape[0])
+        top_s, top_i = lax.top_k(s, k)
+        top_boxes = boxes[top_i]
+        kept_s, kept_i = _nms_single_class(
+            top_boxes, top_s, nms_thr, NEG / 2, min(post_n, k), False
+        )
+        out_boxes = top_boxes[jnp.maximum(kept_i, 0)]
+        valid = kept_i >= 0
+        out_boxes = jnp.where(valid[:, None], out_boxes, -1.0)
+        if out_boxes.shape[0] < post_n:
+            pad = jnp.full((post_n - out_boxes.shape[0], 4), -1.0)
+            out_boxes = jnp.concatenate([out_boxes, pad], 0)
+            kept_s = jnp.concatenate(
+                [kept_s, jnp.full((post_n - kept_s.shape[0],), NEG)], 0
+            )
+        return out_boxes, jnp.where(kept_s > NEG / 2, kept_s, -1.0), valid.sum(
+        ).astype(jnp.int32)
+
+    boxes, probs, cnt = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": [boxes], "RpnRoiProbs": [probs], "RoisLen": [cnt]}
+
+
+@register("ssd_loss")
+def _ssd_loss(ctx, ins, attrs):
+    """Fused SSD loss (reference python layers/detection.py ssd_loss, which
+    composes iou_similarity → bipartite_match → target_assign →
+    mine_hard_examples → smooth_l1 + softmax CE; here one lowering so XLA
+    fuses the whole pipeline). Returns per-image loss [B, 1]."""
+    (loc,) = ins["Location"]  # [B, M, 4]
+    (conf,) = ins["Confidence"]  # [B, M, C]
+    (gtbox,) = ins["GTBox"]  # [B, G, 4]
+    (gtlabel,) = ins["GTLabel"]  # [B, G, 1] or [B, G]
+    (gtlen,) = ins["GTLen"]  # [B]
+    (prior,) = ins["PriorBox"]  # [M, 4]
+    pb_var = ins.get("PriorBoxVar", [None])[0]
+    bg = int(attrs.get("background_label", 0))
+    overlap_t = float(attrs.get("overlap_threshold", 0.5))
+    neg_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    match_type = attrs.get("match_type", "per_prediction")
+    b, m, _ = loc.shape
+    g = gtbox.shape[1]
+    c = conf.shape[2]
+    glabel = gtlabel.reshape(b, g).astype(jnp.int32)
+    glen = gtlen.reshape(-1).astype(jnp.int32)
+
+    pcx, pcy, pw, ph = _center_size(prior, True)
+
+    def per_image(loc_i, conf_i, gt_i, gl_i, n_i):
+        gt_valid = jnp.arange(g) < n_i
+        iou = _iou_matrix(gt_i, prior)  # [G, M]
+        iou = jnp.where(gt_valid[:, None], iou, 0.0)
+        match, mdist = _bipartite_match_single(iou)
+        if match_type == "per_prediction":
+            am = jnp.argmax(iou, axis=0).astype(jnp.int32)
+            amd = jnp.max(iou, axis=0)
+            take = (match == -1) & (amd >= overlap_t)
+            match = jnp.where(take, am, match)
+        pos = match >= 0  # [M]
+        num_pos = pos.sum()
+
+        # confidence loss
+        tgt_label = jnp.where(pos, jnp.take(gl_i, jnp.maximum(match, 0)), bg)
+        logp = jax.nn.log_softmax(conf_i, axis=1)  # [M, C]
+        cls_loss = -jnp.take_along_axis(
+            logp, tgt_label[:, None], axis=1
+        ).reshape(m)
+        # hard-negative mining
+        num_neg = jnp.minimum(
+            (num_pos.astype(jnp.float32) * neg_ratio).astype(jnp.int32),
+            m - num_pos,
+        )
+        neg_cand = jnp.where(pos, NEG, cls_loss)
+        order = jnp.argsort(-neg_cand)
+        rank = jnp.zeros((m,), jnp.int32).at[order].set(jnp.arange(m, dtype=jnp.int32))
+        neg = (~pos) & (rank < num_neg)
+        conf_loss = jnp.where(pos | neg, cls_loss, 0.0).sum()
+
+        # localization loss (smooth l1 on encoded targets)
+        mgt = jnp.take(gt_i, jnp.maximum(match, 0), axis=0)  # [M, 4]
+        tcx = (mgt[:, 0] + mgt[:, 2]) / 2
+        tcy = (mgt[:, 1] + mgt[:, 3]) / 2
+        tw = jnp.maximum(mgt[:, 2] - mgt[:, 0], 1e-8)
+        th = jnp.maximum(mgt[:, 3] - mgt[:, 1], 1e-8)
+        enc = jnp.stack(
+            [
+                (tcx - pcx) / pw,
+                (tcy - pcy) / ph,
+                jnp.log(tw / pw),
+                jnp.log(th / ph),
+            ],
+            axis=1,
+        )
+        if pb_var is not None:
+            enc = enc / pb_var
+        diff = jnp.abs(loc_i - enc)
+        sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5).sum(axis=1)
+        loc_loss = jnp.where(pos, sl1, 0.0).sum()
+
+        denom = jnp.maximum(num_pos.astype(jnp.float32), 1.0)
+        return (conf_w * conf_loss + loc_w * loc_loss) / denom
+
+    loss = jax.vmap(per_image)(loc, conf, gtbox, glabel, glen)
+    return {"Loss": [loss.reshape(b, 1)]}

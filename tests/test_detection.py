@@ -1,0 +1,304 @@
+"""Detection suite tests (reference unittests: test_prior_box_op.py,
+test_anchor_generator_op.py, test_box_coder_op.py, test_iou_similarity_op.py,
+test_bipartite_match_op.py, test_target_assign_op.py,
+test_multiclass_nms_op.py, test_roi_pool_op.py, test_roi_align_op.py,
+test_polygon_box_transform.py, test_generate_proposals.py,
+test_yolov3_loss_op.py, test_ssd_loss.py via layers/detection.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _fresh():
+    return framework.Program(), framework.Program()
+
+
+def run_prog(main, startup, feed, fetch, seed=0):
+    scope = Scope(seed=seed)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def _iou(a, b):
+    ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+    ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+    iw, ih = max(ix2 - ix1, 0), max(iy2 - iy1, 0)
+    inter = iw * ih
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_prior_box():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data(name="f", shape=[1, 8, 4, 4], dtype="float32",
+                                 append_batch_size=False)
+        img = fluid.layers.data(name="im", shape=[1, 3, 32, 32],
+                                dtype="float32", append_batch_size=False)
+        boxes, variances = fluid.layers.prior_box(
+            feat, img, min_sizes=[8.0], max_sizes=[16.0],
+            aspect_ratios=[2.0], flip=True, clip=True)
+    (bv, vv) = run_prog(
+        main, startup,
+        {"f": np.zeros((1, 8, 4, 4), np.float32),
+         "im": np.zeros((1, 3, 32, 32), np.float32)},
+        [boxes.name, variances.name])
+    bv, vv = np.asarray(bv), np.asarray(vv)
+    # aspect ratios expand to [1, 2, 0.5] -> 3 + 1 max_size prior = 4
+    assert bv.shape == (4, 4, 4, 4)
+    # cell (0,0): center (4, 4), min_size prior half-width 4 -> [0, 0, 8, 8]/32
+    np.testing.assert_allclose(bv[0, 0, 0], [0.0, 0.0, 0.25, 0.25], atol=1e-6)
+    # max-size prior: sqrt(8*16)/2 = 5.657
+    s = np.sqrt(8 * 16.0) / 2
+    np.testing.assert_allclose(
+        bv[0, 0, 3], [0, 0, (4 + s) / 32, (4 + s) / 32], atol=1e-5)
+    np.testing.assert_allclose(vv[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    assert (bv >= 0).all() and (bv <= 1).all()
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(0)
+    M, R = 6, 5
+    prior = np.sort(rng.rand(M, 2, 2), axis=1).reshape(M, 4).astype("float32")
+    pvar = np.full((M, 4), 0.1, np.float32)
+    gt = np.sort(rng.rand(R, 2, 2), axis=1).reshape(R, 4).astype("float32")
+
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        pb = fluid.layers.data(name="pb", shape=[M, 4], dtype="float32",
+                               append_batch_size=False)
+        pv = fluid.layers.data(name="pv", shape=[M, 4], dtype="float32",
+                               append_batch_size=False)
+        tb = fluid.layers.data(name="tb", shape=[R, 4], dtype="float32",
+                               append_batch_size=False)
+        enc = fluid.layers.box_coder(pb, pv, tb, "encode_center_size")
+        dec = fluid.layers.box_coder(pb, pv, enc, "decode_center_size")
+    (ev, dv) = run_prog(main, startup, {"pb": prior, "pv": pvar, "tb": gt},
+                        [enc.name, dec.name])
+    ev, dv = np.asarray(ev), np.asarray(dv)
+    assert ev.shape == (R, M, 4)
+    # decode(encode(gt)) reproduces gt against every prior
+    for j in range(M):
+        np.testing.assert_allclose(dv[:, j], gt, atol=1e-4)
+
+
+def test_iou_similarity_and_bipartite_match():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    y = np.array([[0, 0, 2, 2], [10, 10, 11, 11], [1, 1, 3, 3]], np.float32)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[2, 4], dtype="float32",
+                               append_batch_size=False)
+        yv = fluid.layers.data(name="y", shape=[3, 4], dtype="float32",
+                               append_batch_size=False)
+        iou = fluid.layers.iou_similarity(xv, yv)
+        match, dist = fluid.layers.bipartite_match(iou)
+    (iv, mv, dvv) = run_prog(main, startup, {"x": x, "y": y},
+                             [iou.name, match.name, dist.name])
+    iv = np.asarray(iv)
+    for i in range(2):
+        for j in range(3):
+            np.testing.assert_allclose(iv[i, j], _iou(x[i], y[j]), atol=1e-5)
+    mv = np.asarray(mv).reshape(-1)
+    # col 0 matches row 0 (iou 1), col 2 matches row 1 (iou 1), col 1 none
+    assert mv[0] == 0 and mv[2] == 1 and mv[1] == -1
+
+
+def test_multiclass_nms():
+    # 1 image, 4 boxes, 2 classes (class 0 = background)
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30], [50, 50, 60, 60]]], np.float32)
+    scores = np.zeros((1, 2, 4), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.05]  # box 1 overlaps box 0 heavily
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        bv = fluid.layers.data(name="b", shape=[1, 4, 4], dtype="float32",
+                               append_batch_size=False)
+        sv = fluid.layers.data(name="s", shape=[1, 2, 4], dtype="float32",
+                               append_batch_size=False)
+        out = fluid.layers.multiclass_nms(
+            bv, sv, score_threshold=0.1, nms_top_k=4, keep_top_k=4,
+            nms_threshold=0.5, normalized=False)
+    (ov, cnt) = run_prog(main, startup, {"b": boxes, "s": scores},
+                         [out.name, out._len_name])
+    ov = np.asarray(ov)[0]
+    assert np.asarray(cnt).reshape(-1)[0] == 2
+    # kept: box 0 (0.9) and box 2 (0.7); box 1 suppressed, box 3 below thresh
+    np.testing.assert_allclose(ov[0, :2], [1, 0.9], atol=1e-6)
+    np.testing.assert_allclose(ov[0, 2:], boxes[0, 0], atol=1e-6)
+    np.testing.assert_allclose(ov[1, :2], [1, 0.7], atol=1e-6)
+    assert (ov[2:] == -1).all()
+
+
+def test_roi_pool_and_align():
+    B, C, H, W = 1, 1, 6, 6
+    x = np.arange(H * W, dtype=np.float32).reshape(B, C, H, W)
+    rois = np.array([[[0, 0, 3, 3], [2, 2, 5, 5]]], np.float32)
+    rois_len = np.array([2], np.int64)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[B, C, H, W], dtype="float32",
+                               append_batch_size=False)
+        rv = fluid.layers.data(name="r", shape=[B, 2, 4], dtype="float32",
+                               append_batch_size=False)
+        main.global_block().create_var(name="rl", shape=(B,), dtype="int64")
+        rv._len_name = "rl"
+        pooled = fluid.layers.roi_pool(xv, rv, 2, 2, 1.0)
+        aligned = fluid.layers.roi_align(xv, rv, 2, 2, 1.0, sampling_ratio=2)
+    (pv, av) = run_prog(main, startup,
+                        {"x": x, "r": rois, "rl": rois_len},
+                        [pooled.name, aligned.name])
+    pv = np.asarray(pv)
+    assert pv.shape == (1, 2, 1, 2, 2)
+    # roi (0,0,3,3) is rows/cols 0..3; 2x2 max pool over 4x4 region
+    np.testing.assert_allclose(pv[0, 0, 0], [[7, 9], [19, 21]])
+    av = np.asarray(av)
+    assert av.shape == (1, 2, 1, 2, 2)
+    assert np.isfinite(av).all()
+    # align averages within bins: strictly between region min and max
+    assert av[0, 0, 0].min() > 0 and av[0, 0, 0].max() < 21
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 2, 2, 2), np.float32)
+    x[0, 0, 1, 1] = 2.0  # x-offset at cell (1,1)
+    x[0, 1, 1, 1] = -1.0  # y-offset
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[1, 2, 2, 2], dtype="float32",
+                               append_batch_size=False)
+        out = fluid.layers.polygon_box_transform(xv)
+    (ov,) = run_prog(main, startup, {"x": x}, [out.name])
+    ov = np.asarray(ov)
+    np.testing.assert_allclose(ov[0, 0, 1, 1], 4 * 1 + 2.0)  # 4*x_coord + off
+    np.testing.assert_allclose(ov[0, 1, 1, 1], 4 * 1 - 1.0)
+    assert ov[0, 0, 0, 0] == 0
+
+
+def test_generate_proposals_shapes():
+    rng = np.random.RandomState(0)
+    B, A, H, W = 1, 3, 4, 4
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data(name="f", shape=[B, 8, H, W], dtype="float32",
+                                 append_batch_size=False)
+        anchors, variances = fluid.layers.anchor_generator(
+            feat, anchor_sizes=[32.0], aspect_ratios=[0.5, 1.0, 2.0],
+            stride=[16.0, 16.0])
+        scores = fluid.layers.data(name="s", shape=[B, A, H, W],
+                                   dtype="float32", append_batch_size=False)
+        deltas = fluid.layers.data(name="d", shape=[B, A * 4, H, W],
+                                   dtype="float32", append_batch_size=False)
+        im_info = fluid.layers.data(name="ii", shape=[B, 3], dtype="float32",
+                                    append_batch_size=False)
+        rois, probs = fluid.layers.generate_proposals(
+            scores, deltas, im_info, anchors, variances,
+            pre_nms_top_n=12, post_nms_top_n=5, nms_thresh=0.7, min_size=2.0)
+    (rv, pv, cnt) = run_prog(
+        main, startup,
+        {"f": np.zeros((B, 8, H, W), np.float32),
+         "s": rng.rand(B, A, H, W).astype("float32"),
+         "d": (rng.randn(B, A * 4, H, W) * 0.1).astype("float32"),
+         "ii": np.array([[64.0, 64.0, 1.0]], np.float32)},
+        [rois.name, probs.name, rois._len_name])
+    rv, pv = np.asarray(rv), np.asarray(pv)
+    n = int(np.asarray(cnt).reshape(-1)[0])
+    assert rv.shape == (B, 5, 4) and 1 <= n <= 5
+    valid = rv[0, :n]
+    assert (valid >= 0).all() and (valid[:, 2] <= 63.0 + 1e-5).all()
+    assert (rv[0, n:] == -1).all()
+
+
+def test_ssd_loss_trains():
+    """multi_box_head + ssd_loss: the loss falls on a fixed tiny scene."""
+    rng = np.random.RandomState(2)
+    B, G = 4, 3
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[B, 3, 32, 32],
+                                dtype="float32", append_batch_size=False)
+        gt_box = fluid.layers.data(name="gt", shape=[B, G, 4],
+                                   dtype="float32", append_batch_size=False)
+        main.global_block().create_var(name="gtl", shape=(B,), dtype="int64")
+        gt_box._len_name = "gtl"
+        gt_label = fluid.layers.data(name="lbl", shape=[B, G, 1],
+                                     dtype="int64", append_batch_size=False)
+        c1 = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                                 stride=2, padding=1, act="relu")
+        c2 = fluid.layers.conv2d(c1, num_filters=8, filter_size=3,
+                                 stride=2, padding=1, act="relu")
+        mbox_loc, mbox_conf, boxes, pvars = fluid.layers.multi_box_head(
+            inputs=[c1, c2], image=img, base_size=32, num_classes=3,
+            aspect_ratios=[[1.0], [1.0]], min_sizes=[8.0, 16.0],
+            max_sizes=[None, None] and [12.0, 24.0], flip=False)
+        loss_v = fluid.layers.ssd_loss(mbox_loc, mbox_conf, gt_box, gt_label,
+                                       boxes, pvars)
+        loss = fluid.layers.mean(loss_v)
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+
+    imgs = rng.rand(B, 3, 32, 32).astype("float32")
+    gts = np.zeros((B, G, 4), np.float32)
+    lbls = np.zeros((B, G, 1), np.int64)
+    lens = np.array([2, 1, 2, 1], np.int64)
+    for b in range(B):
+        for g in range(lens[b]):
+            x1, y1 = rng.rand(2) * 0.5
+            gts[b, g] = [x1, y1, x1 + 0.3, y1 + 0.3]
+            lbls[b, g, 0] = rng.randint(1, 3)
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(50):
+            (lv,) = exe.run(
+                main, feed={"img": imgs, "gt": gts, "lbl": lbls, "gtl": lens},
+                fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_yolov3_loss_trains():
+    rng = np.random.RandomState(3)
+    B, CLS, H, W = 2, 4, 4, 4
+    anchors = [10, 14, 23, 27, 37, 58]
+    A = 3
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data(
+            name="feat", shape=[B, 8, H, W], dtype="float32",
+            append_batch_size=False)
+        x = fluid.layers.conv2d(feat, num_filters=A * (5 + CLS),
+                                filter_size=1)
+        gtbox = fluid.layers.data(name="gt", shape=[B, 3, 4], dtype="float32",
+                                  append_batch_size=False)
+        gtlabel = fluid.layers.data(name="lbl", shape=[B, 3], dtype="int64",
+                                    append_batch_size=False)
+        loss_v = fluid.layers.yolov3_loss(x, gtbox, gtlabel, anchors, CLS,
+                                          ignore_thresh=0.7)
+        loss = fluid.layers.mean(loss_v)
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    feats = rng.rand(B, 8, H, W).astype("float32")
+    gts = np.zeros((B, 3, 4), np.float32)
+    lbls = rng.randint(0, CLS, (B, 3)).astype("int64")
+    for b in range(B):
+        gts[b, :2] = rng.rand(2, 4) * 0.4 + 0.2  # cx, cy, w, h all in (0, 0.6)
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(main,
+                            feed={"feat": feats, "gt": gts, "lbl": lbls},
+                            fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])

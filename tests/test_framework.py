@@ -116,3 +116,20 @@ def test_stop_gradient_blocks_backward():
     assert all("fc_1" in n or "fc_0" not in n for n in grad_params) or (
         first_fc_w not in grad_params
     )
+
+
+def test_package_import_does_not_initialize_backend():
+    """Module-level jnp values would freeze the platform before the CPU
+    bootstrap can run (regression: detection_ops NEG, Scope.rng_key)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import paddle_tpu\n"
+        "from jax._src import xla_bridge as xb\n"
+        "assert not xb._backends, 'backend initialized at import: %r' % xb._backends\n"
+        "print('clean')\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0 and "clean" in r.stdout, r.stdout + r.stderr
