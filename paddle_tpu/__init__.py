@@ -23,8 +23,10 @@ from . import (
     param_attr,
     reader,
     regularizer,
+    transpiler,
     unique_name,
 )
+from . import distributed  # noqa: F401
 from .batch import batch
 from .data_feeder import DataFeeder
 from .py_reader import EOFException

@@ -1,0 +1,8 @@
+"""Distributed runtime: socket RPC + parameter-shard serving loop.
+
+Reference analog: paddle/fluid/operators/distributed/ (SURVEY.md §2.7). The
+collective path (multi-host SPMD over ICI/DCN) lives in paddle_tpu/parallel/;
+this package is the host-side RPC tier used by the pserver transpile mode.
+"""
+
+from .rpc import RPCClient, RPCServer  # noqa: F401

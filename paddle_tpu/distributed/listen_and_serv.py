@@ -1,0 +1,151 @@
+"""Parameter-shard serving loop (the pserver main op).
+
+Reference analog: operators/distributed_ops/listen_and_serv_op.cc —
+RunSyncLoop (:106-176: wait send-barrier → run optimize sub-blocks per grad →
+serve gets until fetch-barrier) and RunAsyncLoop (:216: optimize immediately
+per arriving grad, no barriers) — plus the request handlers
+(distributed/request_handler_impl.cc: sync-mode scope merge of per-trainer
+grads, get serves params). The optimizer sub-blocks execute through the same
+whole-block XLA executor as regular programs (executor.py), so shard updates
+run compiled, not interpreted.
+
+Synchronization redesign: the reference resets barrier counters each round
+(rpc_server.h ResetBarrierCounter), which races with fast trainers; here each
+trainer's barrier count is MONOTONIC and round r waits for count > r — no
+reset, no race.
+"""
+
+import threading
+
+import numpy as np
+
+from .. import framework
+from .rpc import FETCH_BARRIER, SEND_BARRIER, RPCServer
+
+__all__ = ["run_pserver"]
+
+
+class _BlockRunner:
+    """Compile-and-run one sub-block against the pserver scope."""
+
+    def __init__(self, program, block, scope):
+        self.program = program
+        self.block = block
+        self.scope = scope
+        self._compiled = None
+
+    def run(self):
+        from ..executor import _CompiledBlock
+
+        if self._compiled is None:
+            self._compiled = _CompiledBlock(
+                self.program, self.block, [], [], self.scope
+            )
+        self._compiled(self.scope, {})
+
+
+def run_pserver(op, scope):
+    """Blocks until every trainer sent COMPLETE (reference listen_and_serv
+    blocks its executor thread the same way)."""
+    attrs = op.attrs
+    endpoint = attrs["endpoint"]
+    sync_mode = bool(attrs.get("sync_mode", True))
+    fanin = int(attrs.get("Fanin", 1))
+    program = op.block.program
+    opt_block_ids = list(attrs.get("optimize_blocks", []))
+    grad_to_block_id = dict(
+        kv.split(":") for kv in attrs.get("grad_to_block_id", [])
+    )
+    lr_block_id = int(attrs.get("lr_decay_block_id", -1))
+
+    server = RPCServer(endpoint, fanin)
+    runners = {
+        bid: _BlockRunner(program, program.block(bid), scope)
+        for bid in opt_block_ids
+    }
+    lr_runner = (
+        _BlockRunner(program, program.block(lr_block_id), scope)
+        if lr_block_id >= 0
+        else None
+    )
+    grad_block = {g: int(b) for g, b in grad_to_block_id.items()}
+
+    state_lock = threading.Lock()
+    staged = {}  # grad name -> accumulated np array (sync mode round staging)
+    optimized_rounds = [0]
+    ready = threading.Condition()
+
+    def on_send(name, arr, trainer_id):
+        if arr is None:
+            return
+        if sync_mode:
+            with state_lock:
+                cur = staged.get(name)
+                staged[name] = arr.copy() if cur is None else cur + arr
+        else:
+            # async: optimize immediately per arriving grad (RunAsyncLoop)
+            with state_lock:
+                scope.set_var(name, _to_device(arr))
+                bid = grad_block.get(name)
+                if bid is not None:
+                    runners[bid].run()
+
+    def on_get(name, trainer_id):
+        if sync_mode:
+            # serve only after this trainer's current round was optimized
+            want = server.barrier_counts[SEND_BARRIER].get(trainer_id, 0)
+            with ready:
+                while optimized_rounds[0] < want and not server.all_exited():
+                    ready.wait(timeout=0.5)
+        val = scope.find_var(name)
+        return None if val is None else np.asarray(val)
+
+    server.on_send = on_send
+    server.on_get = on_get
+    server.start()
+    op.attrs["__bound_endpoint__"] = server.endpoint  # port 0 → real port
+
+    try:
+        if sync_mode:
+            rnd = 0
+            while True:
+                if not server.wait_barrier(SEND_BARRIER, rnd):
+                    break
+                with state_lock:
+                    grads, staged_now = dict(staged), staged
+                    staged_now.clear()
+                for g, arr in grads.items():
+                    # sync merge = sum over trainers, then the per-grad
+                    # optimize block (request_handler_impl.cc scope merge)
+                    scope.set_var(g, _to_device(arr))
+                if lr_runner is not None:
+                    lr_runner.run()
+                for g in grads:
+                    bid = grad_block.get(g)
+                    if bid is not None:
+                        runners[bid].run()
+                with ready:
+                    optimized_rounds[0] = rnd + 1
+                    ready.notify_all()
+                if not server.wait_barrier(FETCH_BARRIER, rnd):
+                    break
+                rnd += 1
+        else:
+            server.wait_all_exited()
+    except BaseException:
+        # serving-loop failures must be visible: they run on daemon threads
+        # (reference pserver glog-fatals here)
+        import traceback
+
+        traceback.print_exc()
+        raise
+    finally:
+        with ready:
+            ready.notify_all()
+        server.stop()
+
+
+def _to_device(arr):
+    import jax.numpy as jnp
+
+    return jnp.asarray(arr)

@@ -1,0 +1,308 @@
+"""Socket RPC for parameter-shard training: variable send/get + barriers.
+
+Reference analog: paddle/fluid/operators/distributed/ — RPCClient
+(rpc_client.h:36-79: AsyncSendVar/AsyncGetVar/barriers/SendComplete), RPCServer
+(rpc_server.h:48-105: registered handlers + barrier machinery), and the gRPC
+wire format (send_recv.proto.in VariableMessage; zero-copy serialization in
+grpc_serde.cc). Redesigned host-side for the TPU runtime: a length-prefixed
+binary frame over TCP — varname, dtype, dims, raw tensor bytes — with no
+protobuf/pickle dependency; tensors cross the wire as the numpy buffer exactly
+once (the grpc_serde zero-extra-copy property).
+
+Frame layout (little-endian):
+  u8   msg kind (SEND_VAR / GET_VAR / VAR_REPLY / SEND_BARRIER / FETCH_BARRIER
+                 / COMPLETE / ACK)
+  i32  trainer_id
+  u16  len(varname), varname utf-8
+  u16  len(dtype str), dtype utf-8      (SEND_VAR / VAR_REPLY only)
+  u8   ndim, i64 × ndim dims            (SEND_VAR / VAR_REPLY only)
+  u64  payload byte length, payload     (SEND_VAR / VAR_REPLY only)
+"""
+
+import socket
+import struct
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+__all__ = ["RPCClient", "RPCServer", "serialize_var", "read_frame"]
+
+SEND_VAR = 1
+GET_VAR = 2
+VAR_REPLY = 3
+SEND_BARRIER = 4
+FETCH_BARRIER = 5
+COMPLETE = 6
+ACK = 7
+
+_HEADER = struct.Struct("<Bi")
+_U16 = struct.Struct("<H")
+_U64 = struct.Struct("<Q")
+
+
+def _pack_str(s):
+    b = s.encode("utf-8")
+    return _U16.pack(len(b)) + b
+
+
+def serialize_var(kind, trainer_id, name, array=None):
+    parts = [_HEADER.pack(kind, trainer_id), _pack_str(name)]
+    if array is not None:
+        arr = np.ascontiguousarray(array)
+        parts.append(_pack_str(str(arr.dtype)))
+        parts.append(struct.pack("<B", arr.ndim))
+        parts.append(struct.pack("<%dq" % arr.ndim, *arr.shape))
+        payload = arr.tobytes()  # the single host copy
+        parts.append(_U64.pack(len(payload)))
+        parts.append(payload)
+    else:
+        parts.append(_U64.pack(0) if kind in (SEND_VAR, VAR_REPLY) else b"")
+    return b"".join(parts)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed")
+        got += r
+    return bytes(buf)
+
+
+def read_frame(sock):
+    """Returns (kind, trainer_id, varname, array-or-None)."""
+    kind, trainer_id = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    (nlen,) = _U16.unpack(_recv_exact(sock, 2))
+    name = _recv_exact(sock, nlen).decode("utf-8")
+    arr = None
+    if kind in (SEND_VAR, VAR_REPLY):
+        (dlen,) = _U16.unpack(_recv_exact(sock, 2))
+        if dlen:
+            dtype = _recv_exact(sock, dlen).decode("utf-8")
+            (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
+            dims = struct.unpack("<%dq" % ndim, _recv_exact(sock, 8 * ndim)) if ndim else ()
+            (plen,) = _U64.unpack(_recv_exact(sock, 8))
+            payload = _recv_exact(sock, plen)
+            arr = np.frombuffer(payload, dtype=dtype).reshape(dims)
+        else:
+            _U64.unpack(_recv_exact(sock, 8))
+    return kind, trainer_id, name, arr
+
+
+class RPCClient:
+    """One per trainer process (reference rpc_client.h singleton GetInstance).
+    Maintains one persistent connection per endpoint; async ops run on a
+    thread pool, wait() joins them (AsyncSendVar/Wait semantics)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def instance(cls, trainer_id=0):
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(trainer_id)
+        return cls._instance
+
+    def __init__(self, trainer_id=0, timeout=120.0):
+        self.trainer_id = trainer_id
+        self.timeout = timeout
+        self._socks = {}
+        self._sock_locks = {}
+        self._pool = ThreadPoolExecutor(max_workers=8)
+        self._futures = []
+
+    def _sock(self, endpoint):
+        if endpoint not in self._socks:
+            host, port = endpoint.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=self.timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[endpoint] = s
+            self._sock_locks[endpoint] = threading.Lock()
+        return self._socks[endpoint], self._sock_locks[endpoint]
+
+    def _rpc(self, endpoint, frame, want_reply):
+        sock, lock = self._sock(endpoint)
+        with lock:
+            sock.sendall(frame)
+            if want_reply:
+                kind, _, name, arr = read_frame(sock)
+                return arr if kind == VAR_REPLY else None
+            kind, *_ = read_frame(sock)  # ACK keeps sends flow-controlled
+            return None
+
+    # --- async API (reference rpc_client.h:36-79) ---
+    def async_send_var(self, endpoint, name, array):
+        f = self._pool.submit(
+            self._rpc, endpoint,
+            serialize_var(SEND_VAR, self.trainer_id, name, np.asarray(array)),
+            False,
+        )
+        self._futures.append(f)
+        return f
+
+    def async_get_var(self, endpoint, name):
+        f = self._pool.submit(
+            self._rpc, endpoint, serialize_var(GET_VAR, self.trainer_id, name), True
+        )
+        self._futures.append(f)
+        return f
+
+    def send_barrier(self, endpoint):
+        f = self._pool.submit(
+            self._rpc, endpoint, serialize_var(SEND_BARRIER, self.trainer_id, ""), False
+        )
+        self._futures.append(f)
+        return f
+
+    def fetch_barrier(self, endpoint):
+        f = self._pool.submit(
+            self._rpc, endpoint, serialize_var(FETCH_BARRIER, self.trainer_id, ""), False
+        )
+        self._futures.append(f)
+        return f
+
+    def send_complete(self, endpoint):
+        try:
+            self._rpc(endpoint, serialize_var(COMPLETE, self.trainer_id, ""), False)
+        except (ConnectionError, OSError):
+            pass  # server may already be down
+
+    def wait(self):
+        fs, self._futures = self._futures, []
+        for f in fs:
+            f.result(timeout=self.timeout)
+
+    def close(self):
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks.clear()
+        self._pool.shutdown(wait=False)
+        with RPCClient._lock:
+            if RPCClient._instance is self:
+                RPCClient._instance = None
+
+
+class RPCServer:
+    """Parameter-shard server transport (reference rpc_server.h:48 +
+    grpc_server.cc). Owns the listening socket and per-connection threads;
+    the training-loop semantics (sync barriers, grad merge, optimize) live in
+    listen_and_serv.py, wired in via the three handler callbacks, mirroring
+    the reference's RequestSend/RequestGet handler registration."""
+
+    def __init__(self, endpoint, fanin):
+        host, port = endpoint.rsplit(":", 1)
+        self.fanin = fanin
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host or "0.0.0.0", int(port)))
+        self._listener.listen(64)
+        self.endpoint = "%s:%d" % (host, self._listener.getsockname()[1])
+        self._threads = []
+        self._stop = threading.Event()
+        self.cond = threading.Condition()
+        # trainer_id -> monotonically increasing barrier count (see
+        # listen_and_serv.py: round r waits for count > r; monotonic counters
+        # replace the reference's racy ResetBarrierCounter)
+        self.barrier_counts = {SEND_BARRIER: {}, FETCH_BARRIER: {}}
+        self.exited_trainers = set()
+        # handlers set by the serving loop (RequestSendHandler etc.)
+        self.on_send = None  # fn(name, array, trainer_id)
+        self.on_get = None  # fn(name, trainer_id) -> np array (may block)
+
+    def start(self):
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self):
+        self._listener.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                kind, trainer_id, name, arr = read_frame(conn)
+                if kind == SEND_VAR:
+                    self.on_send(name, arr, trainer_id)
+                    conn.sendall(serialize_var(ACK, 0, ""))
+                elif kind == GET_VAR:
+                    out = self.on_get(name, trainer_id)
+                    if out is None:
+                        # unknown var: reply empty so the client raises
+                        # instead of timing out (reference returns a gRPC
+                        # error status)
+                        conn.sendall(serialize_var(VAR_REPLY, 0, name, None))
+                    else:
+                        conn.sendall(serialize_var(VAR_REPLY, 0, name, out))
+                elif kind in (SEND_BARRIER, FETCH_BARRIER):
+                    with self.cond:
+                        counts = self.barrier_counts[kind]
+                        counts[trainer_id] = counts.get(trainer_id, 0) + 1
+                        self.cond.notify_all()
+                    conn.sendall(serialize_var(ACK, 0, ""))
+                elif kind == COMPLETE:
+                    with self.cond:
+                        self.exited_trainers.add(trainer_id)
+                        self.cond.notify_all()
+                    conn.sendall(serialize_var(ACK, 0, ""))
+        except (ConnectionError, OSError):
+            pass
+        except BaseException:
+            import traceback
+
+            traceback.print_exc()
+        finally:
+            conn.close()
+
+    # --- barrier machinery (reference rpc_server.h WaitBarrier/ResetBarrier) ---
+    def wait_barrier(self, kind, round_idx):
+        """Wait until every live trainer passed barrier round `round_idx`
+        (count > round_idx); returns False once every trainer exited instead
+        (graceful shutdown, rpc_server.h:98 Complete)."""
+        with self.cond:
+            while True:
+                if len(self.exited_trainers) >= self.fanin:
+                    return False
+                counts = self.barrier_counts[kind]
+                passed = sum(
+                    1
+                    for t, c in counts.items()
+                    if c > round_idx and t not in self.exited_trainers
+                )
+                if passed >= self.fanin - len(self.exited_trainers):
+                    return True
+                self.cond.wait(timeout=0.5)
+
+    def wait_all_exited(self):
+        with self.cond:
+            while len(self.exited_trainers) < self.fanin:
+                self.cond.wait(timeout=0.5)
+
+    def all_exited(self):
+        with self.cond:
+            return len(self.exited_trainers) >= self.fanin
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass

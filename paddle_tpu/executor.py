@@ -19,6 +19,8 @@ Scope (reference framework/scope.h) holds name -> jax.Array plus the PRNG key
 that stochastic ops consume.
 """
 
+import threading
+
 import numpy as np
 
 import jax
@@ -66,11 +68,21 @@ class Scope:
 
 
 _global_scope = Scope()
-_scope_stack = [_global_scope]
+_scope_tls = threading.local()
+
+
+def _scope_stack():
+    # per-thread stack (pserver serving loops and AsyncExecutor workers each
+    # run under their own scope_guard concurrently; the reference's Scope use
+    # is likewise per-thread)
+    st = getattr(_scope_tls, "stack", None)
+    if st is None:
+        st = _scope_tls.stack = [_global_scope]
+    return st
 
 
 def global_scope():
-    return _scope_stack[-1]
+    return _scope_stack()[-1]
 
 
 class scope_guard:
@@ -78,10 +90,10 @@ class scope_guard:
         self.scope = scope
 
     def __enter__(self):
-        _scope_stack.append(self.scope)
+        _scope_stack().append(self.scope)
 
     def __exit__(self, *args):
-        _scope_stack.pop()
+        _scope_stack().pop()
 
 
 def _as_feed_array(value, var):
@@ -111,17 +123,23 @@ class _CompiledBlock:
     ncclAllReduce ops."""
 
     def __init__(self, program, block, feed_names, fetch_names, scope, mesh=None,
-                 data_axes=("dp",), feed_ranks=None):
+                 data_axes=("dp",), feed_ranks=None, ops_override=None):
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
+        src_ops = block.ops if ops_override is None else ops_override
         ops = [
             op
-            for op in block.ops
+            for op in src_ops
             if not registry.get(op.type).skip_exec
-        ] if all(registry.is_registered(op.type) for op in block.ops) else None
+        ] if all(registry.is_registered(op.type) for op in src_ops) else None
         if ops is None:
-            unknown = [op.type for op in block.ops if not registry.is_registered(op.type)]
+            unknown = [op.type for op in src_ops if not registry.is_registered(op.type)]
             raise NotImplementedError("ops without lowering: %s" % sorted(set(unknown)))
+        if any(registry.get(op.type).is_host for op in ops):
+            raise RuntimeError(
+                "host ops (send/recv/listen_and_serv...) cannot be jitted; "
+                "run this block through Executor, which partitions at host ops"
+            )
         self.ops = ops
 
         # classify external inputs: fed names are args; persistable names found
@@ -250,6 +268,85 @@ class _CompiledBlock:
         return fetches
 
 
+class _SegmentedBlock:
+    """A block containing host ops (RPC send/recv, listen_and_serv — the
+    reference's non-kernel OperatorBase ops), executed as alternating XLA
+    segments and host calls.
+
+    Reference analog: the reference's per-op interpreter runs host ops
+    in-line with kernels (executor.cc:389-396); here the block is partitioned
+    AT host-op boundaries, each maximal device run is one jitted XLA segment
+    (same _CompiledBlock machinery), and values cross segments through the
+    Scope. Segments compile lazily at first execution so vars produced by
+    earlier host ops (e.g. recv outputs) are in scope by then."""
+
+    def __init__(self, program, block, feed_names, fetch_names):
+        self.program = program
+        self.block = block
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        # partition: [("xla", [ops]) | ("host", op)]
+        self.segments = []
+        cur = []
+        for op in block.ops:
+            opdef = registry.get(op.type)
+            if opdef.is_host:
+                if cur:
+                    self.segments.append(("xla", cur))
+                    cur = []
+                self.segments.append(("host", op))
+            else:
+                cur.append(op)
+        if cur:
+            self.segments.append(("xla", cur))
+
+        # per-xla-segment exports: produced names consumed by later segments,
+        # host ops, or the final fetch list — these leave the jit via fetches
+        # and land in the scope (persistable mutations are handled by
+        # _CompiledBlock's donated-state path independently).
+        later_consumed = set(self.fetch_names)
+        self._exports = [None] * len(self.segments)
+        for i in range(len(self.segments) - 1, -1, -1):
+            kind, payload = self.segments[i]
+            if kind == "xla":
+                produced = set()
+                for op in payload:
+                    produced.update(op.output_arg_names)
+                self._exports[i] = sorted(produced & later_consumed)
+                for op in payload:
+                    later_consumed.update(op.input_arg_names)
+            else:
+                later_consumed.update(payload.input_arg_names)
+        self._compiled = [None] * len(self.segments)
+
+    def __call__(self, scope, feed_arrays):
+        for name, value in feed_arrays.items():
+            scope.set_var(
+                name, value if isinstance(value, jax.Array) else jnp.asarray(value)
+            )
+        for i, (kind, payload) in enumerate(self.segments):
+            if kind == "host":
+                registry.get(payload.type).host_fn(payload, scope)
+                continue
+            if not payload:
+                continue
+            compiled = self._compiled[i]
+            if compiled is None:
+                compiled = _CompiledBlock(
+                    self.program,
+                    self.block,
+                    [],
+                    self._exports[i],
+                    scope,
+                    ops_override=payload,
+                )
+                self._compiled[i] = compiled
+            vals = compiled(scope, {})
+            for name, val in zip(self._exports[i], vals):
+                scope.set_var(name, val)
+        return [scope.find_var(n) for n in self.fetch_names]
+
+
 class Executor:
     """Drop-in for fluid.Executor (reference python/paddle/fluid/executor.py:256).
 
@@ -262,8 +359,17 @@ class Executor:
         self.place = place
         self._cache = {}
 
-    def close(self):  # compat (reference Executor::Close notifies pservers)
+    def close(self):
+        """Reference Executor::Close (executor.cc:111-119): notify pservers
+        this trainer is done (SendComplete), letting their sync loops exit."""
         self._cache.clear()
+        from .distributed.rpc import RPCClient
+
+        client = RPCClient._instance
+        if client is not None:
+            for ep in list(client._socks):
+                client.send_complete(ep)
+            client.close()
 
     def run(
         self,
@@ -313,9 +419,18 @@ class Executor:
         )
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
-            compiled = _CompiledBlock(
-                program, block, list(feed_arrays.keys()), fetch_names, scope
+            has_host = any(
+                registry.is_registered(op.type) and registry.get(op.type).is_host
+                for op in block.ops
             )
+            if has_host:
+                compiled = _SegmentedBlock(
+                    program, block, list(feed_arrays.keys()), fetch_names
+                )
+            else:
+                compiled = _CompiledBlock(
+                    program, block, list(feed_arrays.keys()), fetch_names, scope
+                )
             if use_program_cache:
                 self._cache[key] = compiled
 

@@ -14,7 +14,15 @@ from .. import (  # noqa: F401
     optimizer,
     param_attr,
     regularizer,
+    transpiler,
     unique_name,
+)
+from ..transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    InferenceTranspiler,
+    memory_optimize,
+    release_memory,
 )
 from ..data_feeder import DataFeeder  # noqa: F401
 from ..py_reader import EOFException  # noqa: F401

@@ -293,6 +293,12 @@ class Operator:
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
         self.attrs.setdefault(OpRole.OP_ROLE_KEY, _current_role())
+        # ops created under _optimized_guard carry their (param, grad) pair —
+        # the seam the multi-device pass and DistributeTranspiler key on
+        # (reference op_proto_maker.h OpRoleVar)
+        role_var = _current_role_var()
+        if role_var and OpRole.OP_ROLE_VAR_KEY not in self.attrs:
+            self.attrs[OpRole.OP_ROLE_VAR_KEY] = list(role_var)
 
     def input(self, slot):
         return self.inputs.get(slot, [])
@@ -669,6 +675,11 @@ class Program:
 def _current_role():
     prog = _main_program_
     return prog._op_role if prog is not None else OpRole.Forward
+
+
+def _current_role_var():
+    prog = _main_program_
+    return prog._op_role_var if prog is not None else []
 
 
 _main_program_ = Program()

@@ -8,4 +8,6 @@ from . import control_flow_ops  # noqa: F401 — registration side effects
 from . import loss_ops  # noqa: F401 — registration side effects
 from . import decode_ops  # noqa: F401 — registration side effects
 from . import detection_ops  # noqa: F401 — registration side effects
+from . import dist_ops  # noqa: F401 — registration side effects
+from . import quant_ops  # noqa: F401 — registration side effects
 from .registry import OPS, get, is_registered, register

@@ -1,0 +1,95 @@
+"""Distributed RPC ops — host ops executed between XLA segments.
+
+Reference analog: operators/distributed_ops/ — send_op (async grad push),
+recv_op (param pull), send_barrier_op, fetch_barrier_op, listen_and_serv_op,
+checkpoint_notify_op, fake_init_op. These are the reference's non-kernel
+OperatorBase ops (they talk gRPC, not CUDA); here they run on the host between
+the block's jitted XLA segments (executor.py partitions at host ops), speaking
+the socket RPC in distributed/rpc.py.
+"""
+
+import numpy as np
+
+from .registry import register_host
+
+
+def _client(op):
+    from ..distributed.rpc import RPCClient
+
+    return RPCClient.instance(int(op.attrs.get("trainer_id", 0)))
+
+
+@register_host("send")
+def _send(op, scope):
+    """Push each X[i] to epmap[i] (reference send_op.cc: AsyncSendVar per var,
+    then Wait)."""
+    client = _client(op)
+    names = op.input("X")
+    epmap = op.attrs["epmap"]
+    for name, ep in zip(names, epmap):
+        client.async_send_var(ep, name, np.asarray(scope.find_var(name)))
+    client.wait()
+
+
+@register_host("recv")
+def _recv(op, scope):
+    """Pull each Out[i] from epmap[i] (reference recv_op.cc)."""
+    client = _client(op)
+    names = op.output("Out")
+    epmap = op.attrs["epmap"]
+    futures = [(name, client.async_get_var(ep, name)) for name, ep in zip(names, epmap)]
+    import jax.numpy as jnp
+
+    for name, f in futures:
+        arr = f.result(timeout=client.timeout)
+        if arr is None:
+            raise KeyError(
+                "recv: pserver has no var %r (wrong endpoint map?)" % name
+            )
+        scope.set_var(name, jnp.asarray(arr))
+
+
+@register_host("send_barrier")
+def _send_barrier(op, scope):
+    client = _client(op)
+    for ep in op.attrs["endpoints"]:
+        client.send_barrier(ep)
+    client.wait()
+
+
+@register_host("fetch_barrier")
+def _fetch_barrier(op, scope):
+    client = _client(op)
+    for ep in op.attrs["endpoints"]:
+        client.fetch_barrier(ep)
+    client.wait()
+
+
+@register_host("listen_and_serv")
+def _listen_and_serv(op, scope):
+    from ..distributed.listen_and_serv import run_pserver
+
+    run_pserver(op, scope)
+
+
+@register_host("checkpoint_notify")
+def _checkpoint_notify(op, scope):
+    """Ask each pserver to checkpoint its shards (reference
+    checkpoint_notify_op.cc + RequestCheckpointHandler). Served over the same
+    GET channel: the pserver saves on demand via its save hook if installed."""
+    client = _client(op)
+    for ep in op.attrs.get("epmap", op.attrs.get("endpoints", [])):
+        client.async_get_var(ep, "__checkpoint__:%s" % op.attrs.get("dir", ""))
+    client.wait()
+
+
+@register_host("fake_init")
+def _fake_init(op, scope):
+    """Declare-only init for vars whose values live on pservers (reference
+    fake_init_op.cc): creates an empty placeholder so startup programs type-
+    check; real values arrive via recv."""
+    import jax.numpy as jnp
+
+    for name in op.output("Out"):
+        if scope.find_var(name) is None:
+            scope.set_var(name, jnp.zeros((1,), jnp.float32))

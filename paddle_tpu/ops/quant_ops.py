@@ -1,0 +1,80 @@
+"""Fake-quantization ops for quantization-aware training.
+
+Reference analog: operators/fake_quantize_op.{cc,cu} (fake_quantize_abs_max,
+fake_quantize_range_abs_max) and fake_dequantize_op.cc (fake_dequantize_max_abs)
+— used by the contrib QuantizeTranspiler (quantize_transpiler.py:81). Gradients
+are straight-through (the reference wires Out@GRAD to X@GRAD identically in
+the transpiler's backward rewrite); here the quantize ops register an identity
+grad maker so append_backward handles quantized programs unchanged. TPU note:
+values stay in float with quantization *simulated* (round-to-level), which is
+exactly the reference's training-time behavior; true int8 serving is the
+freeze step of the transpiler.
+"""
+
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _identity_grad(slot_in="X", slot_out="Out"):
+    def maker(op, block, grad_map):
+        return [
+            {
+                "type": "assign",
+                "inputs": {"X": [grad_map[op.output(slot_out)[0]]]},
+                "outputs": {"Out": [grad_map[op.input(slot_in)[0]]]},
+                "attrs": {},
+            }
+        ]
+
+    return maker
+
+
+def _quant_levels(bit_length):
+    return float((1 << (int(bit_length) - 1)) - 1)
+
+
+@register("fake_quantize_abs_max", grad=_identity_grad())
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    """Out = round(X / scale * s) where scale = max|X|, s = 2^(bits-1)-1
+    (reference fake_quantize_op.cc FakeQuantizeAbsMaxOp)."""
+    (x,) = ins["X"]
+    s = _quant_levels(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    scale = jnp.where(scale == 0, jnp.ones_like(scale), scale)
+    out = jnp.round(x / scale * s)
+    return {"Out": [out], "OutScale": [scale]}
+
+
+@register("fake_quantize_range_abs_max", grad=_identity_grad())
+def _fake_quantize_range_abs_max(ctx, ins, attrs):
+    """Training: scale = max(|X|, decayed running scale); inference: scale =
+    InScale (reference FakeQuantizeRangeAbsMaxOp; the window of the reference
+    becomes an exponential moving max — same fixed-point, no host-side window
+    buffer, which would be a dynamic gather under jit)."""
+    (x,) = ins["X"]
+    s = _quant_levels(attrs.get("bit_length", 8))
+    in_scale = ins["InScale"][0] if ins.get("InScale") else None
+    if attrs.get("is_test", False) and in_scale is not None:
+        scale = jnp.reshape(in_scale, ())
+    else:
+        cur = jnp.max(jnp.abs(x))
+        if in_scale is not None:
+            prev = jnp.reshape(in_scale, ())
+            scale = jnp.maximum(cur, 0.9 * prev)
+        else:
+            scale = cur
+    scale = jnp.where(scale == 0, jnp.ones_like(scale), scale)
+    out = jnp.clip(jnp.round(x / scale * s), -s, s)
+    return {"Out": [out], "OutScale": [jnp.reshape(scale, (1,))]}
+
+
+@register("fake_dequantize_max_abs", grad=_identity_grad())
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    """Out = X * scale / max_range (reference fake_dequantize_op.cc)."""
+    (x,) = ins["X"]
+    (scale,) = ins["Scale"]
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": [x * (jnp.reshape(scale, ()) / max_range)]}

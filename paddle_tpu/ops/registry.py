@@ -55,6 +55,7 @@ class OpDef:
         no_grad=False,
         stochastic=False,
         skip_exec=False,
+        host_fn=None,
     ):
         self.type = type
         self.lower = lower
@@ -65,6 +66,15 @@ class OpDef:
         self.no_grad = no_grad
         self.stochastic = stochastic
         self.skip_exec = skip_exec  # executor/infer ignore (feed/fetch markers)
+        # host ops run OUTSIDE the jitted computation, between XLA segments
+        # (RPC send/recv, listen_and_serv, checkpoint notify — the reference's
+        # non-kernel OperatorBase ops, SURVEY.md §2.7). Signature:
+        # host_fn(op, scope). The executor partitions the block at host ops.
+        self.host_fn = host_fn
+
+    @property
+    def is_host(self):
+        return self.host_fn is not None
 
 
 OPS = {}
@@ -82,6 +92,17 @@ def register(type, **kwargs):
 
 def register_no_lower(type, **kwargs):
     OPS[type] = OpDef(type, lower=None, skip_exec=True, **kwargs)
+
+
+def register_host(type, **kwargs):
+    """Decorator: @register_host("send") def run(op, scope): ... Host ops are
+    no-grad and contribute no shape inference."""
+
+    def deco(fn):
+        OPS[type] = OpDef(type, lower=None, no_grad=True, host_fn=fn, **kwargs)
+        return fn
+
+    return deco
 
 
 def get(type):

@@ -1,0 +1,17 @@
+"""Program→program rewrites (reference python/paddle/fluid/transpiler/):
+DistributeTranspiler (pserver + collective modes), memory_optimize,
+InferenceTranspiler, QuantizeTranspiler, Bf16Transpiler (float16 analog).
+"""
+
+from .bf16_transpiler import Bf16Transpiler, Float16Transpiler  # noqa: F401
+from .distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)
+from .inference_transpiler import InferenceTranspiler  # noqa: F401
+from .memory_optimization_transpiler import (  # noqa: F401
+    memory_optimize,
+    release_memory,
+)
+from .ps_dispatcher import HashName, PSDispatcher, RoundRobin  # noqa: F401
+from .quantize_transpiler import QuantizeTranspiler  # noqa: F401

