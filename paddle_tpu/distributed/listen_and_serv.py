@@ -91,6 +91,27 @@ def run_pserver(op, scope):
                     runners[bid].run()
 
     def on_get(name, trainer_id):
+        if name.startswith("__checkpoint__:"):
+            # RequestCheckpointHandler (request_handler_impl.h:103): persist
+            # this shard's vars under the trainer-provided dir, outside the
+            # barrier protocol so a notify can land mid-round
+            ckpt_dir = name.split(":", 1)[1]
+            if not ckpt_dir:
+                return None  # var-less reply → client raises instead of
+                # reporting a checkpoint that was never written
+            import os
+
+            os.makedirs(ckpt_dir, exist_ok=True)
+            with state_lock:
+                for vname, val in list(scope.vars.items()):
+                    if val is not None:
+                        np.save(
+                            os.path.join(
+                                ckpt_dir, vname.replace("/", "__") + ".npy"
+                            ),
+                            np.asarray(val),
+                        )
+            return np.ones((1,), np.int64)
         if sync_mode:
             # serve only after this trainer's current round was optimized
             want = server.barrier_counts[SEND_BARRIER].get(trainer_id, 0)
@@ -111,19 +132,21 @@ def run_pserver(op, scope):
             while True:
                 if not server.wait_barrier(SEND_BARRIER, rnd):
                     break
+                # state_lock covers the scope mutations too, so a concurrent
+                # checkpoint snapshot never sees torn mid-update params
                 with state_lock:
-                    grads, staged_now = dict(staged), staged
-                    staged_now.clear()
-                for g, arr in grads.items():
-                    # sync merge = sum over trainers, then the per-grad
-                    # optimize block (request_handler_impl.cc scope merge)
-                    scope.set_var(g, _to_device(arr))
-                if lr_runner is not None:
-                    lr_runner.run()
-                for g in grads:
-                    bid = grad_block.get(g)
-                    if bid is not None:
-                        runners[bid].run()
+                    grads = dict(staged)
+                    staged.clear()
+                    for g, arr in grads.items():
+                        # sync merge = sum over trainers, then the per-grad
+                        # optimize block (request_handler_impl.cc scope merge)
+                        scope.set_var(g, _to_device(arr))
+                    if lr_runner is not None:
+                        lr_runner.run()
+                    for g in grads:
+                        bid = grad_block.get(g)
+                        if bid is not None:
+                            runners[bid].run()
                 with ready:
                     optimized_rounds[0] = rnd + 1
                     ready.notify_all()

@@ -14,9 +14,11 @@ Frame layout (little-endian):
                  / COMPLETE / ACK)
   i32  trainer_id
   u16  len(varname), varname utf-8
-  u16  len(dtype str), dtype utf-8      (SEND_VAR / VAR_REPLY only)
-  u8   ndim, i64 × ndim dims            (SEND_VAR / VAR_REPLY only)
-  u64  payload byte length, payload     (SEND_VAR / VAR_REPLY only)
+  u16  len(dtype str), dtype utf-8      (SEND_VAR / VAR_REPLY only; 0 marks a
+                                         var-less frame and ends it — the
+                                         "unknown var" reply)
+  u8   ndim, i64 × ndim dims            (SEND_VAR / VAR_REPLY, dtype len > 0)
+  u64  payload byte length, payload     (SEND_VAR / VAR_REPLY, dtype len > 0)
 """
 
 import socket
@@ -56,8 +58,8 @@ def serialize_var(kind, trainer_id, name, array=None):
         payload = arr.tobytes()  # the single host copy
         parts.append(_U64.pack(len(payload)))
         parts.append(payload)
-    else:
-        parts.append(_U64.pack(0) if kind in (SEND_VAR, VAR_REPLY) else b"")
+    elif kind in (SEND_VAR, VAR_REPLY):
+        parts.append(_U16.pack(0))  # zero dtype length = var-less frame
     return b"".join(parts)
 
 
@@ -88,8 +90,6 @@ def read_frame(sock):
             (plen,) = _U64.unpack(_recv_exact(sock, 8))
             payload = _recv_exact(sock, plen)
             arr = np.frombuffer(payload, dtype=dtype).reshape(dims)
-        else:
-            _U64.unpack(_recv_exact(sock, 8))
     return kind, trainer_id, name, arr
 
 
@@ -113,17 +113,27 @@ class RPCClient:
         self.timeout = timeout
         self._socks = {}
         self._sock_locks = {}
+        self._connect_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=8)
         self._futures = []
 
     def _sock(self, endpoint):
-        if endpoint not in self._socks:
-            host, port = endpoint.rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=self.timeout)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._socks[endpoint] = s
-            self._sock_locks[endpoint] = threading.Lock()
-        return self._socks[endpoint], self._sock_locks[endpoint]
+        # pool workers race to first-connect an endpoint; a per-endpoint
+        # connect lock serializes creation without letting one slow/dead
+        # endpoint's connect stall RPCs to every other endpoint
+        try:
+            return self._socks[endpoint], self._sock_locks[endpoint]
+        except KeyError:
+            pass
+        with self._connect_lock:
+            ep_lock = self._sock_locks.setdefault(endpoint, threading.Lock())
+        with ep_lock:
+            if endpoint not in self._socks:
+                host, port = endpoint.rsplit(":", 1)
+                s = socket.create_connection((host, int(port)), timeout=self.timeout)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._socks[endpoint] = s
+            return self._socks[endpoint], ep_lock
 
     def _rpc(self, endpoint, frame, want_reply):
         sock, lock = self._sock(endpoint)

@@ -77,10 +77,18 @@ def _checkpoint_notify(op, scope):
     """Ask each pserver to checkpoint its shards (reference
     checkpoint_notify_op.cc + RequestCheckpointHandler). Served over the same
     GET channel: the pserver saves on demand via its save hook if installed."""
+    ckpt_dir = op.attrs.get("dir", "")
+    if not ckpt_dir:
+        raise ValueError("checkpoint_notify requires a non-empty 'dir' attr")
     client = _client(op)
-    for ep in op.attrs.get("epmap", op.attrs.get("endpoints", [])):
-        client.async_get_var(ep, "__checkpoint__:%s" % op.attrs.get("dir", ""))
+    futures = [
+        (ep, client.async_get_var(ep, "__checkpoint__:%s" % ckpt_dir))
+        for ep in op.attrs.get("epmap", op.attrs.get("endpoints", []))
+    ]
     client.wait()
+    for ep, f in futures:
+        if f.result() is None:
+            raise RuntimeError("pserver %s failed to checkpoint to %r" % (ep, ckpt_dir))
 
 
 @register_host("fake_init")

@@ -88,21 +88,43 @@ class Bf16Transpiler:
                         else:
                             cast_names.append(n)
                     op.inputs[slot] = cast_names
-                # outputs stay f32-typed
-                for out in op.output_arg_names:
-                    if out in flipped:
-                        block.var(out).dtype = "float32"
-                        flipped.discard(out)
+                # the op computes in f32: route each flipped output through an
+                # f32 temp, then cast back down so downstream ops see the bf16
+                # value their var annotation promises (without this, f32
+                # silently propagates through the rest of the network)
+                post_casts = []
+                for slot, names in list(op.outputs.items()):
+                    out_names = []
+                    for out in names:
+                        if out in flipped:
+                            f32 = out + ".f32out"
+                            if not block.has_var(f32):
+                                v = block.var(out)
+                                block.create_var(
+                                    name=f32, shape=v.shape, dtype="float32"
+                                )
+                            post_casts.append(
+                                Operator(
+                                    block,
+                                    "cast",
+                                    inputs={"X": [f32]},
+                                    outputs={"Out": [out]},
+                                    attrs={
+                                        "in_dtype": "float32",
+                                        "out_dtype": "bfloat16",
+                                        OpRole.OP_ROLE_KEY: OpRole.Forward,
+                                    },
+                                )
+                            )
+                            out_names.append(f32)
+                        else:
+                            out_names.append(out)
+                    op.outputs[slot] = out_names
                 new_ops.append(op)
-                # downstream non-blacklisted consumers expect bf16: insert a
-                # lazy cast only when a flipped-input op consumes this output
+                new_ops.extend(post_casts)
                 continue
             new_ops.append(op)
         block.ops = new_ops
-
-        # reconcile dtype boundaries: any op consuming a mix is fine — the
-        # lowerings promote like NumPy — but casts at f32→bf16 boundaries are
-        # inserted so the propagated program stays canonically typed
         program._bump_version()
         return program
 

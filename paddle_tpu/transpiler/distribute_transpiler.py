@@ -182,8 +182,18 @@ class DistributeTranspiler:
             role = op.attrs.get(OpRole.OP_ROLE_KEY, OpRole.Forward)
             if op.type in OPTIMIZER_OP_TYPES and role & OpRole.Optimize:
                 pg = op.attrs.get(OpRole.OP_ROLE_VAR_KEY) or []
-                if len(pg) >= 2:
-                    self.param_grad_pairs.append((pg[0], pg[1]))
+                if len(pg) < 2:
+                    # an update op we can't attribute to a (param, grad) pair
+                    # cannot be placed on a pserver shard; keeping it would
+                    # misalign the pair<->op zip below and apply the wrong
+                    # update rule to every later param
+                    raise ValueError(
+                        "optimizer op %r lacks the (param, grad) op_role_var "
+                        "attr; build it via optimizer.minimize / "
+                        "_optimized_guard so the transpiler can place it"
+                        % op.type
+                    )
+                self.param_grad_pairs.append((pg[0], pg[1]))
                 opt_op_indices.append(i)
             elif role == OpRole.LRSched:
                 self.lr_ops.append(op)

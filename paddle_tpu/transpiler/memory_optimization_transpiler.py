@@ -28,17 +28,6 @@ _SKIP_OP_TYPES = frozenset(
 )
 
 
-def _numel(shape):
-    if shape is None:
-        return None
-    n = 1
-    for d in shape:
-        if d is None or d < 0:
-            return None  # dynamic — not reusable
-        n *= d
-    return n
-
-
 class _Liveness:
     """Backward liveness over the straight-line op list (the reference's
     ControlFlowGraph restricted to block 0, which is where it applies it)."""
@@ -77,11 +66,18 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False, level=0):
             protected.update(op.output_arg_names)
 
     liveness = _Liveness(block, protected)
-    free_pool = {}  # (dtype, numel) -> [buffer names free for reuse]
+    free_pool = {}  # (dtype, shape) -> [buffer names free for reuse]
     mapping = {}  # original var name -> buffer name it now occupies
+    occupants = {}  # buffer name -> set of original names mapped onto it
 
     def pool_key(v):
-        return (v.dtype, _numel(v.shape))
+        # Exact dtype+shape match, with a dynamic (-1) dim allowed: two vars
+        # whose static shapes are identical occupy equal-size buffers at
+        # runtime even when the batch dim is symbolic (the reference compares
+        # shapes the same way, memory_optimization_transpiler.py:150-163).
+        if v.shape is None:
+            return None
+        return (v.dtype, tuple(v.shape))
 
     for i, op in enumerate(block.ops):
         # inputs were defined earlier — apply their renames
@@ -92,24 +88,27 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False, level=0):
             if out in protected or out in mapping or not block.has_var(out):
                 continue
             key = pool_key(block.var(out))
-            if key[1] is None:
+            if key is None:
                 continue
             candidates = free_pool.get(key)
             if candidates:
-                mapping[out] = candidates.pop()
+                buf = candidates.pop()
+                mapping[out] = buf
+                occupants.setdefault(buf, set()).add(out)
         for slot, names in op.outputs.items():
             op.outputs[slot] = [mapping.get(n, n) for n in names]
         # original vars whose live range ends here free their buffer
         live = liveness.live_after[i]
         for name in set(op.input_arg_names) | set(op.output_arg_names):
-            # `name` is a buffer name; find if any original still maps to it
-            originals = [o for o, b in mapping.items() if b == name] or [name]
-            if any(o in live for o in originals):
+            # `name` is a buffer name; free only once every original mapped
+            # onto it (and itself) is dead
+            originals = occupants.get(name) or (name,)
+            if name in live or any(o in live for o in originals):
                 continue
             if name in protected or not block.has_var(name):
                 continue
             key = pool_key(block.var(name))
-            if key[1] is None:
+            if key is None:
                 continue
             lst = free_pool.setdefault(key, [])
             if name not in lst:
@@ -129,13 +128,18 @@ def memory_optimize(input_program, skip_opt_set=None, print_log=False, level=0):
     if print_log:
         saved = 0
         for new, old in mapping.items():
-            v = block.vars.get(old)
-            if v is not None and _numel(v.shape):
-                saved += _numel(v.shape) * np.dtype(
-                    "float32" if v.dtype == "bfloat16" else v.dtype
-                ).itemsize
+            v = block.vars.get(old) or block.vars.get(new)
+            if v is None or v.shape is None:
+                continue
+            # product of known dims: per-sample bytes when batch dim is -1
+            n = 1
+            for d in v.shape:
+                n *= d if d and d > 0 else 1
+            saved += n * np.dtype(
+                "float32" if v.dtype == "bfloat16" else v.dtype
+            ).itemsize
         print(
-            "memory_optimize: reused %d buffers (~%.1f KB host-visible)"
+            "memory_optimize: reused %d buffers (~%.1f KB/sample host-visible)"
             % (len(mapping), saved / 1024.0)
         )
     return mapping

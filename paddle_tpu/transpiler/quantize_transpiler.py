@@ -16,6 +16,7 @@ import numpy as np
 
 from .. import framework
 from ..framework import Operator, OpRole
+from ..ops.quant_ops import _quant_levels
 
 __all__ = ["QuantizeTranspiler"]
 
@@ -99,7 +100,7 @@ class QuantizeTranspiler:
         src = qout.name
         # chain a dequant per input scale: x * (s1/r) * (s2/r) — the
         # reference folds the product the same way for mul/conv
-        max_range = float((1 << (self.activation_bits - 1)) - 1)
+        max_range = _quant_levels(self.activation_bits)
         for i, s in enumerate(scale_names):
             dst = out if i == len(scale_names) - 1 else block.create_var(
                 name="%s.deq%d" % (out, i), shape=v.shape, dtype=v.dtype
@@ -128,7 +129,7 @@ class QuantizeTranspiler:
         import jax.numpy as jnp
 
         block = program.global_block()
-        levels = float((1 << (self.weight_bits - 1)) - 1)
+        levels = _quant_levels(self.weight_bits)
         frozen = {}
         keep_ops = []
         rename = {}  # old input name -> replacement

