@@ -99,18 +99,20 @@ def run_pserver(op, scope):
             if not ckpt_dir:
                 return None  # var-less reply → client raises instead of
                 # reporting a checkpoint that was never written
-            import os
+            from .. import io as fluid_io
 
-            os.makedirs(ckpt_dir, exist_ok=True)
+            # jax arrays are immutable and set_var only rebinds names, so a
+            # dict snapshot under the lock is a consistent checkpoint; the
+            # device→host copies and disk writes run outside it so concurrent
+            # sends/optimize rounds don't stall on I/O. Grad staging vars
+            # (`*@GRAD`) are transient — skip them, like save_persistables.
             with state_lock:
-                for vname, val in list(scope.vars.items()):
-                    if val is not None:
-                        np.save(
-                            os.path.join(
-                                ckpt_dir, vname.replace("/", "__") + ".npy"
-                            ),
-                            np.asarray(val),
-                        )
+                snapshot = {
+                    vname: val
+                    for vname, val in scope.vars.items()
+                    if val is not None and "@" not in vname
+                }
+            fluid_io.save_arrays(ckpt_dir, snapshot)
             return np.ones((1,), np.int64)
         if sync_mode:
             # serve only after this trainer's current round was optimized

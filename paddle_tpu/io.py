@@ -42,6 +42,25 @@ def _bf16_safe_save(arr):
     return a, None
 
 
+def save_arrays(dirname, arrays):
+    """bf16-safe per-var np.save of a name->array dict, with the same
+    `<name>.npy` + `__dtypes__.json` layout load_vars reads. Shared with the
+    pserver checkpoint handler (distributed/listen_and_serv.py) so shard
+    checkpoints are restorable by the normal loaders."""
+    os.makedirs(dirname, exist_ok=True)
+    meta = {}
+    for name, val in arrays.items():
+        arr, orig_dtype = _bf16_safe_save(val)
+        if orig_dtype:
+            meta[name] = orig_dtype
+        path = os.path.join(dirname, name + ".npy")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        np.save(path, arr)
+    if meta:
+        with open(os.path.join(dirname, "__dtypes__.json"), "w") as f:
+            json.dump(meta, f)
+
+
 def save_vars(
     executor,
     dirname,
@@ -56,25 +75,27 @@ def save_vars(
         vars = [v for v in program.list_vars() if predicate is None or predicate(v)]
     scope = global_scope()
     os.makedirs(dirname, exist_ok=True)
-    combined = {}
-    meta = {}
+    arrays = {}
     for v in vars:
         name = v.name if isinstance(v, Variable) else str(v)
         val = scope.find_var(name)
         if val is None:
             raise RuntimeError("variable %r has no value in scope; run startup first" % name)
-        arr, orig_dtype = _bf16_safe_save(val)
-        if orig_dtype:
-            meta[name] = orig_dtype
-        if filename is None:
-            np.save(os.path.join(dirname, name + ".npy"), arr)
-        else:
+        arrays[name] = val
+    if filename is None:
+        save_arrays(dirname, arrays)
+    else:
+        combined = {}
+        meta = {}
+        for name, val in arrays.items():
+            arr, orig_dtype = _bf16_safe_save(val)
+            if orig_dtype:
+                meta[name] = orig_dtype
             combined[name] = arr
-    if filename is not None:
         np.savez(os.path.join(dirname, filename), **combined)
-    if meta:
-        with open(os.path.join(dirname, "__dtypes__.json"), "w") as f:
-            json.dump(meta, f)
+        if meta:
+            with open(os.path.join(dirname, "__dtypes__.json"), "w") as f:
+                json.dump(meta, f)
 
 
 def _is_param(v):

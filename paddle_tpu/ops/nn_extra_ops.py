@@ -1,0 +1,598 @@
+"""Secondary NN / vision / tensor ops completing reference op-registry parity.
+
+Reference analogs (paddle/fluid/operators/): conv3d_op.cc, pool_op.cc (pool3d),
+pool_with_index_op.{cc,h} + math/pooling.cc:552 (mask = global h*W+w index),
+unpool_op.cc + math/unpooling.cc:39 (scatter by global index), spp_op.h:31-51
+(pow-of-2 pyramid with ceil kernels), maxout_op.cc + math/maxouting.cc,
+group_norm_op.cc, affine_channel_op.cc, bilinear_tensor_product_op.h,
+grid_sampler_op.h:34-80 (corners zeroed out of bounds, coords scaled by
+(g+1)*0.5*(dim-1)), affine_grid_op.cc, minus_op.cc, l1_norm_op.h,
+squared_l2_distance_op.h, selu_op.cc, fill_op.cc, is_empty_op.cc,
+multiplex_op.cc, crop_op.cc, pad_constant_like_op.cc, random_crop_op.h,
+space_to_depth_op.h:39-57 (channel order (bh, bw, c)), conv_shift_op.cc
+(circular correlation), add_position_encoding_op.h:63-76 (half sin / half
+cos), mean_iou_op.h:92-110, similarity_focus_op.h:29-130 (greedy row/col
+unique selection per selected channel).
+
+All lowerings are whole-block XLA ops; gradients come from the registry's
+generic jax.vjp derivation except where a custom grad reuses a saved index
+(max-pool masks), matching the reference's Mask-based grad kernels.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+
+def _norm_list(v, n, default):
+    if v is None:
+        v = default
+    v = [int(x) for x in (v if isinstance(v, (list, tuple)) else [v])]
+    if len(v) == 1:
+        v = v * n
+    return v
+
+
+def _conv_nd(x, w, attrs, nd, transpose=False, depthwise_groups=None):
+    strides = _norm_list(attrs.get("strides"), nd, [1] * nd)
+    paddings = _norm_list(attrs.get("paddings"), nd, [0] * nd)
+    dilations = _norm_list(attrs.get("dilations"), nd, [1] * nd)
+    groups = int(depthwise_groups or attrs.get("groups", 1) or 1)
+    sp = "DHW"[-nd:]
+    if not transpose:
+        return lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=strides,
+            padding=[(p, p) for p in paddings],
+            rhs_dilation=dilations,
+            dimension_numbers=("NC" + sp, "OI" + sp, "NC" + sp),
+            feature_group_count=groups,
+        )
+    # Transposed conv with group support: fractionally-strided conv
+    # (lhs_dilation) against the spatially-flipped, IO-swapped kernel. The
+    # paddle filter layout for conv_transpose is (C_in, C_out/groups, *k).
+    k = w.shape[2:]
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    if groups > 1:
+        # (C_in, C_out/g, *k) -> g * (C_in/g, C_out/g, *k) -> (C_out, C_in/g, *k)
+        cin = w.shape[0]
+        w = w.reshape((groups, cin // groups) + w.shape[1:])
+        w = jnp.moveaxis(w, 2, 1).reshape((-1, cin // groups) + k)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    pad = [
+        (dilations[i] * (k[i] - 1) - paddings[i], dilations[i] * (k[i] - 1) - paddings[i])
+        for i in range(nd)
+    ]
+    return lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=[1] * nd,
+        padding=pad,
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NC" + sp, "OI" + sp, "NC" + sp),
+        feature_group_count=groups,
+    )
+
+
+def _pool_nd(x, attrs, nd):
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _norm_list(attrs.get("ksize"), nd, [2] * nd)
+    strides = _norm_list(attrs.get("strides"), nd, ksize)
+    paddings = _norm_list(attrs.get("paddings"), nd, [0] * nd)
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+        strides = ksize
+        paddings = [0] * nd
+    window = (1, 1) + tuple(ksize)
+    strd = (1, 1) + tuple(strides)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strd, pads)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strd, pads)
+    if attrs.get("exclusive", True) and any(paddings):
+        cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strd, pads)
+        return s / cnt
+    return s / float(np.prod(ksize))
+
+
+def _window_stack(x, ksize, strides, paddings, pad_value):
+    """Stack pooling windows: (N, C, *S) -> (N, C, prod(k), *out), plus the
+    per-window-element global flat spatial index of each sample."""
+    nd = len(ksize)
+    spatial = x.shape[2:]
+    out = [
+        (spatial[i] + 2 * paddings[i] - ksize[i]) // strides[i] + 1 for i in range(nd)
+    ]
+    xp = jnp.pad(
+        x,
+        ((0, 0), (0, 0)) + tuple((p, p) for p in paddings),
+        constant_values=pad_value,
+    )
+    slabs, gidx = [], []
+    for offs in itertools.product(*[range(k) for k in ksize]):
+        idx = (slice(None), slice(None)) + tuple(
+            slice(offs[i], offs[i] + (out[i] - 1) * strides[i] + 1, strides[i])
+            for i in range(nd)
+        )
+        slabs.append(xp[idx])
+        # global index of this window element at each output position
+        coord = [
+            jnp.arange(out[i]) * strides[i] - paddings[i] + offs[i] for i in range(nd)
+        ]
+        flat = coord[0]
+        for i in range(1, nd):
+            flat = flat[..., None] * spatial[i] + coord[i]
+        gidx.append(flat)
+    return jnp.stack(slabs, axis=2), jnp.stack(gidx, axis=0), out
+
+
+def _max_pool_with_index(ctx, ins, attrs, nd):
+    (x,) = ins["X"]
+    ksize = _norm_list(attrs.get("ksize"), nd, [2] * nd)
+    strides = _norm_list(attrs.get("strides"), nd, ksize)
+    paddings = _norm_list(attrs.get("paddings"), nd, [0] * nd)
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+        strides = ksize
+        paddings = [0] * nd
+    win, gidx, out = _window_stack(x, ksize, strides, paddings, -jnp.inf)
+    amax = jnp.argmax(win, axis=2)
+    val = jnp.max(win, axis=2)
+    # gidx is (K, *out) shared across N,C: pick the winning window element's
+    # global spatial index per flattened output position
+    gflat = gidx.reshape(gidx.shape[0], -1)  # (K, P)
+    aflat = amax.reshape(amax.shape[0], amax.shape[1], -1)  # (N, C, P)
+    mask = gflat[aflat, jnp.arange(gflat.shape[1])[None, None, :]].reshape(val.shape)
+    return {"Out": [val], "Mask": [mask.astype(jnp.int32)]}
+
+
+def _mask_scatter_grad(dout, mask, spatial_numel):
+    """Scatter pooled grads back through saved global indices (reference
+    math/pooling.cc MaxPool2dWithIndexGradFunctor)."""
+    n, c = dout.shape[:2]
+    d2 = dout.reshape(n * c, -1)
+    m2 = mask.reshape(n * c, -1)
+
+    def scat(g, m):
+        return jnp.zeros((spatial_numel,), g.dtype).at[m].add(g)
+
+    return jax.vmap(scat)(d2, m2)
+
+
+def _pool_index_grad_maker(op, block, grad_map):
+    return [
+        {
+            "type": op.type + "_grad",
+            "inputs": {
+                "X": [op.input("X")[0]],
+                "Mask": [op.output("Mask")[0]],
+                "Out@GRAD": [grad_map[op.output("Out")[0]]],
+            },
+            "outputs": {"X@GRAD": [grad_map[op.input("X")[0]]]},
+            "attrs": dict(op.attrs),
+        }
+    ]
+
+
+# ---------------------------------------------------------------------------
+# conv3d / pool3d family
+# ---------------------------------------------------------------------------
+
+
+@register("conv3d")
+def _conv3d(ctx, ins, attrs):
+    out = _conv_nd(ins["Input"][0], ins["Filter"][0], attrs, 3)
+    return {"Output": [out]}
+
+
+@register("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    out = _conv_nd(ins["Input"][0], ins["Filter"][0], attrs, 3, transpose=True)
+    return {"Output": [out]}
+
+
+@register("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(ctx, ins, attrs):
+    x = ins["Input"][0]
+    out = _conv_nd(x, ins["Filter"][0], attrs, 2, transpose=True)
+    return {"Output": [out]}
+
+
+@register("pool3d")
+def _pool3d(ctx, ins, attrs):
+    return {"Out": [_pool_nd(ins["X"][0], attrs, 3)]}
+
+
+@register("max_pool2d_with_index", grad=_pool_index_grad_maker)
+def _max_pool2d_with_index(ctx, ins, attrs):
+    return _max_pool_with_index(ctx, ins, attrs, 2)
+
+
+@register("max_pool3d_with_index", grad=_pool_index_grad_maker)
+def _max_pool3d_with_index(ctx, ins, attrs):
+    return _max_pool_with_index(ctx, ins, attrs, 3)
+
+
+@register("max_pool2d_with_index_grad", no_grad=True)
+def _max_pool2d_with_index_grad(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (mask,) = ins["Mask"]
+    (dout,) = ins["Out@GRAD"]
+    flat = _mask_scatter_grad(dout, mask, int(np.prod(x.shape[2:])))
+    return {"X@GRAD": [flat.reshape(x.shape)]}
+
+
+@register("max_pool3d_with_index_grad", no_grad=True)
+def _max_pool3d_with_index_grad(ctx, ins, attrs):
+    return _max_pool2d_with_index_grad(ctx, ins, attrs)
+
+
+@register("unpool")
+def _unpool(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (indices,) = ins["Indices"]
+    ksize = _norm_list(attrs.get("ksize"), 2, [2, 2])
+    strides = _norm_list(attrs.get("strides"), 2, ksize)
+    paddings = _norm_list(attrs.get("paddings"), 2, [0, 0])
+    n, c, h, w = x.shape
+    oh = (h - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    ow = (w - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    x2 = x.reshape(n * c, -1)
+    i2 = indices.reshape(n * c, -1)
+
+    def scat(v, m):
+        return jnp.zeros((oh * ow,), v.dtype).at[m].set(v)
+
+    out = jax.vmap(scat)(x2, i2).reshape(n, c, oh, ow)
+    return {"Out": [out]}
+
+
+@register("spp")
+def _spp(ctx, ins, attrs):
+    (x,) = ins["X"]
+    height = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    pieces = []
+    for p in range(height):
+        bins = 2**p
+        kh = -(-h // bins)
+        kw = -(-w // bins)
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        pooled = _pool_nd(
+            x,
+            {
+                "pooling_type": ptype,
+                "ksize": [kh, kw],
+                "strides": [kh, kw],
+                "paddings": [ph, pw],
+                "exclusive": False,
+            },
+            2,
+        )
+        pieces.append(pooled.reshape(n, -1))
+    return {"Out": [jnp.concatenate(pieces, axis=1)]}
+
+
+@register("maxout")
+def _maxout(ctx, ins, attrs):
+    (x,) = ins["X"]
+    g = int(attrs["groups"])
+    n, c = x.shape[:2]
+    out = x.reshape((n, c // g, g) + x.shape[2:]).max(axis=2)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# normalization / channel transforms
+# ---------------------------------------------------------------------------
+
+
+@register("group_norm")
+def _group_norm(ctx, ins, attrs):
+    (x,) = ins["X"]
+    eps = float(attrs.get("epsilon", 1e-5))
+    groups = int(attrs.get("groups", 1))
+    n, c = x.shape[:2]
+    xg = x.reshape(n, groups, -1).astype(jnp.float32)
+    mean = xg.mean(axis=2)
+    var = xg.var(axis=2)
+    y = (xg - mean[:, :, None]) * lax.rsqrt(var[:, :, None] + eps)
+    y = y.reshape(x.shape)
+    cshape = (1, c) + (1,) * (x.ndim - 2)
+    if "Scale" in ins:
+        y = y * ins["Scale"][0].reshape(cshape)
+    if "Bias" in ins:
+        y = y + ins["Bias"][0].reshape(cshape)
+    return {"Y": [y.astype(x.dtype)], "Mean": [mean], "Variance": [var]}
+
+
+@register("affine_channel")
+def _affine_channel(ctx, ins, attrs):
+    (x,) = ins["X"]
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    cshape = [1] * x.ndim
+    cshape[c_axis] = x.shape[c_axis]
+    out = x * ins["Scale"][0].reshape(cshape) + ins["Bias"][0].reshape(cshape)
+    return {"Out": [out]}
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    (w,) = ins["Weight"]
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if "Bias" in ins:
+        out = out + ins["Bias"][0].reshape(1, -1)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# spatial samplers
+# ---------------------------------------------------------------------------
+
+
+@register("grid_sampler")
+def _grid_sampler(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (grid,) = ins["Grid"]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * 0.5 * (w - 1)
+    gy = (grid[..., 1] + 1.0) * 0.5 * (h - 1)
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    out = jnp.zeros((n, c) + grid.shape[1:3], x.dtype)
+    batch = jnp.arange(n).reshape(n, 1, 1)
+    for dx, dy in ((0, 0), (0, 1), (1, 0), (1, 1)):
+        xs = x0 + dx
+        ys = y0 + dy
+        wgt = (1.0 - jnp.abs(gx - xs)) * (1.0 - jnp.abs(gy - ys))
+        inb = (xs >= 0) & (xs <= w - 1) & (ys >= 0) & (ys <= h - 1)
+        xi = jnp.clip(xs, 0, w - 1).astype(jnp.int32)
+        yi = jnp.clip(ys, 0, h - 1).astype(jnp.int32)
+        v = x[batch, :, yi, xi]  # (n, gh, gw, c)
+        v = jnp.moveaxis(v, -1, 1)
+        out = out + v * (wgt * inb)[:, None]
+    return {"Output": [out]}
+
+
+@register("affine_grid")
+def _affine_grid(ctx, ins, attrs):
+    (theta,) = ins["Theta"]
+    if "OutputShape" in ins and ins["OutputShape"][0] is not None:
+        oshape = [int(d) for d in np.asarray(ins["OutputShape"][0])]
+    else:
+        oshape = [int(d) for d in attrs["output_shape"]]
+    n, _, h, w = oshape
+    xs = jnp.linspace(-1.0, 1.0, w)
+    ys = jnp.linspace(-1.0, 1.0, h)
+    gx, gy = jnp.meshgrid(xs, ys)  # (h, w)
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (h, w, 3)
+    out = jnp.einsum("hwk,nck->nhwc", base, theta.astype(jnp.float32))
+    return {"Output": [out.astype(theta.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# small math / tensor ops
+# ---------------------------------------------------------------------------
+
+
+@register("minus")
+def _minus(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    return {"Out": [jnp.abs(ins["X"][0]).sum().reshape(1)]}
+
+
+@register("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    if y.shape[0] == 1 and x.shape[0] > 1:
+        y = jnp.broadcast_to(y, x.shape)
+    sub = x - y
+    out = jnp.square(sub.reshape(sub.shape[0], -1)).sum(axis=1, keepdims=True)
+    return {"sub_result": [sub], "Out": [out]}
+
+
+@register("selu")
+def _selu(ctx, ins, attrs):
+    (x,) = ins["X"]
+    scale = float(attrs.get("scale", 1.0507009873554804934193349852946))
+    alpha = float(attrs.get("alpha", 1.6732632423543772848170429916717))
+    return {"Out": [scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))]}
+
+
+@register("fill", no_grad=True)
+def _fill(ctx, ins, attrs):
+    shape = [int(d) for d in attrs["shape"]]
+    dtype = attrs.get("dtype", "float32")
+    value = np.asarray(attrs["value"], dtype=np.float64).reshape(shape)
+    return {"Out": [jnp.asarray(value).astype(jnp.dtype(dtype))]}
+
+
+@register("is_empty", no_grad=True)
+def _is_empty(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [jnp.full((1,), x.size == 0, jnp.bool_)]}
+
+
+@register("multiplex")
+def _multiplex(ctx, ins, attrs):
+    xs = ins["X"]
+    (ids,) = ins["Ids"]
+    stacked = jnp.stack(xs, axis=0)  # (k, n, ...)
+    rows = ids.reshape(-1).astype(jnp.int32)
+    return {"Out": [stacked[rows, jnp.arange(stacked.shape[1])]]}
+
+
+@register("crop")
+def _crop(ctx, ins, attrs):
+    (x,) = ins["X"]
+    if "Y" in ins and ins["Y"][0] is not None:
+        shape = list(ins["Y"][0].shape)
+    else:
+        shape = [int(d) for d in attrs["shape"]]
+    if "Offsets" in ins and ins["Offsets"][0] is not None:
+        offsets = [int(o) for o in np.asarray(ins["Offsets"][0])]
+    else:
+        offsets = [int(o) for o in attrs.get("offsets", [0] * x.ndim)]
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[idx]]}
+
+
+@register("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    val = float(attrs.get("pad_value", 0.0))
+    pads = [(0, x.shape[i] - y.shape[i]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(y, pads, constant_values=val)]}
+
+
+@register("random_crop", no_grad=True, stochastic=True)
+def _random_crop(ctx, ins, attrs):
+    (x,) = ins["X"]
+    shape = [int(d) for d in attrs["shape"]]
+    lead = x.ndim - len(shape)
+    key = ctx.next_rng()
+    starts = []
+    for i, s in enumerate(shape):
+        key, sub = jax.random.split(key)
+        hi = x.shape[lead + i] - s
+        starts.append(
+            jax.random.randint(sub, (), 0, hi + 1) if hi > 0 else jnp.int32(0)
+        )
+    idx = [jnp.int32(0)] * lead + starts
+    out = lax.dynamic_slice(x, idx, list(x.shape[:lead]) + shape)
+    outs = {"Out": [out]}
+    if "Seed" in ins and ins["Seed"][0] is not None:
+        outs["SeedOut"] = [ins["Seed"][0]]
+    return outs
+
+
+@register("space_to_depth")
+def _space_to_depth(ctx, ins, attrs):
+    (x,) = ins["X"]
+    b = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // b, b, w // b, b)
+    out = out.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * b * b, h // b, w // b)
+    return {"Out": [out]}
+
+
+@register("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    (x,) = ins["X"]  # (B, M)
+    (y,) = ins["Y"]  # (B, N), N odd, N <= M
+    m = x.shape[1]
+    nn = y.shape[1]
+    half = nn // 2
+    out = jnp.zeros_like(x)
+    for j in range(nn):
+        out = out + y[:, j : j + 1] * jnp.roll(x, half - j, axis=1)
+    return {"Out": [out]}
+
+
+@register("add_position_encoding")
+def _add_position_encoding(ctx, ins, attrs):
+    (x,) = ins["X"]  # (B, T, D)
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    b, t, d = x.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    k = jnp.arange(half, dtype=jnp.float32)[None, :]
+    denom = jnp.power(10000.0, k / (half - 1)) if half > 1 else jnp.ones_like(k)
+    val = pos / denom  # (T, half)
+    enc = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=1)  # (T, D)
+    return {"Out": [alpha * x + beta * enc[None].astype(x.dtype)]}
+
+
+@register("mean_iou", no_grad=True)
+def _mean_iou(ctx, ins, attrs):
+    (pred,) = ins["Predictions"]
+    (label,) = ins["Labels"]
+    nc = int(attrs["num_classes"])
+    p = pred.reshape(-1).astype(jnp.int32)
+    l = label.reshape(-1).astype(jnp.int32)
+    eq = p == l
+    correct = jnp.zeros((nc,), jnp.int32).at[jnp.where(eq, p, nc)].add(1, mode="drop")
+    wrong = (
+        jnp.zeros((nc,), jnp.int32)
+        .at[jnp.where(eq, nc, l)]
+        .add(1, mode="drop")
+        .at[jnp.where(eq, nc, p)]
+        .add(1, mode="drop")
+    )
+    for extra in ins.get("InCorrects", []) or []:
+        correct = correct + extra.astype(jnp.int32)
+    for extra in ins.get("InWrongs", []) or []:
+        wrong = wrong + extra.astype(jnp.int32)
+    denom = wrong + correct
+    valid = (denom > 0).sum()
+    iou_sum = (correct / jnp.maximum(denom, 1)).sum()
+    mean_iou = (iou_sum / valid).astype(jnp.float32).reshape(1)
+    for extra in ins.get("InMeanIou", []) or []:
+        mean_iou = mean_iou + extra
+    return {"OutMeanIou": [mean_iou], "OutWrong": [wrong], "OutCorrect": [correct]}
+
+
+@register("similarity_focus", no_grad=True)
+def _similarity_focus(ctx, ins, attrs):
+    (x,) = ins["X"]  # (N, d1, d2, d3)
+    axis = int(attrs["axis"])
+    indexes = [int(i) for i in attrs["indexes"]]
+    # move the focus axis to position 1; greedy selection runs on the
+    # remaining (a, b) plane
+    perm = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 3, 1, 2)}[axis]
+    xt = x.transpose(perm)
+    n, _, a, bdim = xt.shape
+    steps = min(a, bdim)
+
+    def one_slice(s):  # s: (a, b) -> mask (a, b) of greedily picked cells
+        def body(_, carry):
+            rowtag, coltag, sel = carry
+            masked = jnp.where(rowtag[:, None] | coltag[None, :], -jnp.inf, s)
+            flat = jnp.argmax(masked)
+            i, j = flat // bdim, flat % bdim
+            return rowtag.at[i].set(True), coltag.at[j].set(True), sel.at[i, j].set(True)
+
+        _, _, sel = lax.fori_loop(
+            0,
+            steps,
+            body,
+            (
+                jnp.zeros((a,), jnp.bool_),
+                jnp.zeros((bdim,), jnp.bool_),
+                jnp.zeros((a, bdim), jnp.bool_),
+            ),
+        )
+        return sel
+
+    mask = jnp.zeros((n, a, bdim), jnp.bool_)
+    for idx in indexes:
+        mask = mask | jax.vmap(one_slice)(xt[:, idx])
+    out = jnp.broadcast_to(mask[:, None], xt.shape).astype(x.dtype)
+    inv = np.argsort(perm)
+    return {"Out": [out.transpose(tuple(inv))]}

@@ -1,0 +1,551 @@
+"""Tests for the secondary NN/vision op tier (ops/nn_extra_ops.py), following
+the reference's per-op OpTest pattern (unittests/test_conv3d_op.py,
+test_pool_max_op.py, test_unpool_op.py, test_spp_op.py, test_maxout_op.py,
+test_group_norm_op.py, test_grid_sampler_op.py, test_similarity_focus_op.py…)
+with numpy reference implementations inline."""
+
+import itertools
+import unittest
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def np_conv3d(x, w, stride, pad):
+    n, cin, d, h, wd = x.shape
+    cout, _, kd, kh, kw = w.shape
+    od = (d + 2 * pad - kd) // stride + 1
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad), (pad, pad)))
+    out = np.zeros((n, cout, od, oh, ow), x.dtype)
+    for zi, yi, xi in itertools.product(range(od), range(oh), range(ow)):
+        patch = xp[
+            :,
+            :,
+            zi * stride : zi * stride + kd,
+            yi * stride : yi * stride + kh,
+            xi * stride : xi * stride + kw,
+        ]
+        out[:, :, zi, yi, xi] = np.tensordot(patch, w, axes=([1, 2, 3, 4], [1, 2, 3, 4]))
+    return out
+
+
+class TestConv3d(OpTest):
+    def setUp(self):
+        self.op_type = "conv3d"
+        x = np.random.rand(2, 3, 5, 5, 5).astype("float32")
+        w = np.random.rand(4, 3, 3, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2, 2], "paddings": [1, 1, 1]}
+        self.outputs = {"Output": np_conv3d(x, w, 2, 1)}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_check_grad(self):
+        self.check_grad(["Input", "Filter"], "Output", max_relative_error=0.03)
+
+
+class TestConv3dTranspose(OpTest):
+    def setUp(self):
+        self.op_type = "conv3d_transpose"
+        x = np.random.rand(2, 3, 4, 4, 4).astype("float32")
+        w = np.random.rand(3, 5, 3, 3, 3).astype("float32")  # (Cin, Cout, k...)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2, 2], "paddings": [1, 1, 1]}
+        # reference: out = (in-1)*s - 2p + k; check vs explicit scatter-accum
+        n, cin, d, h, wd = x.shape
+        _, cout, kd, kh, kw = w.shape
+        od = (d - 1) * 2 - 2 + kd
+        out = np.zeros((n, cout, od + 2, od + 2, od + 2), "float32")
+        for zi, yi, xi in itertools.product(range(d), range(h), range(wd)):
+            contrib = np.einsum("nc,cokij->nokij", x[:, :, zi, yi, xi], w)
+            out[
+                :,
+                :,
+                zi * 2 : zi * 2 + kd,
+                yi * 2 : yi * 2 + kh,
+                xi * 2 : xi * 2 + kw,
+            ] += contrib
+        out = out[:, :, 1 : 1 + od, 1 : 1 + od, 1 : 1 + od]
+        self.outputs = {"Output": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestDepthwiseConv2dTranspose(OpTest):
+    def setUp(self):
+        self.op_type = "depthwise_conv2d_transpose"
+        c = 3
+        x = np.random.rand(2, c, 4, 4).astype("float32")
+        w = np.random.rand(c, 1, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0], "groups": c}
+        n, _, h, wd = x.shape
+        out = np.zeros((n, c, h + 2, wd + 2), "float32")
+        for yi, xi in itertools.product(range(h), range(wd)):
+            out[:, :, yi : yi + 3, xi : xi + 3] += (
+                x[:, :, yi, xi][:, :, None, None] * w[None, :, 0]
+            )
+        self.outputs = {"Output": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestPool3dAvg(OpTest):
+    def setUp(self):
+        self.op_type = "pool3d"
+        x = np.random.rand(2, 3, 4, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2, 2], "strides": [2, 2, 2]}
+        out = x.reshape(2, 3, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7))
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestMaxPool2dWithIndex(OpTest):
+    def setUp(self):
+        self.op_type = "max_pool2d_with_index"
+        x = np.random.rand(2, 3, 6, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        n, c, h, w = x.shape
+        out = np.zeros((n, c, 3, 3), "float32")
+        mask = np.zeros((n, c, 3, 3), "int32")
+        for i, j in itertools.product(range(3), range(3)):
+            win = x[:, :, 2 * i : 2 * i + 2, 2 * j : 2 * j + 2].reshape(n, c, 4)
+            out[:, :, i, j] = win.max(-1)
+            am = win.argmax(-1)
+            mask[:, :, i, j] = (2 * i + am // 2) * w + (2 * j + am % 2)
+        self.outputs = {"Out": out, "Mask": mask}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestUnpool(OpTest):
+    def setUp(self):
+        self.op_type = "unpool"
+        x = np.random.rand(2, 3, 2, 2).astype("float32")
+        indices = np.stack(
+            [
+                np.random.choice(16, size=4, replace=False).reshape(2, 2)
+                for _ in range(6)
+            ]
+        ).reshape(2, 3, 2, 2).astype("int32")
+        self.inputs = {"X": x, "Indices": indices}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        out = np.zeros((2, 3, 16), "float32")
+        for n, c in itertools.product(range(2), range(3)):
+            out[n, c, indices[n, c].reshape(-1)] = x[n, c].reshape(-1)
+        self.outputs = {"Out": out.reshape(2, 3, 4, 4)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSpp(OpTest):
+    def setUp(self):
+        self.op_type = "spp"
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+        lvl0 = x.max(axis=(2, 3)).reshape(2, -1)
+        lvl1 = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5)).reshape(2, -1)
+        self.outputs = {"Out": np.concatenate([lvl0, lvl1], axis=1)}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestMaxout(OpTest):
+    def setUp(self):
+        self.op_type = "maxout"
+        x = np.random.rand(2, 6, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"groups": 2}
+        self.outputs = {"Out": x.reshape(2, 3, 2, 4, 4).max(axis=2)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestGroupNorm(OpTest):
+    def setUp(self):
+        self.op_type = "group_norm"
+        x = np.random.rand(2, 4, 3, 3).astype("float32")
+        scale = np.random.rand(4).astype("float32")
+        bias = np.random.rand(4).astype("float32")
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": 1e-5, "groups": 2}
+        xg = x.reshape(2, 2, -1)
+        mean = xg.mean(-1)
+        var = xg.var(-1)
+        y = (xg - mean[..., None]) / np.sqrt(var[..., None] + 1e-5)
+        y = y.reshape(x.shape) * scale.reshape(1, 4, 1, 1) + bias.reshape(1, 4, 1, 1)
+        self.outputs = {"Y": y, "Mean": mean, "Variance": var}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.02)
+
+
+class TestAffineChannel(OpTest):
+    def setUp(self):
+        self.op_type = "affine_channel"
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        scale = np.random.rand(3).astype("float32")
+        bias = np.random.rand(3).astype("float32")
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.outputs = {"Out": x * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], "Out")
+
+
+class TestBilinearTensorProduct(OpTest):
+    def setUp(self):
+        self.op_type = "bilinear_tensor_product"
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(3, 5).astype("float32")
+        w = np.random.rand(6, 4, 5).astype("float32")
+        b = np.random.rand(1, 6).astype("float32")
+        self.inputs = {"X": x, "Y": y, "Weight": w, "Bias": b}
+        self.outputs = {"Out": np.einsum("bm,kmn,bn->bk", x, w, y) + b}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Y", "Weight"], "Out", max_relative_error=0.02)
+
+
+class TestGridSampler(OpTest):
+    def setUp(self):
+        self.op_type = "grid_sampler"
+        x = np.random.rand(2, 3, 5, 5).astype("float32")
+        grid = (np.random.rand(2, 4, 4, 2).astype("float32") - 0.5) * 2.2
+        self.inputs = {"X": x, "Grid": grid}
+        n, c, h, w = x.shape
+        out = np.zeros((2, 3, 4, 4), "float32")
+        gx = (grid[..., 0] + 1) * 0.5 * (w - 1)
+        gy = (grid[..., 1] + 1) * 0.5 * (h - 1)
+        for ni, yi, xi in itertools.product(range(2), range(4), range(4)):
+            fx, fy = gx[ni, yi, xi], gy[ni, yi, xi]
+            x0, y0 = int(np.floor(fx)), int(np.floor(fy))
+            for dx, dy in itertools.product((0, 1), (0, 1)):
+                xs, ys = x0 + dx, y0 + dy
+                wgt = (1 - abs(fx - xs)) * (1 - abs(fy - ys))
+                if 0 <= xs <= w - 1 and 0 <= ys <= h - 1:
+                    out[ni, :, yi, xi] += wgt * x[ni, :, ys, xs]
+        self.outputs = {"Output": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestAffineGrid(OpTest):
+    def setUp(self):
+        self.op_type = "affine_grid"
+        theta = np.random.rand(2, 2, 3).astype("float32")
+        self.inputs = {"Theta": theta}
+        self.attrs = {"output_shape": [2, 3, 4, 5]}
+        xs = np.linspace(-1, 1, 5)
+        ys = np.linspace(-1, 1, 4)
+        out = np.zeros((2, 4, 5, 2), "float32")
+        for n, i, j in itertools.product(range(2), range(4), range(5)):
+            base = np.array([xs[j], ys[i], 1.0])
+            out[n, i, j] = theta[n] @ base
+        self.outputs = {"Output": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestSmallMathOps(OpTest):
+    def setUp(self):
+        self.op_type = "minus"
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestL1Norm(OpTest):
+    def setUp(self):
+        self.op_type = "l1_norm"
+        x = (np.random.rand(3, 4).astype("float32") - 0.5) * 2
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.abs(x).sum().reshape(1)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestSquaredL2Distance(OpTest):
+    def setUp(self):
+        self.op_type = "squared_l2_distance"
+        x = np.random.rand(4, 5).astype("float32")
+        y = np.random.rand(4, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        sub = x - y
+        self.outputs = {
+            "sub_result": sub,
+            "Out": np.square(sub).sum(axis=1, keepdims=True),
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+class TestSelu(OpTest):
+    def setUp(self):
+        self.op_type = "selu"
+        scale, alpha = 1.0507009873554805, 1.6732632423543772
+        x = (np.random.rand(3, 4).astype("float32") - 0.5) * 4
+        self.inputs = {"X": x}
+        self.attrs = {"scale": scale, "alpha": alpha}
+        self.outputs = {
+            "Out": np.where(x > 0, scale * x, scale * alpha * (np.exp(x) - 1))
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestFill(OpTest):
+    def setUp(self):
+        self.op_type = "fill"
+        val = np.random.rand(3, 4).astype("float32")
+        self.inputs = {}
+        self.attrs = {
+            "shape": [3, 4],
+            "dtype": "float32",
+            "value": val.reshape(-1).tolist(),
+        }
+        self.outputs = {"Out": val}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestIsEmpty(OpTest):
+    def setUp(self):
+        self.op_type = "is_empty"
+        self.inputs = {"X": np.random.rand(3, 4).astype("float32")}
+        self.outputs = {"Out": np.array([False])}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestMultiplex(OpTest):
+    def setUp(self):
+        self.op_type = "multiplex"
+        x1 = np.random.rand(4, 5).astype("float32")
+        x2 = np.random.rand(4, 5).astype("float32")
+        x3 = np.random.rand(4, 5).astype("float32")
+        ids = np.array([[0], [2], [1], [0]], dtype="int32")
+        self.inputs = {"X": [("x1", x1), ("x2", x2), ("x3", x3)], "Ids": ids}
+        stacked = np.stack([x1, x2, x3])
+        out = stacked[ids[:, 0], np.arange(4)]
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestCrop(OpTest):
+    def setUp(self):
+        self.op_type = "crop"
+        x = np.random.rand(5, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [2, 3], "offsets": [1, 2]}
+        self.outputs = {"Out": x[1:3, 2:5]}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestPadConstantLike(OpTest):
+    def setUp(self):
+        self.op_type = "pad_constant_like"
+        x = np.random.rand(4, 5).astype("float32")
+        y = np.random.rand(2, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"pad_value": 1.5}
+        out = np.full((4, 5), 1.5, "float32")
+        out[:2, :3] = y
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["Y"], "Out")
+
+
+class TestSpaceToDepth(OpTest):
+    def setUp(self):
+        self.op_type = "space_to_depth"
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"blocksize": 2}
+        # reference space_to_depth_op.h: out[b, (bh*2+bw)*C + c, j, i]
+        #   = x[b, c, j*2+bh, i*2+bw]
+        out = np.zeros((2, 12, 2, 2), "float32")
+        for c, bh, bw, j, i in itertools.product(
+            range(3), range(2), range(2), range(2), range(2)
+        ):
+            out[:, (bh * 2 + bw) * 3 + c, j, i] = x[:, c, j * 2 + bh, i * 2 + bw]
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestConvShift(OpTest):
+    def setUp(self):
+        self.op_type = "conv_shift"
+        x = np.random.rand(3, 8).astype("float32")
+        y = np.random.rand(3, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        out = np.zeros_like(x)
+        m, nn = 8, 3
+        for b, i in itertools.product(range(3), range(m)):
+            for j in range(nn):
+                out[b, i] += x[b, (i + j - nn // 2) % m] * y[b, j]
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestAddPositionEncoding(OpTest):
+    def setUp(self):
+        self.op_type = "add_position_encoding"
+        x = np.random.rand(2, 5, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"alpha": 0.5, "beta": 2.0}
+        out = np.zeros_like(x)
+        half = 3
+        for pos in range(5):
+            for k in range(half):
+                val = pos / np.power(10000.0, k / (half - 1))
+                out[:, pos, k] = x[:, pos, k] * 0.5 + np.sin(val) * 2.0
+                out[:, pos, half + k] = x[:, pos, half + k] * 0.5 + np.cos(val) * 2.0
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_check_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestMeanIou(OpTest):
+    def setUp(self):
+        self.op_type = "mean_iou"
+        pred = np.random.randint(0, 4, (20,)).astype("int32")
+        label = np.random.randint(0, 4, (20,)).astype("int32")
+        self.inputs = {"Predictions": pred, "Labels": label}
+        self.attrs = {"num_classes": 4}
+        wrong = np.zeros(4, "int32")
+        correct = np.zeros(4, "int32")
+        for p, l in zip(pred, label):
+            if p == l:
+                correct[p] += 1
+            else:
+                wrong[l] += 1
+                wrong[p] += 1
+        denom = (wrong + correct).astype("float64")
+        valid = (denom > 0).sum()
+        iou = correct / np.maximum(denom, 1)
+        self.outputs = {
+            "OutMeanIou": np.array([iou.sum() / valid], "float32"),
+            "OutWrong": wrong,
+            "OutCorrect": correct,
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestSimilarityFocus(OpTest):
+    def setUp(self):
+        self.op_type = "similarity_focus"
+        x = np.random.rand(2, 3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "indexes": [0, 2]}
+        out = np.zeros_like(x)
+        for n in range(2):
+            for idx in [0, 2]:
+                s = x[n, idx]
+                order = np.argsort(-s.reshape(-1))
+                tag2 = np.zeros(4, bool)
+                tag3 = np.zeros(5, bool)
+                cnt = 0
+                for flat in order:
+                    i, j = flat // 5, flat % 5
+                    if tag2[i] or tag3[j]:
+                        continue
+                    tag2[i] = tag3[j] = True
+                    out[n, :, i, j] = 1
+                    cnt += 1
+                    if cnt == 4:
+                        break
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+if __name__ == "__main__":
+    unittest.main()
