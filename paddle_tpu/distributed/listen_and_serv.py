@@ -72,11 +72,20 @@ def run_pserver(op, scope):
 
     state_lock = threading.Lock()
     staged = {}  # grad name -> accumulated np array (sync mode round staging)
+    prefetch_ids = {}  # (trainer_id, "<table>:<req>") -> staged __prefetch__ ids
     optimized_rounds = [0]
     ready = threading.Condition()
 
     def on_send(name, arr, trainer_id):
         if arr is None:
+            return
+        if name.startswith("__prefetch_ids__:"):
+            # RequestPrefetchHandler (request_handler_impl.h + parameter_
+            # prefetch.cc): stage the id vector; the matching GET computes
+            # and returns the table rows. Keyed per trainer so concurrent
+            # prefetches of the same table don't collide.
+            with state_lock:
+                prefetch_ids[(trainer_id, name.split(":", 1)[1])] = np.asarray(arr)
             return
         if sync_mode:
             with state_lock:
@@ -91,6 +100,22 @@ def run_pserver(op, scope):
                     runners[bid].run()
 
     def on_get(name, trainer_id):
+        if name.startswith("__prefetch_out__:"):
+            # key layout: __prefetch_out__:<table>:<req> — rows of this
+            # shard's table slice for the staged ids (masked slots, id<0,
+            # return zero rows; merge_ids drops them by position)
+            key = name.split(":", 1)[1]
+            table_name, _, _req = key.partition(":")
+            with state_lock:
+                ids = prefetch_ids.pop((trainer_id, key), None)
+                table = scope.find_var(table_name)
+            if ids is None or table is None:
+                return None
+            tbl = np.asarray(table)
+            idx = np.clip(ids.astype(np.int64), 0, tbl.shape[0] - 1)
+            rows = tbl[idx]
+            rows[ids < 0] = 0
+            return rows
         if name.startswith("__checkpoint__:"):
             # RequestCheckpointHandler (request_handler_impl.h:103): persist
             # this shard's vars under the trainer-provided dir, outside the

@@ -11,4 +11,6 @@ from . import detection_ops  # noqa: F401 — registration side effects
 from . import dist_ops  # noqa: F401 — registration side effects
 from . import quant_ops  # noqa: F401 — registration side effects
 from . import nn_extra_ops  # noqa: F401 — registration side effects
+from . import compose_ops  # noqa: F401 — registration side effects
+from . import frame_ops  # noqa: F401 — registration side effects
 from .registry import OPS, get, is_registered, register

@@ -1,0 +1,349 @@
+"""Fused / composite ops registered for reference op-registry parity.
+
+Reference analogs: fc_op.cc (inference-fused fc), fused/fused_elemwise_
+activation_op.cc, fused/fusion_lstm_op.cc, fused/fusion_gru_op.cc,
+fused/fusion_seqconv_eltadd_relu_op.cc, fused/fusion_seqexpand_concat_fc_op.cc,
+fused/fused_embedding_fc_lstm_op.cc, fused/fusion_transpose_flatten_concat_op.cc,
+attention_lstm_op.cc, lstm_op.cc ("lstm"), lstmp_op.cc, gru_op.cc ("gru"),
+cudnn_lstm_op.cu.cc.
+
+On TPU these exist for PROGRAM parity, not speed: the reference fused them
+because its per-op executor couldn't (CPU JIT /手写 kernels); here every
+composite is expressed in terms of the same jnp lowerings the unfused ops use
+and XLA refuses nothing — the fusion happens in the compiler. Sequence inputs
+follow this framework's padded-dense + SeqLen convention (LoD redesign,
+SURVEY.md §5.7).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import sequence_ops
+from .registry import OPS, bcast_y, register
+
+
+def _opt(ins, slot):
+    """Optional-slot read: empty-var placeholders arrive as [None]
+    (registry.lower_ops), so both absence and None must read as missing."""
+    vals = ins.get(slot)
+    return vals[0] if vals and vals[0] is not None else None
+
+
+# ---------------------------------------------------------------------------
+# fc + elementwise fusions
+# ---------------------------------------------------------------------------
+
+
+@register("fc")
+def _fc(ctx, ins, attrs):
+    """Sum of Input[i] @ W[i] (+ Bias), the inference-pass fc fusion
+    (fc_op.cc; in training fc is composed from mul + elementwise_add)."""
+    xs = ins["Input"]
+    ws = ins["W"]
+    in_num_col_dims = int(attrs.get("in_num_col_dims", 1))
+    out = None
+    for x, w in zip(xs, ws):
+        lead = int(np.prod(x.shape[:in_num_col_dims]))
+        x2 = x.reshape(lead, -1)
+        term = x2 @ w
+        out = term if out is None else out + term
+    bias = _opt(ins, "Bias")
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    if attrs.get("activation_type"):
+        out = _ACT[attrs["activation_type"]](out)
+    x0 = xs[0]
+    out = out.reshape(x0.shape[:in_num_col_dims] + (out.shape[-1],))
+    return {"Out": [out]}
+
+
+_ACT = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "identity": lambda x: x,
+    "": lambda x: x,
+}
+
+_BINOPS = {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+}
+
+_UNOPS = {
+    "relu": jax.nn.relu,
+    "scale": None,  # handled with the scale attr
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+}
+
+
+@register("fused_elemwise_activation")
+def _fused_elemwise_activation(ctx, ins, attrs):
+    """functor_list[0] is the OUTER function (reference
+    fused_elemwise_activation_op.h IsUnaryCompound): [binary, unary] →
+    Out = binary(x, unary(y)), [unary, binary] → Out = unary(binary(x, y));
+    IntermediateOut is the inner result either way."""
+    (x,) = ins["X"]
+    (y,) = ins["Y"]
+    functors = [f.lower() for f in attrs["functor_list"]]
+    axis = int(attrs.get("axis", -1))
+    scale = float(attrs.get("scale", 0.0))
+
+    def unary(name, v):
+        if name == "scale":
+            return v * scale
+        return _UNOPS[name](v)
+
+    if functors[0] in _BINOPS:
+        inter = unary(functors[1], y)
+        out = _BINOPS[functors[0]](x, bcast_y(x, inter, axis))
+    else:
+        inter = _BINOPS[functors[1]](x, bcast_y(x, y, axis))
+        out = unary(functors[0], inter)
+    return {"Out": [out], "IntermediateOut": [inter]}
+
+
+@register("fusion_transpose_flatten_concat")
+def _fusion_transpose_flatten_concat(ctx, ins, attrs):
+    trans = [int(a) for a in attrs["trans_axis"]]
+    flat_axis = int(attrs["flatten_axis"])
+    concat_axis = int(attrs["concat_axis"])
+    pieces = []
+    for x in ins["X"]:
+        t = x.transpose(trans)
+        lead = int(np.prod(t.shape[:flat_axis]))
+        pieces.append(t.reshape(lead, -1))
+    return {"Out": [jnp.concatenate(pieces, axis=concat_axis)]}
+
+
+# ---------------------------------------------------------------------------
+# recurrent composites. "lstm"/"gru" are the reference's canonical op names
+# for what this framework registered as dynamic_lstm / dynamic_gru (the fluid
+# layers emit type "lstm"/"gru"); alias them so transpiled/imported programs
+# using reference op names execute unchanged.
+# ---------------------------------------------------------------------------
+
+register("lstm")(OPS["dynamic_lstm"].lower)
+register("gru")(OPS["dynamic_gru"].lower)
+
+
+@register("lstmp")
+def _lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection (reference lstmp_op.cc): the recurrent
+    connection feeds the projection r = act(h @ ProjWeight) instead of h.
+    Weight is (p, 4h), ProjWeight is (h, p)."""
+    (x,) = ins["Input"]  # (b, t, 4h) pre-projected input contribution
+    (w,) = ins["Weight"]
+    (wp,) = ins["ProjWeight"]
+    (seqlen,) = ins["SeqLen"]
+    bias = _opt(ins, "Bias")
+    b, t, h4 = x.shape
+    h = h4 // 4
+    p = wp.shape[1]
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    proj_act = _ACT[attrs.get("proj_activation", "identity")]
+
+    gate_bias = bias.reshape(-1)[: 4 * h] if bias is not None else None
+    xs = jnp.moveaxis(x, 1, 0)
+    tidx = jnp.arange(t)
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        xt, ti = inp
+        gates = xt + r_prev @ w
+        if gate_bias is not None:
+            gates = gates + gate_bias
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(gi)
+        f = jax.nn.sigmoid(gf)
+        o = jax.nn.sigmoid(go)
+        c_new = f * c_prev + i * jnp.tanh(gc)
+        h_new = o * jnp.tanh(c_new)
+        r_new = proj_act(h_new @ wp)
+        mask = (ti < lens).astype(x.dtype).reshape(-1, 1)
+        r_out = mask * r_new + (1 - mask) * r_prev
+        c_out = mask * c_new + (1 - mask) * c_prev
+        return (r_out, c_out), (r_out, c_out, mask * h_new)
+
+    init = (jnp.zeros((b, p), x.dtype), jnp.zeros((b, h), x.dtype))
+    _, (rs, cs, hs) = lax.scan(step, init, (xs, tidx))
+    mask = (jnp.arange(t)[None, :] < lens[:, None]).astype(x.dtype)[..., None]
+    return {
+        "Projection": [jnp.moveaxis(rs, 0, 1) * mask],
+        "Cell": [jnp.moveaxis(cs, 0, 1) * mask],
+        "Hidden": [jnp.moveaxis(hs, 0, 1) * mask],
+    }
+
+
+@register("cudnn_lstm")
+def _cudnn_lstm(ctx, ins, attrs):
+    """Padded-batch single-layer LSTM over seq-major input (reference
+    cudnn_lstm_op.cu.cc). W is a flat blob [Wx(D,4h) | Wh(h,4h) | b(4h)] —
+    the cuDNN packed-weights analog; multi-layer/bidirectional variants should
+    be built from stacked `lstm` ops instead (models/stacked_lstm.py)."""
+    (x,) = ins["Input"]  # (T, N, D) seq-major like cuDNN
+    (w,) = ins["W"]
+    hidden_size = int(attrs["hidden_size"])
+    if int(attrs.get("num_layers", 1)) != 1 or attrs.get("is_bidirec", False):
+        raise NotImplementedError(
+            "cudnn_lstm: stack lstm ops for multi-layer/bidirectional"
+        )
+    t, n, d = x.shape
+    h = hidden_size
+    flat = w.reshape(-1)
+    wx = flat[: d * 4 * h].reshape(d, 4 * h)
+    wh = flat[d * 4 * h : (d + h) * 4 * h].reshape(h, 4 * h)
+    b = flat[(d + h) * 4 * h : (d + h) * 4 * h + 4 * h]
+    h0 = _opt(ins, "InitH")
+    c0 = _opt(ins, "InitC")
+    h0 = jnp.zeros((n, h), x.dtype) if h0 is None else h0.reshape(n, h)
+    c0 = jnp.zeros((n, h), x.dtype) if c0 is None else c0.reshape(n, h)
+
+    def step(carry, xt):
+        h_prev, c_prev = carry
+        gates = xt @ wx + h_prev @ wh + b
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(gi)
+        f = jax.nn.sigmoid(gf)
+        c_new = f * c_prev + i * jnp.tanh(gc)
+        o = jax.nn.sigmoid(go)
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    (hl, cl), hs = lax.scan(step, (h0, c0), x)
+    return {"Out": [hs], "last_h": [hl[None]], "last_c": [cl[None]]}
+
+
+def _project_then(ins, wx_slot, extra):
+    (x,) = ins["X"]
+    (wx,) = ins[wx_slot]
+    proj = jnp.einsum("btd,dg->btg", x, wx)
+    sub = dict(extra)
+    sub["Input"] = [proj]
+    sub["SeqLen"] = ins["SeqLen"]
+    for slot in ("H0", "C0", "Bias"):
+        if _opt(ins, slot) is not None:
+            sub[slot] = ins[slot]
+    return sub
+
+
+@register("fusion_lstm")
+def _fusion_lstm(ctx, ins, attrs):
+    """x @ WeightX then the lstm recurrence in one op (reference
+    fused/fusion_lstm_op.cc)."""
+    sub = _project_then(ins, "WeightX", {"Weight": ins["WeightH"]})
+    return OPS["dynamic_lstm"].lower(ctx, sub, attrs)
+
+
+@register("fusion_gru")
+def _fusion_gru(ctx, ins, attrs):
+    sub = _project_then(ins, "WeightX", {"Weight": ins["WeightH"]})
+    return OPS["dynamic_gru"].lower(ctx, sub, attrs)
+
+
+@register("fused_embedding_fc_lstm")
+def _fused_embedding_fc_lstm(ctx, ins, attrs):
+    """Embedding lookup (rows are pre-multiplied by the fc weight, as the
+    reference's pass rewrites them) + lstm (fused_embedding_fc_lstm_op.cc)."""
+    (ids,) = ins["Ids"]  # (b, t) or (b, t, 1)
+    (emb,) = ins["Embeddings"]  # (vocab, 4h)
+    ids2 = ids.reshape(ids.shape[0], -1).astype(jnp.int32)
+    proj = emb[ids2]
+    sub = {
+        "Input": [proj],
+        "Weight": ins["WeightH"],
+        "SeqLen": ins["SeqLen"],
+    }
+    for slot in ("H0", "C0", "Bias"):
+        if _opt(ins, slot) is not None:
+            sub[slot] = ins[slot]
+    return OPS["dynamic_lstm"].lower(ctx, sub, attrs)
+
+
+@register("fusion_seqconv_eltadd_relu")
+def _fusion_seqconv_eltadd_relu(ctx, ins, attrs):
+    out = sequence_ops._sequence_conv(
+        ctx,
+        {"X": ins["X"], "Filter": ins["Filter"], "SeqLen": ins["SeqLen"]},
+        attrs,
+    )["Out"][0]
+    out = jax.nn.relu(out + ins["Bias"][0].reshape(1, 1, -1))
+    return {"Out": [out]}
+
+
+@register("fusion_seqexpand_concat_fc")
+def _fusion_seqexpand_concat_fc(ctx, ins, attrs):
+    """First input is the full sequence (b,t,d0); the rest are per-sequence
+    vectors broadcast over time; concat + fc + activation
+    (fused/fusion_seqexpand_concat_fc_op.cc)."""
+    xs = ins["X"]
+    (w,) = ins["FCWeight"]
+    seq = xs[0]
+    b, t = seq.shape[:2]
+    parts = [seq] + [jnp.broadcast_to(v[:, None, :], (b, t, v.shape[-1])) for v in xs[1:]]
+    cat = jnp.concatenate(parts, axis=-1)
+    out = jnp.einsum("btd,do->bto", cat, w)
+    fc_bias = _opt(ins, "FCBias")
+    if fc_bias is not None:
+        out = out + fc_bias.reshape(1, 1, -1)
+    out = _ACT[attrs.get("fc_activation", "identity")](out)
+    return {"Out": [out]}
+
+
+@register("attention_lstm")
+def _attention_lstm(ctx, ins, attrs):
+    """Per-step content attention over the input sequence feeding an LSTM
+    (reference attention_lstm_op.cc): score_t = fc([x_t, h_prev]); softmax
+    over valid steps; the attended vector drives one lstm step. Padded-dense
+    redesign of the reference's LoD loop."""
+    (x,) = ins["X"]  # (b, t, d)
+    (seqlen,) = ins["SeqLen"]
+    (aw,) = ins["AttentionWeight"]  # (d + h, 1)
+    (lw,) = ins["LSTMWeight"]  # (d + h, 4h)
+    lstm_bias = _opt(ins, "LSTMBias")
+    lb = lstm_bias.reshape(-1) if lstm_bias is not None else 0.0
+    atten_bias = _opt(ins, "AttentionBias")
+    ab = atten_bias.reshape(-1) if atten_bias is not None else None
+    b, t, d = x.shape
+    h = lw.shape[1] // 4
+    lens = seqlen.reshape(-1).astype(jnp.int32)
+    valid = jnp.arange(t)[None, :] < lens[:, None]  # (b, t)
+    h0 = _opt(ins, "H0")
+    h0 = jnp.zeros((b, h), x.dtype) if h0 is None else h0
+    c0 = _opt(ins, "C0")
+    c0 = jnp.zeros((b, h), x.dtype) if c0 is None else c0
+
+    aw_x = aw[:d, 0]
+    aw_h = aw[d:, 0]
+
+    def step(carry, _):
+        h_prev, c_prev = carry
+        score = x @ aw_x + (h_prev @ aw_h[:, None]).reshape(b, 1)
+        if ab is not None:
+            score = score + ab
+        scalar = _opt(ins, "AttentionScalar")
+        if scalar is not None:
+            score = score * scalar.reshape(())
+            scalar_bias = _opt(ins, "AttentionScalarBias")
+            if scalar_bias is not None:
+                score = score + scalar_bias.reshape(())
+        score = jnp.where(valid, score, -jnp.inf)
+        alpha = jax.nn.softmax(score, axis=1)  # (b, t)
+        atted = jnp.einsum("bt,btd->bd", alpha, x)
+        gates = jnp.concatenate([atted, h_prev], axis=-1) @ lw + lb
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(gf) * c_prev + jax.nn.sigmoid(gi) * jnp.tanh(gc)
+        h_new = jax.nn.sigmoid(go) * jnp.tanh(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    max_len = t
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), None, length=max_len)
+    mask = valid.astype(x.dtype)[..., None]
+    return {
+        "Hidden": [jnp.moveaxis(hs, 0, 1) * mask],
+        "Cell": [jnp.moveaxis(cs, 0, 1) * mask],
+    }

@@ -1,0 +1,305 @@
+"""Framework-level parity ops: graph-native checkpoint (save/load), scope
+management, IfElse row split/merge, tensor-array export, sharded-id plumbing.
+
+Reference analogs: operators/save_op.cc, load_op.cc, save_combine_op.cc,
+load_combine_op.cc (checkpointing as ops executed by io.py-built programs,
+SURVEY.md §5.4), delete_var_op.cc, controlflow/get_places_op.cc, csp/go_op.cc,
+split_lod_tensor_op.cc / merge_lod_tensor_op.cc (the reference IfElse's
+row-scatter — here masked selects, static shapes), tensor_array_to_tensor_op.cc,
+rnn_memory_helper_op.cc, distributed_ops/split_ids_op.cc / merge_ids_op.cc /
+split_byref_op.cc, distributed_ops/prefetch_op.cc + distributed/
+parameter_prefetch.cc:26 (remote sparse-table row fetch), distributed_ops/
+gen_nccl_id_op.cc.
+
+NOT replicated: split_selected_rows / merge_selected_rows /
+get_tensor_from_selected_rows / lookup_sparse_table — this framework has no
+SelectedRows runtime type; sparse embedding gradients are dense scatter-adds
+and sharded tables live in parallel/sharded_embedding.py (SURVEY.md §7 hard
+part 5), so those ops have no value to operate on.
+"""
+
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, register_host
+
+
+# ---------------------------------------------------------------------------
+# graph-native checkpoint ops (reference save_op.cc / load_op.cc; io.py's
+# save/load build programs of these in the reference — our io.py writes
+# directly, these ops make user programs that embed save/load runnable)
+# ---------------------------------------------------------------------------
+
+
+def _save_path(op):
+    path = op.attrs["file_path"]
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    return path
+
+
+@register_host("save")
+def _save(op, scope):
+    from .. import io as fluid_io
+
+    (name,) = op.input("X")
+    val = scope.find_var(name)
+    if val is None:
+        raise RuntimeError("save: variable %r has no value in scope" % name)
+    arr, orig = fluid_io._bf16_safe_save(val)
+    path = _save_path(op)
+    if op.attrs.get("save_as_fp16", False):
+        arr = arr.astype(np.float16)
+    np.save(path, arr)
+    if orig:
+        with open(path + ".dtype", "w") as f:
+            f.write(orig)
+
+
+@register_host("load")
+def _load(op, scope):
+    path = op.attrs["file_path"]
+    arr = np.load(path if path.endswith(".npy") else path + ".npy")
+    (name,) = op.output("Out")
+    if os.path.exists(path + ".dtype"):
+        with open(path + ".dtype") as f:
+            orig = f.read().strip()
+        arr = jnp.asarray(arr).astype(orig)
+    scope.set_var(name, jnp.asarray(arr))
+
+
+@register_host("save_combine")
+def _save_combine(op, scope):
+    from .. import io as fluid_io
+
+    path = _save_path(op)
+    arrays = {}
+    dtypes = {}
+    for name in op.input("X"):
+        val = scope.find_var(name)
+        if val is None:
+            raise RuntimeError("save_combine: variable %r has no value" % name)
+        arr, orig = fluid_io._bf16_safe_save(val)
+        arrays[name] = arr
+        if orig:
+            dtypes[name] = orig
+    np.savez(path, __dtypes__=np.array([repr(dtypes)]), **arrays)
+
+
+@register_host("load_combine")
+def _load_combine(op, scope):
+    path = op.attrs["file_path"]
+    data = np.load(path if path.endswith(".npz") else path + ".npz", allow_pickle=False)
+    dtypes = {}
+    if "__dtypes__" in data:
+        import ast
+
+        dtypes = ast.literal_eval(str(data["__dtypes__"][0]))
+    for name in op.output("Out"):
+        arr = jnp.asarray(data[name])
+        if name in dtypes:
+            arr = arr.astype(dtypes[name])
+        scope.set_var(name, arr)
+
+
+@register_host("delete_var")
+def _delete_var(op, scope):
+    """Eager scope cleanup (reference delete_var_op.cc; the executor's GC
+    analog for explicitly-programmed deletion)."""
+    for name in op.input("X"):
+        scope.vars.pop(name, None)
+
+
+@register_host("get_places")
+def _get_places(op, scope):
+    """Device enumeration (reference controlflow/get_places_op.cc, feeds
+    parallel_do). Stores the device count; SPMD placement itself is mesh-
+    driven (parallel/mesh.py), not place-list driven."""
+    import jax
+
+    kind = op.attrs.get("device_type", "")
+    devs = jax.devices()
+    count = int(op.attrs.get("device_count", 0) or 0) or len(devs)
+    (out,) = op.output("Out")
+    scope.set_var(out, jnp.arange(count, dtype=jnp.int32))
+
+
+@register_host("go")
+def _go(op, scope):
+    """Fire-and-forget async block launch (reference csp/go_op.cc spawns a
+    detached thread running the sub-block on a child scope)."""
+    from ..executor import _SegmentedBlock
+
+    sub = op.attrs["sub_block"]
+    program = op.block.program
+
+    def run():
+        seg = _SegmentedBlock(program, sub, [], [])
+        seg(scope, {})
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    # keep handles so callers/tests can join deterministically
+    threads = scope.find_var("__go_threads__")
+    if not isinstance(threads, list):
+        threads = []
+        scope.vars["__go_threads__"] = threads
+    threads.append(t)
+
+
+# ---------------------------------------------------------------------------
+# IfElse row scatter/gather + array export + StaticRNN memory plumbing
+# ---------------------------------------------------------------------------
+
+
+@register("split_lod_tensor")
+def _split_lod_tensor(ctx, ins, attrs):
+    """Reference split_lod_tensor_op.cc compacts true/false rows into two
+    smaller tensors; XLA needs static shapes, so both outputs keep the full
+    batch with non-selected rows zeroed — merge_lod_tensor composes exactly
+    (the reference IfElse contract is split→branch→merge, and per-row
+    branches commute with the masking)."""
+    (x,) = ins["X"]
+    (mask,) = ins["Mask"]
+    m = mask.reshape(-1).astype(bool)
+    shape = (-1,) + (1,) * (x.ndim - 1)
+    mf = m.reshape(shape)
+    return {"OutTrue": [jnp.where(mf, x, 0)], "OutFalse": [jnp.where(mf, 0, x)]}
+
+
+@register("merge_lod_tensor")
+def _merge_lod_tensor(ctx, ins, attrs):
+    (in_true,) = ins["InTrue"]
+    (in_false,) = ins["InFalse"]
+    (mask,) = ins["Mask"]
+    m = mask.reshape((-1,) + (1,) * (in_true.ndim - 1)).astype(bool)
+    return {"Out": [jnp.where(m, in_true, in_false)]}
+
+
+@register("tensor_array_to_tensor", infer_shape=lambda op, block: None)
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    """Concat/stack the (buffer, size) tensor-array along `axis` (reference
+    tensor_array_to_tensor_op.cc). Static-capacity semantics: all buffer
+    slots participate (writes past `size` never happen under the layers API)."""
+    (arr,) = ins["X"]
+    buf, _size = arr
+    axis = int(attrs.get("axis", 0))
+    if attrs.get("use_stack", False):
+        out = jnp.moveaxis(buf, 0, axis)
+    else:
+        pieces = [buf[i] for i in range(buf.shape[0])]
+        out = jnp.concatenate(pieces, axis=axis)
+    idx = jnp.full((buf.shape[0],), buf.shape[1] if buf.ndim > 1 else 1, jnp.int32)
+    return {"Out": [out], "OutIndex": [idx]}
+
+
+@register("rnn_memory_helper")
+def _rnn_memory_helper(ctx, ins, attrs):
+    (x,) = ins["X"]
+    return {"Out": [x]}
+
+
+@register("rnn_memory_helper_grad", no_grad=True)
+def _rnn_memory_helper_grad(ctx, ins, attrs):
+    (g,) = ins["Out@GRAD"]
+    return {"X@GRAD": [g]}
+
+
+# ---------------------------------------------------------------------------
+# sharded-id plumbing for distributed sparse tables (reference
+# distributed_ops/split_ids_op.cc: shard = id % n; merge_ids_op.cc restores
+# original order from the per-shard results)
+# ---------------------------------------------------------------------------
+
+
+@register("split_ids", no_grad=True)
+def _split_ids(ctx, ins, attrs):
+    """Static-shape redesign: each of the N outputs keeps the full id vector
+    with other shards' slots masked to -1 (dense analog of the reference's
+    compaction; lookup results are gathered back by position, so masked slots
+    never surface)."""
+    (ids,) = ins["Ids"]
+    flat = ids.reshape(-1)
+    # shard count = declared output arity, carried as an attr by the layer /
+    # transpiler (lowerings see slots, not the OpDesc's output list)
+    n = int(attrs.get("num_shards") or attrs.get("n_parts") or 1)
+    outs = []
+    for shard in range(n):
+        keep = (flat % n) == shard
+        outs.append(jnp.where(keep, flat, -1))
+    return {"Out": outs}
+
+
+@register("merge_ids", no_grad=True)
+def _merge_ids(ctx, ins, attrs):
+    """Rows[i] holds shard i's lookup result aligned to the original id
+    positions (split_ids' masked layout); merge selects per position."""
+    (ids,) = ins["Ids"]
+    rows = ins["X"]
+    flat = ids.reshape(-1).astype(jnp.int32)
+    n = len(rows)
+    out = rows[0]
+    for shard in range(1, n):
+        sel = ((flat % n) == shard).reshape((-1,) + (1,) * (rows[0].ndim - 1))
+        out = jnp.where(sel, rows[shard], out)
+    return {"Out": [out]}
+
+
+@register("split_byref")
+def _split_byref(ctx, ins, attrs):
+    """Row-section split (reference split_byref_op.cc — zero-copy slices of
+    the param for per-pserver send; XLA slices fuse into the send staging)."""
+    (x,) = ins["X"]
+    sections = [int(s) for s in attrs["sections"]]
+    outs = []
+    start = 0
+    for s in sections:
+        outs.append(x[start : start + s])
+        start += s
+    return {"Out": outs}
+
+
+@register_host("prefetch")
+def _prefetch(op, scope):
+    """Remote sparse-table row fetch (reference distributed_ops/prefetch_op.cc
+    + parameter_prefetch.cc:26): send the id vector, receive the rows. Served
+    by the pserver's __prefetch__ GET channel (distributed/listen_and_serv.py)."""
+    from ..distributed.rpc import RPCClient
+
+    client = RPCClient.instance(int(op.attrs.get("trainer_id", 0)))
+    in_names = op.input("X")
+    out_names = op.output("Out")
+    epmap = op.attrs["epmap"]
+    table = op.attrs.get("table_name")
+    if not table:
+        names = op.attrs.get("table_names")
+        table = names[0] if isinstance(names, (list, tuple)) and names else ""
+    for ids_name, out_name, ep in zip(in_names, out_names, epmap):
+        ids = np.asarray(scope.find_var(ids_name)).reshape(-1)
+        client.async_send_var(ep, "__prefetch_ids__:%s:%s" % (table, out_name), ids)
+    client.wait()
+    futures = [
+        (out_name, ep, client.async_get_var(ep, "__prefetch_out__:%s:%s" % (table, out_name)))
+        for out_name, ep in zip(out_names, epmap)
+    ]
+    for out_name, ep, f in futures:
+        rows = f.result(timeout=client.timeout)
+        if rows is None:
+            raise RuntimeError("prefetch: pserver %s returned no rows" % ep)
+        scope.set_var(out_name, jnp.asarray(rows))
+
+
+@register_host("gen_nccl_id")
+def _gen_nccl_id(op, scope):
+    """Collective rendezvous (reference gen_nccl_id_op.cc gossiped an
+    ncclUniqueId over a temporary gRPC server). On TPU the XLA runtime's
+    coordination service owns rendezvous — jax.distributed.initialize at
+    process start (parallel/multihost.py) — so this op is a checked no-op
+    kept so transpiled NCCL2-mode startup programs execute."""
+    slot = "NCCLID" if op.outputs.get("NCCLID") else "Out"
+    for out in op.output(slot):
+        scope.set_var(out, jnp.zeros((1,), jnp.int32))

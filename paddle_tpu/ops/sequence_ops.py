@@ -202,7 +202,9 @@ def _dynamic_lstm(ctx, ins, attrs):
         return (h_out, c_out), (h_out, c_out)
 
     # with reverse=True the scan hits padding (t >= len) first; it is masked
-    init = (jnp.zeros((b, h), x.dtype), jnp.zeros((b, h), x.dtype))
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else jnp.zeros((b, h), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") and ins["C0"][0] is not None else jnp.zeros((b, h), x.dtype)
+    init = (h0.astype(x.dtype), c0.astype(x.dtype))
     _, (hs, cs) = _scan_time(step, init, (xs, tidx), reverse=is_reverse)
     hidden = jnp.moveaxis(hs, 0, 1)
     cell = jnp.moveaxis(cs, 0, 1)
@@ -243,7 +245,11 @@ def _dynamic_gru(ctx, ins, attrs):
         h_out = mask * h_new + (1 - mask) * h_prev
         return h_out, h_out
 
-    init = jnp.zeros((b, h), x.dtype)
+    init = (
+        ins["H0"][0].astype(x.dtype)
+        if ins.get("H0") and ins["H0"][0] is not None
+        else jnp.zeros((b, h), x.dtype)
+    )
     _, hs = _scan_time(step, init, (xs, tidx), reverse=is_reverse)
     hidden = _masked(jnp.moveaxis(hs, 0, 1), lens)
     return {"Hidden": [hidden]}

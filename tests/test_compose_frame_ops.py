@@ -1,0 +1,402 @@
+"""Tests for fused/composite ops (ops/compose_ops.py) and framework parity
+ops (ops/frame_ops.py), modeled on the reference's test_fusion_lstm_op.py,
+test_fused_elemwise_activation_op.py, test_save_load (book tests),
+test_split_ids_op.py / test_merge_ids_op.py patterns."""
+
+import os
+import tempfile
+import unittest
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from op_test import OpTest
+from paddle_tpu import framework
+from paddle_tpu.executor import Executor, Scope, scope_guard
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestFc(OpTest):
+    def setUp(self):
+        self.op_type = "fc"
+        x = np.random.rand(4, 6).astype("float32")
+        w = np.random.rand(6, 5).astype("float32")
+        b = np.random.rand(5).astype("float32")
+        self.inputs = {"Input": x, "W": w, "Bias": b}
+        self.attrs = {"in_num_col_dims": 1}
+        self.outputs = {"Out": x @ w + b}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestFusedElemwiseActivation(OpTest):
+    def setUp(self):
+        self.op_type = "fused_elemwise_activation"
+        x = (np.random.rand(3, 4).astype("float32") - 0.5) * 2
+        y = (np.random.rand(3, 4).astype("float32") - 0.5) * 2
+        self.inputs = {"X": x, "Y": y}
+        # functor_list[0] is the OUTER function (reference IsUnaryCompound):
+        # [elementwise_add, relu] => x + relu(y)
+        self.attrs = {"functor_list": ["elementwise_add", "relu"], "axis": -1}
+        inter = np.maximum(y, 0)
+        self.outputs = {"Out": x + inter, "IntermediateOut": inter}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestFusionTransposeFlattenConcat(OpTest):
+    def setUp(self):
+        self.op_type = "fusion_transpose_flatten_concat"
+        x1 = np.random.rand(2, 3, 4).astype("float32")
+        x2 = np.random.rand(2, 3, 5).astype("float32")
+        self.inputs = {"X": [("tf1", x1), ("tf2", x2)]}
+        self.attrs = {"trans_axis": [0, 2, 1], "flatten_axis": 1, "concat_axis": 1}
+        f1 = x1.transpose(0, 2, 1).reshape(2, -1)
+        f2 = x2.transpose(0, 2, 1).reshape(2, -1)
+        self.outputs = {"Out": np.concatenate([f1, f2], axis=1)}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestLstmAlias(OpTest):
+    """`lstm` must behave exactly like dynamic_lstm (reference lstm_op.cc is
+    the op the fluid dynamic_lstm layer emits)."""
+
+    def setUp(self):
+        self.op_type = "lstm"
+        b, t, h = 2, 4, 3
+        x = np.random.rand(b, t, 4 * h).astype("float32") - 0.5
+        w = np.random.rand(h, 4 * h).astype("float32") - 0.5
+        lens = np.array([4, 2], dtype="int64")
+        self.inputs = {"Input": x, "Weight": w, "SeqLen": lens}
+        self.attrs = {"use_peepholes": False}
+        hidden = np.zeros((b, t, h), "float32")
+        cell = np.zeros((b, t, h), "float32")
+        hp = np.zeros((b, h))
+        cp = np.zeros((b, h))
+        for ti in range(t):
+            gates = x[:, ti] + hp @ w
+            gc, gi, gf, go = np.split(gates, 4, axis=1)
+            i, f, o = sigmoid(gi), sigmoid(gf), sigmoid(go)
+            cn = f * cp + i * np.tanh(gc)
+            hn = o * np.tanh(cn)
+            mask = (ti < lens).astype("float64").reshape(-1, 1)
+            hp = mask * hn + (1 - mask) * hp
+            cp = mask * cn + (1 - mask) * cp
+            hidden[:, ti] = (hp * mask).astype("float32")
+            cell[:, ti] = (cp * mask).astype("float32")
+        self.outputs = {"Hidden": hidden, "Cell": cell}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestFusionLstm(OpTest):
+    def setUp(self):
+        self.op_type = "fusion_lstm"
+        b, t, d, h = 2, 3, 4, 3
+        x = np.random.rand(b, t, d).astype("float32") - 0.5
+        wx = np.random.rand(d, 4 * h).astype("float32") - 0.5
+        wh = np.random.rand(h, 4 * h).astype("float32") - 0.5
+        lens = np.array([3, 3], dtype="int64")
+        self.inputs = {"X": x, "WeightX": wx, "WeightH": wh, "SeqLen": lens}
+        self.attrs = {"use_peepholes": False}
+        proj = x @ wx
+        hp = np.zeros((b, h))
+        cp = np.zeros((b, h))
+        hidden = np.zeros((b, t, h), "float32")
+        cell = np.zeros((b, t, h), "float32")
+        for ti in range(t):
+            gates = proj[:, ti] + hp @ wh
+            gc, gi, gf, go = np.split(gates, 4, axis=1)
+            i, f, o = sigmoid(gi), sigmoid(gf), sigmoid(go)
+            cp = f * cp + i * np.tanh(gc)
+            hp = o * np.tanh(cp)
+            hidden[:, ti] = hp
+            cell[:, ti] = cp
+        self.outputs = {"Hidden": hidden, "Cell": cell}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_check_grad(self):
+        self.check_grad(["X", "WeightX", "WeightH"], "Hidden", max_relative_error=0.03)
+
+
+class TestFusionGru(OpTest):
+    def setUp(self):
+        self.op_type = "fusion_gru"
+        b, t, d, h = 2, 3, 4, 3
+        x = np.random.rand(b, t, d).astype("float32") - 0.5
+        wx = np.random.rand(d, 3 * h).astype("float32") - 0.5
+        wh = np.random.rand(h, 3 * h).astype("float32") - 0.5
+        lens = np.array([3, 2], dtype="int64")
+        self.inputs = {"X": x, "WeightX": wx, "WeightH": wh, "SeqLen": lens}
+        proj = x @ wx
+        hp = np.zeros((b, h))
+        hidden = np.zeros((b, t, h), "float32")
+        for ti in range(t):
+            xt = proj[:, ti]
+            g_ur = xt[:, : 2 * h] + hp @ wh[:, : 2 * h]
+            u = sigmoid(g_ur[:, :h])
+            r = sigmoid(g_ur[:, h:])
+            c = np.tanh(xt[:, 2 * h :] + (r * hp) @ wh[:, 2 * h :])
+            hn = (1 - u) * hp + u * c
+            mask = (ti < lens).astype("float64").reshape(-1, 1)
+            hp = mask * hn + (1 - mask) * hp
+            hidden[:, ti] = hp * mask
+        self.outputs = {"Hidden": hidden}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestLstmp(OpTest):
+    def setUp(self):
+        self.op_type = "lstmp"
+        b, t, h, p = 2, 3, 4, 2
+        x = np.random.rand(b, t, 4 * h).astype("float32") - 0.5
+        w = np.random.rand(p, 4 * h).astype("float32") - 0.5
+        wp = np.random.rand(h, p).astype("float32") - 0.5
+        lens = np.array([3, 3], dtype="int64")
+        self.inputs = {"Input": x, "Weight": w, "ProjWeight": wp, "SeqLen": lens}
+        rp = np.zeros((b, p))
+        cp = np.zeros((b, h))
+        proj_out = np.zeros((b, t, p), "float32")
+        for ti in range(t):
+            gates = x[:, ti] + rp @ w
+            gc, gi, gf, go = np.split(gates, 4, axis=1)
+            cn = sigmoid(gf) * cp + sigmoid(gi) * np.tanh(gc)
+            hn = sigmoid(go) * np.tanh(cn)
+            rp = hn @ wp
+            cp = cn
+            proj_out[:, ti] = rp
+        self.outputs = {"Projection": proj_out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4, no_check_set=["Cell", "Hidden"])
+
+
+class TestCudnnLstm(OpTest):
+    def setUp(self):
+        self.op_type = "cudnn_lstm"
+        t, n, d, h = 3, 2, 4, 3
+        x = np.random.rand(t, n, d).astype("float32") - 0.5
+        wx = np.random.rand(d, 4 * h).astype("float32") - 0.5
+        wh = np.random.rand(h, 4 * h).astype("float32") - 0.5
+        bias = np.random.rand(4 * h).astype("float32") - 0.5
+        w = np.concatenate([wx.reshape(-1), wh.reshape(-1), bias])
+        self.inputs = {"Input": x, "W": w}
+        self.attrs = {"hidden_size": h, "num_layers": 1}
+        hp = np.zeros((n, h))
+        cp = np.zeros((n, h))
+        out = np.zeros((t, n, h), "float32")
+        for ti in range(t):
+            gates = x[ti] @ wx + hp @ wh + bias
+            gi, gf, gc, go = np.split(gates, 4, axis=1)
+            cp = sigmoid(gf) * cp + sigmoid(gi) * np.tanh(gc)
+            hp = sigmoid(go) * np.tanh(cp)
+            out[ti] = hp
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4, no_check_set=["last_h", "last_c"])
+
+
+class TestFusionSeqexpandConcatFc(OpTest):
+    def setUp(self):
+        self.op_type = "fusion_seqexpand_concat_fc"
+        b, t = 2, 3
+        seq = np.random.rand(b, t, 4).astype("float32")
+        vec = np.random.rand(b, 2).astype("float32")
+        w = np.random.rand(6, 5).astype("float32")
+        self.inputs = {"X": [("seq_in", seq), ("vec_in", vec)], "FCWeight": w}
+        self.attrs = {"fc_activation": "relu"}
+        cat = np.concatenate(
+            [seq, np.broadcast_to(vec[:, None, :], (b, t, 2))], axis=-1
+        )
+        self.outputs = {"Out": np.maximum(cat @ w, 0)}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestSplitMergeLodTensor(OpTest):
+    def setUp(self):
+        self.op_type = "split_lod_tensor"
+        x = np.random.rand(4, 3).astype("float32")
+        mask = np.array([[1], [0], [1], [0]], dtype=bool)
+        self.inputs = {"X": x, "Mask": mask}
+        mf = mask.astype("float32")
+        self.outputs = {"OutTrue": x * mf, "OutFalse": x * (1 - mf)}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestMergeLodTensor(OpTest):
+    def setUp(self):
+        self.op_type = "merge_lod_tensor"
+        t = np.random.rand(4, 3).astype("float32")
+        f = np.random.rand(4, 3).astype("float32")
+        mask = np.array([[1], [0], [1], [0]], dtype=bool)
+        self.inputs = {"InTrue": t, "InFalse": f, "Mask": mask}
+        self.outputs = {"Out": np.where(mask, t, f)}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestSplitByref(OpTest):
+    def setUp(self):
+        self.op_type = "split_byref"
+        x = np.random.rand(7, 3).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"sections": [3, 4]}
+        self.outputs = {"Out": [("sb_out0", x[:3]), ("sb_out1", x[3:])]}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestSplitMergeIds(unittest.TestCase):
+    def test_round_trip(self):
+        """split_ids shards by id%n with masked layout; merge_ids restores a
+        per-position lookup result (reference split_ids_op.cc semantics under
+        the static-shape redesign)."""
+        main = framework.Program()
+        startup = framework.Program()
+        ids = np.array([0, 3, 4, 7, 2], dtype="int64")
+        table = np.random.rand(8, 3).astype("float32")
+        with fluid.program_guard(main, startup):
+            blk = main.global_block()
+            blk.create_var(name="ids", shape=ids.shape, dtype="int64")
+            blk.create_var(name="table", shape=table.shape, dtype="float32")
+            for i in range(2):
+                blk.create_var(name="shard%d" % i, shape=None, dtype=None)
+            blk.append_op(
+                type="split_ids",
+                inputs={"Ids": ["ids"]},
+                outputs={"Out": ["shard0", "shard1"]},
+                attrs={"num_shards": 2},
+            )
+            # emulate per-shard lookup (masked ids -> zero rows)
+            for i in range(2):
+                blk.create_var(name="rows%d" % i, shape=None, dtype=None)
+                blk.append_op(
+                    type="lookup_table",
+                    inputs={"Ids": ["shard%d" % i], "W": ["table"]},
+                    outputs={"Out": ["rows%d" % i]},
+                    attrs={"padding_idx": -1},
+                )
+            blk.create_var(name="merged", shape=None, dtype=None)
+            blk.append_op(
+                type="merge_ids",
+                inputs={"Ids": ["ids"], "X": ["rows0", "rows1"]},
+                outputs={"Out": ["merged"]},
+            )
+        exe = Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            (merged,) = exe.run(
+                main,
+                feed={"ids": ids, "table": table},
+                fetch_list=["merged"],
+            )
+        np.testing.assert_allclose(merged, table[ids], rtol=1e-5)
+
+
+class TestSaveLoadOps(unittest.TestCase):
+    def test_save_load_roundtrip(self):
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "var.npy")
+            val = np.random.rand(3, 4).astype("float32")
+            main = framework.Program()
+            blk = main.global_block()
+            blk.create_var(name="v", shape=val.shape, dtype="float32")
+            blk.append_op(
+                type="save",
+                inputs={"X": ["v"]},
+                outputs={},
+                attrs={"file_path": path},
+            )
+            exe = Executor(fluid.CPUPlace())
+            with scope_guard(Scope()):
+                exe.run(main, feed={"v": val}, fetch_list=[])
+            self.assertTrue(os.path.exists(path))
+
+            main2 = framework.Program()
+            blk2 = main2.global_block()
+            blk2.create_var(name="w", shape=val.shape, dtype="float32")
+            blk2.append_op(
+                type="load",
+                inputs={},
+                outputs={"Out": ["w"]},
+                attrs={"file_path": path},
+            )
+            scope = Scope()
+            with scope_guard(scope):
+                exe.run(main2, feed={}, fetch_list=[])
+                np.testing.assert_allclose(np.asarray(scope.find_var("w")), val)
+
+    def test_save_combine_load_combine(self):
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "combined.npz")
+            a = np.random.rand(2, 2).astype("float32")
+            b = np.random.rand(3).astype("float32")
+            main = framework.Program()
+            blk = main.global_block()
+            blk.create_var(name="a", shape=a.shape, dtype="float32")
+            blk.create_var(name="b", shape=b.shape, dtype="float32")
+            blk.append_op(
+                type="save_combine",
+                inputs={"X": ["a", "b"]},
+                outputs={},
+                attrs={"file_path": path},
+            )
+            exe = Executor(fluid.CPUPlace())
+            with scope_guard(Scope()):
+                exe.run(main, feed={"a": a, "b": b}, fetch_list=[])
+
+            main2 = framework.Program()
+            blk2 = main2.global_block()
+            blk2.create_var(name="a", shape=a.shape, dtype="float32")
+            blk2.create_var(name="b", shape=b.shape, dtype="float32")
+            blk2.append_op(
+                type="load_combine",
+                inputs={},
+                outputs={"Out": ["a", "b"]},
+                attrs={"file_path": path},
+            )
+            scope = Scope()
+            with scope_guard(scope):
+                exe.run(main2, feed={}, fetch_list=[])
+                np.testing.assert_allclose(np.asarray(scope.find_var("a")), a)
+                np.testing.assert_allclose(np.asarray(scope.find_var("b")), b)
+
+
+class TestDeleteVar(unittest.TestCase):
+    def test_delete(self):
+        main = framework.Program()
+        blk = main.global_block()
+        blk.create_var(name="v", shape=[2], dtype="float32")
+        blk.append_op(
+            type="delete_var", inputs={"X": ["v"]}, outputs={}, attrs={}
+        )
+        exe = Executor(fluid.CPUPlace())
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(main, feed={"v": np.zeros(2, "float32")}, fetch_list=[])
+            self.assertIsNone(scope.find_var("v"))
+
+
+if __name__ == "__main__":
+    unittest.main()
