@@ -10,6 +10,8 @@ import random
 import threading
 import queue as Queue
 
+from . import creator  # noqa: F401 — np_array/text_file/recordio creators
+
 __all__ = [
     "map_readers",
     "buffered",
