@@ -27,11 +27,15 @@ from . import (
     unique_name,
 )
 from . import distributed  # noqa: F401
+from . import profiler  # noqa: F401
+from . import native  # noqa: F401
 from .batch import batch
 from .data_feeder import DataFeeder
 from .py_reader import EOFException
 from .backward import append_backward
 from .executor import Executor, Scope, global_scope, scope_guard
+from .async_executor import AsyncExecutor
+from .data_feed_desc import DataFeedDesc
 from .framework import (
     Program,
     Variable,

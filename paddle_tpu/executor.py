@@ -324,24 +324,33 @@ class _SegmentedBlock:
             scope.set_var(
                 name, value if isinstance(value, jax.Array) else jnp.asarray(value)
             )
+        from . import profiler as _prof
+
         for i, (kind, payload) in enumerate(self.segments):
             if kind == "host":
-                registry.get(payload.type).host_fn(payload, scope)
+                with _prof.RecordEvent("host_op/%s" % payload.type):
+                    registry.get(payload.type).host_fn(payload, scope)
                 continue
             if not payload:
                 continue
             compiled = self._compiled[i]
             if compiled is None:
-                compiled = _CompiledBlock(
-                    self.program,
-                    self.block,
-                    [],
-                    self._exports[i],
-                    scope,
-                    ops_override=payload,
-                )
+                with _prof.RecordEvent("compile/segment_%d" % i):
+                    compiled = _CompiledBlock(
+                        self.program,
+                        self.block,
+                        [],
+                        self._exports[i],
+                        scope,
+                        ops_override=payload,
+                    )
                 self._compiled[i] = compiled
-            vals = compiled(scope, {})
+            with _prof.RecordEvent("xla_segment_%d" % i):
+                vals = compiled(scope, {})
+                if _prof.is_profiling():
+                    # XLA dispatch is async; block so the event spans compute
+                    # (reference FLAGS_benchmark dev_ctx->Wait, operator.cc:769)
+                    vals = [jax.block_until_ready(v) for v in vals]
             for name, val in zip(self._exports[i], vals):
                 scope.set_var(name, val)
         return [scope.find_var(n) for n in self.fetch_names]
@@ -417,24 +426,30 @@ class Executor:
             tuple(fetch_names),
             id(scope),
         )
+        from . import profiler as _prof
+
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             has_host = any(
                 registry.is_registered(op.type) and registry.get(op.type).is_host
                 for op in block.ops
             )
-            if has_host:
-                compiled = _SegmentedBlock(
-                    program, block, list(feed_arrays.keys()), fetch_names
-                )
-            else:
-                compiled = _CompiledBlock(
-                    program, block, list(feed_arrays.keys()), fetch_names, scope
-                )
+            with _prof.RecordEvent("prepare/block0"):
+                if has_host:
+                    compiled = _SegmentedBlock(
+                        program, block, list(feed_arrays.keys()), fetch_names
+                    )
+                else:
+                    compiled = _CompiledBlock(
+                        program, block, list(feed_arrays.keys()), fetch_names, scope
+                    )
             if use_program_cache:
                 self._cache[key] = compiled
 
-        fetches = compiled(scope, feed_arrays)
+        with _prof.RecordEvent("run/block0"):
+            fetches = compiled(scope, feed_arrays)
+            if _prof.is_profiling():
+                fetches = [jax.block_until_ready(f) for f in fetches]
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

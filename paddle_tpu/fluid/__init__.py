@@ -6,6 +6,7 @@ from .. import (  # noqa: F401
     backward,
     clip,
     framework,
+    profiler,
     initializer,
     io,
     layers,
@@ -27,6 +28,8 @@ from ..transpiler import (  # noqa: F401
 from ..data_feeder import DataFeeder  # noqa: F401
 from ..py_reader import EOFException  # noqa: F401
 from ..executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from ..async_executor import AsyncExecutor  # noqa: F401
+from ..data_feed_desc import DataFeedDesc  # noqa: F401
 from ..parallel_executor import (  # noqa: F401
     BuildStrategy,
     ExecutionStrategy,

@@ -28,6 +28,10 @@ _lib_lock = threading.Lock()
 
 
 def _build():
+    # compile to a temp path and rename into place: concurrent processes
+    # (pytest workers, multi-process trainers) may race the build, and a
+    # half-written .so must never be dlopen-able
+    tmp = "%s.%d.tmp" % (_LIB, os.getpid())
     cmd = [
         "g++",
         "-O2",
@@ -35,7 +39,7 @@ def _build():
         "-fPIC",
         "-shared",
         "-o",
-        _LIB,
+        tmp,
         _SRC,
         "-lz",
         "-lpthread",
@@ -45,6 +49,7 @@ def _build():
         raise RuntimeError(
             "native runtime build failed (%s):\n%s" % (" ".join(cmd), proc.stderr)
         )
+    os.replace(tmp, _LIB)
 
 
 def lib():
@@ -115,6 +120,8 @@ def lib():
         ]
         L.msdf_join.restype = ctypes.c_long
         L.msdf_join.argtypes = [ctypes.c_void_p]
+        L.msdf_file_errors.restype = ctypes.c_long
+        L.msdf_file_errors.argtypes = [ctypes.c_void_p]
         L.msdf_destroy.argtypes = [ctypes.c_void_p]
         _lib = L
     return _lib
@@ -303,6 +310,10 @@ class MultiSlotDataFeed:
         if self._started:
             self._closer.join()
         return self.errors
+
+    def file_errors(self):
+        """Count of shard files that could not be opened at all."""
+        return lib().msdf_file_errors(self._h) if self._h else 0
 
     def __del__(self):
         # order matters: close the queue (unblocks workers stuck on push),

@@ -114,6 +114,9 @@ struct Chunk {
     } else {
       payload = std::move(buf);
     }
+    // num_records is header-declared and not CRC-protected: bound it by the
+    // payload (each record costs >= 4 header bytes) before reserving
+    if (num > payload.size() / 4 + 1) return -1;
     size_t pos = 0;
     records.reserve(num);
     for (uint32_t i = 0; i < num; ++i) {
@@ -202,6 +205,7 @@ struct MultiSlotFeed {
   std::vector<std::thread> workers;
   std::atomic<size_t> next_file{0};
   std::atomic<long> parse_errors{0};
+  std::atomic<long> file_errors{0};  // unopenable shards — a loud failure
 
   void ParseLine(const char* line, std::string* out) {
     const char* p = line;
@@ -242,7 +246,7 @@ struct MultiSlotFeed {
       if (i >= files.size()) break;
       FILE* f = fopen(files[i].c_str(), "r");
       if (!f) {
-        parse_errors.fetch_add(1);
+        file_errors.fetch_add(1);
         continue;
       }
       char* line = nullptr;
@@ -420,6 +424,10 @@ long msdf_join(void* hm) {
   for (auto& t : m->workers) t.join();
   m->workers.clear();
   return m->parse_errors.load();
+}
+
+long msdf_file_errors(void* hm) {
+  return static_cast<MultiSlotFeed*>(hm)->file_errors.load();
 }
 
 void msdf_destroy(void* hm) { delete static_cast<MultiSlotFeed*>(hm); }

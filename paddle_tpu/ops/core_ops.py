@@ -806,6 +806,10 @@ def _lookup_table(ctx, ins, attrs):
     padding_idx = int(attrs.get("padding_idx", -1))
     flat = ids.reshape(-1).astype(jnp.int32)
     out = jnp.take(w, flat, axis=0)
+    # negative ids are padding/masked slots (AsyncExecutor's bucketed batches,
+    # split_ids' shard masks): zero rows, zero grad — jnp.take alone would
+    # clip them to row 0 and silently contribute it
+    out = jnp.where((flat < 0)[:, None], 0.0, out)
     if padding_idx != -1:
         pad = padding_idx if padding_idx >= 0 else padding_idx + w.shape[0]
         out = jnp.where((flat == pad)[:, None], 0.0, out)

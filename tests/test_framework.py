@@ -133,3 +133,50 @@ def test_package_import_does_not_initialize_backend():
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, timeout=120)
     assert r.returncode == 0 and "clean" in r.stdout, r.stdout + r.stderr
+
+
+def test_profiler_context_and_timeline(tmp_path):
+    """Reference test_profiler.py pattern: run a tiny train loop under the
+    profiler context, assert events were aggregated and the dump converts to
+    a chrome trace."""
+    import json
+    import os
+    import sys
+
+    from paddle_tpu.executor import Scope, scope_guard
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="px", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        path = str(tmp_path / "profile")
+        with fluid.profiler.profiler("All", "total", path):
+            for _ in range(3):
+                exe.run(
+                    main,
+                    feed={"px": np.ones((2, 4), "float32")},
+                    fetch_list=[loss.name],
+                )
+        assert not fluid.profiler.is_profiling()
+        with open(path) as f:
+            dump = json.load(f)
+        names = {e["name"] for e in dump["events"]}
+        assert any("run/block0" in n for n in names)
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+        try:
+            import timeline
+
+            out = str(tmp_path / "timeline.json")
+            n = timeline.convert(path, out)
+            assert n > 0
+            with open(out) as f:
+                trace = json.load(f)
+            assert "traceEvents" in trace
+        finally:
+            sys.path.pop(0)
+    fluid.profiler.reset_profiler()

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Convert profiler dumps into chrome://tracing JSON.
+
+Reference analog: tools/timeline.py:36-160 (protobuf profile → chrome trace,
+with --profile_path accepting 'name1=path1,name2=path2' to merge traces from
+multiple trainers into one timeline under distinct pids).
+
+Usage:
+  python tools/timeline.py --profile_path /tmp/profile --timeline_path /tmp/timeline.json
+  python tools/timeline.py --profile_path trainer0=/tmp/p0,trainer1=/tmp/p1 ...
+Then open chrome://tracing and load the output.
+"""
+
+import argparse
+import json
+
+
+def _load(profile_path):
+    named = []
+    if "=" in profile_path:
+        for part in profile_path.split(","):
+            name, _, path = part.partition("=")
+            named.append((name, path))
+    else:
+        named.append(("process", profile_path))
+    return named
+
+
+def convert(profile_path, timeline_path):
+    trace_events = []
+    metadata = []
+    for pid, (name, path) in enumerate(_load(profile_path)):
+        with open(path) as f:
+            dump = json.load(f)
+        metadata.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": name},
+            }
+        )
+        for ev in dump["events"]:
+            trace_events.append(
+                {
+                    "name": ev["name"],
+                    "cat": "host",
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": ev["tid"] % 100000,
+                    "ts": ev["start"] * 1e6,
+                    "dur": (ev["end"] - ev["start"]) * 1e6,
+                }
+            )
+    with open(timeline_path, "w") as f:
+        json.dump({"traceEvents": metadata + trace_events}, f)
+    return len(trace_events)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_path", required=True)
+    ap.add_argument("--timeline_path", required=True)
+    args = ap.parse_args()
+    n = convert(args.profile_path, args.timeline_path)
+    print("wrote %d events to %s" % (n, args.timeline_path))
