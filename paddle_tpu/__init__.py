@@ -28,6 +28,10 @@ from . import (
 )
 from . import distributed  # noqa: F401
 from . import profiler  # noqa: F401
+from . import imperative  # noqa: F401
+from . import debugger  # noqa: F401
+from . import average  # noqa: F401
+from . import evaluator  # noqa: F401
 from . import native  # noqa: F401
 from .batch import batch
 from .data_feeder import DataFeeder

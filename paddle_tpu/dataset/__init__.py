@@ -9,6 +9,35 @@ sample stream with the same shapes/dtypes/vocabulary so that models, readers,
 and tests exercise the identical code path.
 """
 
-from . import cifar, imdb, mnist, uci_housing
+from . import (
+    cifar,
+    conll05,
+    flowers,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    mq2007,
+    sentiment,
+    uci_housing,
+    voc2012,
+    wmt14,
+    wmt16,
+)
 
-__all__ = ["mnist", "cifar", "uci_housing", "imdb", "common"]
+__all__ = [
+    "mnist",
+    "cifar",
+    "uci_housing",
+    "imdb",
+    "imikolov",
+    "movielens",
+    "sentiment",
+    "conll05",
+    "flowers",
+    "voc2012",
+    "wmt14",
+    "wmt16",
+    "mq2007",
+    "common",
+]

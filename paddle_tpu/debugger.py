@@ -1,0 +1,80 @@
+"""Program pretty-printer + graphviz export.
+
+Reference analog: python/paddle/fluid/debugger.py (pprint_program_codes /
+pprint_block_codes over the protobuf descs, draw_block_graphviz) and
+graphviz.py/net_drawer.py dot emitters; C++ side had ir/graph_viz_pass.cc.
+Here the IR is the in-memory Program, so the printers walk Blocks directly.
+"""
+
+__all__ = ["pprint_program_codes", "pprint_block_codes", "draw_block_graphviz"]
+
+from . import framework
+
+
+def _repr_var(v):
+    shape = "?" if v.shape is None else "x".join(str(d) for d in v.shape)
+    return "%s[%s,%s]" % (v.name, v.dtype or "?", shape)
+
+
+def _repr_op(op):
+    ins = ", ".join(
+        "%s=%s" % (slot, names) for slot, names in sorted(op.inputs.items()) if names
+    )
+    outs = ", ".join(
+        "%s=%s" % (slot, names) for slot, names in sorted(op.outputs.items()) if names
+    )
+    attrs = {
+        k: v
+        for k, v in op.attrs.items()
+        if not k.startswith("__") and k not in (framework.OpRole.OP_ROLE_KEY,)
+        and not isinstance(v, framework.Block)
+    }
+    return "%s(%s) -> %s  %s" % (op.type, ins, outs, attrs if attrs else "")
+
+
+def pprint_block_codes(block, show_backward=False):
+    lines = ["block_%d {" % block.idx]
+    for v in block.vars.values():
+        lines.append("  var %s%s" % (_repr_var(v), " persist" if v.persistable else ""))
+    for op in block.ops:
+        role = op.attrs.get(framework.OpRole.OP_ROLE_KEY)
+        if not show_backward and role == framework.OpRole.Backward:
+            continue
+        lines.append("  " + _repr_op(op))
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program, show_backward=False):
+    return "\n".join(
+        pprint_block_codes(program.block(i), show_backward)
+        for i in range(program.num_blocks)
+    )
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Emit a dot graph: op nodes (boxes) wired through var nodes (ellipses),
+    like the reference's draw_block_graphviz / graph_viz_pass."""
+    highlights = set(highlights or [])
+    lines = ["digraph G {", "  rankdir=TB;"]
+    seen_vars = set()
+
+    def var_node(name):
+        if name not in seen_vars:
+            seen_vars.add(name)
+            color = ' style=filled fillcolor="#ffd2d2"' if name in highlights else ""
+            lines.append('  "v_%s" [label="%s" shape=ellipse%s];' % (name, name, color))
+        return '"v_%s"' % name
+
+    for i, op in enumerate(block.ops):
+        op_id = '"op_%d_%s"' % (i, op.type)
+        lines.append("  %s [label=\"%s\" shape=box style=filled fillcolor=\"#d2e5ff\"];" % (op_id, op.type))
+        for name in op.input_arg_names:
+            lines.append("  %s -> %s;" % (var_node(name), op_id))
+        for name in op.output_arg_names:
+            lines.append("  %s -> %s;" % (op_id, var_node(name)))
+    lines.append("}")
+    dot = "\n".join(lines)
+    with open(path, "w") as f:
+        f.write(dot)
+    return dot

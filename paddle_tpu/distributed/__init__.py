@@ -6,3 +6,6 @@ this package is the host-side RPC tier used by the pserver transpile mode.
 """
 
 from .rpc import RPCClient, RPCServer  # noqa: F401
+
+from . import master  # noqa: F401
+from .master import Master, MasterClient  # noqa: F401

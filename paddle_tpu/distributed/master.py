@@ -1,0 +1,250 @@
+"""Fault-tolerant dataset task master + client.
+
+Reference analog: go/master/service.go — partitions RecordIO chunks into
+tasks (:69-106), dispatches them to trainers, re-dispatches tasks whose
+owner times out (:311-341), discards tasks that failed `failure_max` times
+(:368+), and snapshots state to etcd for recovery (:166-207); trainers use
+python/paddle/v2/master/client.py (get_task / task_finished / task_failed).
+
+TPU-native redesign: same task state machine, JSON-line protocol over TCP
+(the cluster fabric here is plain sockets, like distributed/rpc.py), and the
+etcd snapshot becomes an atomic local-file snapshot (the coordination service
+of a TPU pod slice is per-job, not a shared etcd) — restart the master with
+the same snapshot_path and pending/todo state is recovered.
+
+Tasks are (path, begin, end) RecordIO byte ranges produced from
+native.chunk_offsets, so a trainer reads its shard with
+reader.creator.recordio(path, begin, end).
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+from .. import native
+
+__all__ = ["Master", "MasterClient"]
+
+
+class _Task:
+    def __init__(self, task_id, path, begin, end):
+        self.id = task_id
+        self.path = path
+        self.begin = begin
+        self.end = end
+        self.failures = 0
+        self.deadline = None  # set while dispatched
+
+    def spec(self):
+        return {"id": self.id, "path": self.path, "begin": self.begin, "end": self.end}
+
+
+class Master:
+    def __init__(
+        self,
+        endpoint="127.0.0.1:0",
+        chunks_per_task=8,
+        timeout_s=30.0,
+        failure_max=3,
+        snapshot_path=None,
+    ):
+        self.chunks_per_task = chunks_per_task
+        self.timeout_s = timeout_s
+        self.failure_max = failure_max
+        self.snapshot_path = snapshot_path
+        self.todo = []
+        self.pending = {}  # id -> _Task
+        self.done = []
+        self.discarded = []
+        self._lock = threading.Lock()
+        self._next_id = 0
+        host, _, port = endpoint.rpartition(":")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host or "127.0.0.1", int(port)))
+        self._sock.listen(64)
+        self.endpoint = "%s:%d" % (host or "127.0.0.1", self._sock.getsockname()[1])
+        self._closed = False
+        self._recovered = False
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover()
+            self._recovered = True
+
+    # ------------------------------ dataset -------------------------------
+
+    def set_dataset(self, paths):
+        """Partition files into chunk-range tasks (service.go partition()).
+        A no-op after snapshot recovery — the restart script re-runs this, and
+        appending fresh tasks would re-train every finished shard (the
+        reference's SetDataset skips when state was recovered the same way)."""
+        with self._lock:
+            if self._recovered:
+                return
+            for path in paths:
+                offsets = native.chunk_offsets(path) + [os.path.getsize(path)]
+                for i in range(0, len(offsets) - 1, self.chunks_per_task):
+                    begin = offsets[i]
+                    end = offsets[min(i + self.chunks_per_task, len(offsets) - 1)]
+                    self.todo.append(_Task(self._next_id, path, begin, end))
+                    self._next_id += 1
+            self._snapshot_locked()
+
+    # ----------------------------- state I/O ------------------------------
+
+    def _snapshot_locked(self):
+        if not self.snapshot_path:
+            return
+        state = {
+            "next_id": self._next_id,
+            "todo": [t.spec() | {"failures": t.failures} for t in self.todo]
+            + [t.spec() | {"failures": t.failures} for t in self.pending.values()],
+            "done": self.done,
+            "discarded": self.discarded,
+        }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.snapshot_path)  # atomic, like etcd txn
+
+    def _recover(self):
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        self._next_id = state["next_id"]
+        for spec in state["todo"]:
+            t = _Task(spec["id"], spec["path"], spec["begin"], spec["end"])
+            t.failures = spec.get("failures", 0)
+            self.todo.append(t)
+        self.done = state["done"]
+        self.discarded = state["discarded"]
+
+    # ----------------------------- scheduling -----------------------------
+
+    def _requeue_timed_out_locked(self):
+        now = time.monotonic()
+        for tid in [t for t, task in self.pending.items() if task.deadline < now]:
+            task = self.pending.pop(tid)
+            task.failures += 1
+            if task.failures >= self.failure_max:
+                self.discarded.append(task.id)  # service.go failure_max drop
+            else:
+                self.todo.append(task)
+
+    def _handle(self, req):
+        op = req.get("op")
+        with self._lock:
+            self._requeue_timed_out_locked()
+            if op == "get_task":
+                if not self.todo:
+                    if self.pending:
+                        return {"status": "wait"}
+                    return {"status": "no_more"}
+                task = self.todo.pop(0)
+                task.deadline = time.monotonic() + self.timeout_s
+                self.pending[task.id] = task
+                self._snapshot_locked()
+                return {"status": "ok", "task": task.spec()}
+            if op == "task_finished":
+                task = self.pending.pop(int(req["id"]), None)
+                if task is not None:
+                    self.done.append(task.id)
+                    self._snapshot_locked()
+                return {"status": "ok"}
+            if op == "task_failed":
+                task = self.pending.pop(int(req["id"]), None)
+                if task is not None:
+                    task.failures += 1
+                    if task.failures >= self.failure_max:
+                        self.discarded.append(task.id)
+                    else:
+                        self.todo.append(task)
+                    self._snapshot_locked()
+                return {"status": "ok"}
+            if op == "stats":
+                return {
+                    "status": "ok",
+                    "todo": len(self.todo),
+                    "pending": len(self.pending),
+                    "done": len(self.done),
+                    "discarded": len(self.discarded),
+                }
+        return {"status": "bad_request"}
+
+    # ------------------------------ serving -------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def _serve(self):
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn):
+        try:
+            f = conn.makefile("rw")
+            for line in f:
+                resp = self._handle(json.loads(line))
+                f.write(json.dumps(resp) + "\n")
+                f.flush()
+        except (OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class MasterClient:
+    """Trainer-side client (reference python/paddle/v2/master/client.py)."""
+
+    def __init__(self, endpoint, timeout=60.0):
+        host, _, port = endpoint.rpartition(":")
+        self._conn = socket.create_connection((host, int(port)), timeout=timeout)
+        self._f = self._conn.makefile("rw")
+        self._lock = threading.Lock()
+
+    def _call(self, req):
+        with self._lock:
+            self._f.write(json.dumps(req) + "\n")
+            self._f.flush()
+            return json.loads(self._f.readline())
+
+    def get_task(self, wait_s=0.2):
+        """Blocks until a task is available; returns None when the dataset is
+        exhausted (every task done or discarded)."""
+        while True:
+            resp = self._call({"op": "get_task"})
+            if resp["status"] == "ok":
+                return resp["task"]
+            if resp["status"] == "no_more":
+                return None
+            time.sleep(wait_s)
+
+    def task_finished(self, task_id):
+        self._call({"op": "task_finished", "id": task_id})
+
+    def task_failed(self, task_id):
+        self._call({"op": "task_failed", "id": task_id})
+
+    def stats(self):
+        return self._call({"op": "stats"})
+
+    def close(self):
+        try:
+            self._conn.close()
+        except OSError:
+            pass

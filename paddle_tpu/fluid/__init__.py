@@ -5,7 +5,11 @@ from .. import *  # noqa: F401,F403
 from .. import (  # noqa: F401
     backward,
     clip,
+    average,
+    debugger,
+    evaluator,
     framework,
+    imperative,
     profiler,
     initializer,
     io,

@@ -1,0 +1,20 @@
+"""Imperative (eager) mode — define-by-run on jax arrays with a gradient tape.
+
+Reference analog: python/paddle/fluid/imperative/ (layers.py PyLayer base) +
+paddle/fluid/imperative/tracer.{h,cc} — the embryonic eager mode of Fluid
+1.2: ops execute immediately while a C++ tracer records an autograd tape
+that backward() replays.
+
+TPU-first redesign: eager values ARE jax arrays, so "executing an op" is just
+calling its jnp/lowering function, and the tape doesn't need per-op grad
+kernels — each traced call stores the jax.vjp residual closure, and
+backward() walks the tape applying cotangents. A Layer's forward is any
+jnp-composed function; its __call__ is traced as ONE tape node, which also
+means XLA can jit the whole layer body (layer.jit()) without changing user
+code — the per-op dispatch the reference's tracer did never exists here.
+"""
+
+from .base import Tape, Variable, enabled, guard, to_variable  # noqa: F401
+from .layers import Layer, PyLayer  # noqa: F401
+
+__all__ = ["guard", "enabled", "to_variable", "Variable", "Layer", "PyLayer", "Tape"]

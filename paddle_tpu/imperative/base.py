@@ -1,0 +1,136 @@
+"""Eager variables + gradient tape (reference imperative/tracer.{h,cc}
+redesigned over jax.vjp; see package docstring)."""
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_state = {"tape": None}
+
+
+def enabled():
+    return _state["tape"] is not None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """`with fluid.imperative.guard():` — activates eager tracing (reference
+    imperative.base.guard switched the tracer on)."""
+    prev = _state["tape"]
+    _state["tape"] = Tape()
+    try:
+        yield
+    finally:
+        _state["tape"] = prev
+
+
+def current_tape():
+    return _state["tape"]
+
+
+class Variable:
+    """Eager value: a jax array + accumulated gradient. The reference's
+    VarBase (imperative/layers.h) held a tensor and grad slot the same way."""
+
+    def __init__(self, value, stop_gradient=False, name=None):
+        self.value = jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self.name = name
+        self._grad = None
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def _accum(self, g):
+        self._grad = g if self._grad is None else self._grad + g
+
+    def backward(self):
+        """Reverse the tape from this (scalar) variable (reference
+        tracer.cc backward pass over the recorded ops)."""
+        tape = current_tape()
+        if tape is None:
+            raise RuntimeError("backward() outside imperative.guard()")
+        if self.value.size != 1:
+            raise ValueError("backward() needs a scalar loss")
+        tape.backward(self)
+
+    def __repr__(self):
+        return "imperative.Variable(shape=%s, dtype=%s)" % (self.shape, self.dtype)
+
+
+def to_variable(value, block=None, name=None):
+    if isinstance(value, Variable):
+        return value
+    return Variable(value, name=name)
+
+
+class _Node:
+    __slots__ = ("vjp_fn", "inputs", "outputs")
+
+    def __init__(self, vjp_fn, inputs, outputs):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.outputs = outputs
+
+
+class Tape:
+    def __init__(self):
+        self.nodes = []
+
+    def trace(self, fn, inputs):
+        """Run fn(*arrays) under jax.vjp, record the node, return eager
+        Variables. Differentiable leaves are the float inputs with
+        stop_gradient=False."""
+        leaves = [
+            v
+            for v in inputs
+            if not v.stop_gradient and jnp.issubdtype(v.value.dtype, jnp.inexact)
+        ]
+        closed = [v.value for v in inputs]
+        leaf_pos = [i for i, v in enumerate(inputs) if v in leaves]
+
+        def f(*leaf_vals):
+            vals = list(closed)
+            for p, lv in zip(leaf_pos, leaf_vals):
+                vals[p] = lv
+            out = fn(*vals)
+            return out if isinstance(out, tuple) else (out,)
+
+        primals, vjp_fn = jax.vjp(f, *[v.value for v in leaves])
+        outs = [Variable(p) for p in primals]
+        if leaves:
+            self.nodes.append(_Node(vjp_fn, leaves, outs))
+        return outs
+
+    def backward(self, root):
+        root._accum(jnp.ones_like(root.value))
+        for node in reversed(self.nodes):
+            if all(o._grad is None for o in node.outputs):
+                continue  # no cotangent reached this node
+            cots = tuple(
+                o._grad if o._grad is not None else jnp.zeros_like(o.value)
+                for o in node.outputs
+            )
+            grads = node.vjp_fn(cots)
+            for v, g in zip(node.inputs, grads):
+                # PyLayer nodes list ALL inputs (user backward returns grads
+                # positionally); stop_gradient inputs discard theirs here so
+                # position i's grad can never land on a different variable
+                if not v.stop_gradient:
+                    v._accum(g)

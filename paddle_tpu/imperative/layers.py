@@ -1,0 +1,120 @@
+"""Eager Layer / PyLayer bases (reference imperative/layers.py:25 PyLayer —
+forward() over ops traced per call).
+
+Layer.forward is written against jax.numpy values; __call__ traces the whole
+body as one tape node (see base.Tape.trace), so backward() differentiates it
+with jax.vjp and `jit()` compiles it without user changes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import base
+from .base import Variable, to_variable
+
+
+class Layer:
+    """Compose parameters + a jnp-based forward (reference imperative Layer).
+
+    Subclass contract: create parameters in __init__ via create_parameter;
+    implement forward(self, *arrays) taking/returning jax arrays (NOT eager
+    Variables — the tape passes values in, wraps values out)."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._dtype = dtype
+        self._params = []
+        self._sublayers = []
+
+    def create_parameter(self, shape, dtype=None, initializer=None, name=None):
+        dtype = dtype or self._dtype
+        if initializer is None:
+            fan_in = int(np.prod(shape[:-1])) or 1
+            init = np.random.uniform(
+                -1.0 / np.sqrt(fan_in), 1.0 / np.sqrt(fan_in), shape
+            ).astype(dtype)
+        elif callable(initializer):
+            init = np.asarray(initializer(shape)).astype(dtype)
+        else:
+            init = np.full(shape, float(initializer), dtype)
+        p = Variable(init, name=name)
+        self._params.append(p)
+        return p
+
+    def add_sublayer(self, layer):
+        self._sublayers.append(layer)
+        return layer
+
+    def parameters(self):
+        out = list(self._params)
+        for sub in self._sublayers:
+            out.extend(sub.parameters())
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def _fn(self):
+        return self.forward
+
+    def __call__(self, *inputs):
+        tape = base.current_tape()
+        vars_in = [to_variable(v) for v in inputs]
+        params = self.parameters()
+        fn = self._fn()
+
+        def run(*vals):
+            xs = vals[: len(vars_in)]
+            ps = vals[len(vars_in) :]
+            return fn(*xs, *ps) if params else fn(*xs)
+
+        if tape is None:
+            out = run(*[v.value for v in vars_in], *[p.value for p in params])
+            outs = [Variable(o) for o in (out if isinstance(out, tuple) else (out,))]
+        else:
+            outs = tape.trace(run, vars_in + params)
+        return outs[0] if len(outs) == 1 else outs
+
+    def jit(self):
+        """Compile forward with XLA — same tape semantics, fused body (the
+        capability the reference's per-op tracer could never offer)."""
+        self._jitted = jax.jit(self.forward)
+        self._fn = lambda: self._jitted
+        return self
+
+
+class PyLayer:
+    """Custom-python forward/backward pair (reference imperative/layers.py
+    PyLayer: static forward/backward over numpy)."""
+
+    @staticmethod
+    def forward(*inputs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(*douts):
+        raise NotImplementedError
+
+    @classmethod
+    def __call__(cls, *a):
+        return cls.apply(*a)
+
+    @classmethod
+    def apply(cls, *inputs):
+        tape = base.current_tape()
+        vars_in = [to_variable(v) for v in inputs]
+        vals = [v.value for v in vars_in]
+        out = cls.forward(*[np.asarray(v) for v in vals])
+        outs_vals = out if isinstance(out, tuple) else (out,)
+        outs = [Variable(jnp.asarray(o)) for o in outs_vals]
+        if tape is not None:
+
+            def vjp_fn(cots):
+                gs = cls.backward(*[np.asarray(c) for c in cots])
+                gs = gs if isinstance(gs, tuple) else (gs,)
+                return tuple(jnp.asarray(g) for g in gs)
+
+            # record ALL inputs: the user backward returns one grad per input
+            # positionally; Tape.backward drops grads of stop_gradient vars
+            if any(not v.stop_gradient for v in vars_in):
+                tape.nodes.append(base._Node(vjp_fn, vars_in, outs))
+        return outs[0] if len(outs) == 1 else outs

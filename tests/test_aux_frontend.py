@@ -1,0 +1,192 @@
+"""Tests for auxiliary frontend subsystems: dataset readers, debugger/
+graphviz, WeightedAverage, Evaluator shims, and the fault-tolerant dataset
+master (reference go/master/service_test.go + python dataset tests)."""
+
+import os
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dataset, native
+from paddle_tpu.distributed.master import Master, MasterClient
+from paddle_tpu.framework import Program
+from paddle_tpu.reader import creator
+
+
+# ---------------------------------------------------------------------------
+# datasets
+# ---------------------------------------------------------------------------
+
+
+def test_imikolov_ngram_and_seq():
+    wd = dataset.imikolov.build_dict()
+    assert len(wd) == dataset.imikolov.VOCAB
+    grams = list(dataset.imikolov.train(wd, 5)())
+    assert all(len(g) == 5 for g in grams[:50])
+    seqs = list(dataset.imikolov.train(wd, 5, dataset.imikolov.DataType.SEQ)())
+    src, trg = seqs[0]
+    assert len(src) == len(trg)
+    # determinism
+    assert grams[:10] == list(dataset.imikolov.train(wd, 5)())[:10]
+
+
+def test_wmt14_wmt16():
+    for sample in list(dataset.wmt14.train(100)())[:5]:
+        src, trg_in, trg_next = sample
+        assert trg_in[0] == 0 and trg_next[-1] == 1
+        assert len(trg_in) == len(trg_next)
+        assert all(3 <= t < 100 for t in src)
+    src_d, trg_d = dataset.wmt14.get_dict(100)
+    assert len(src_d) == 100
+    for sample in list(dataset.wmt16.train(80, 90)())[:3]:
+        src, trg_in, trg_next = sample
+        assert all(t < 90 for t in trg_next[:-1])
+
+
+def test_movielens():
+    rows = list(dataset.movielens.train()())[:20]
+    for r in rows:
+        uid, gender, age, job, mid, cats, title, rating = r
+        assert 1 <= uid <= dataset.movielens.max_user_id()
+        assert 1 <= mid <= dataset.movielens.max_movie_id()
+        assert 1.0 <= rating <= 5.0
+        assert isinstance(cats, list) and isinstance(title, list)
+    assert len(dataset.movielens.movie_categories()) == 18
+
+
+def test_conll05_sentiment_flowers_voc_mq2007():
+    w, v, l = dataset.conll05.get_dict()
+    sample = next(iter(dataset.conll05.test()()))
+    assert len(sample) == 9
+    assert len(sample[0]) == len(sample[8])
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape[0] == len(w)
+
+    ids, label = next(iter(dataset.sentiment.train()()))
+    assert label in (0, 1)
+    assert max(ids) < len(dataset.sentiment.get_word_dict())
+
+    img, lbl = next(iter(dataset.flowers.train()()))
+    assert img.shape == (3 * 224 * 224,)
+    assert 0 <= lbl < 102
+
+    img, mask = next(iter(dataset.voc2012.train()()))
+    assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
+    assert mask.max() > 0
+
+    a, b = next(iter(dataset.mq2007.train("pairwise")()))
+    assert a.shape == (46,) and b.shape == (46,)
+    feats, rel = next(iter(dataset.mq2007.train("listwise")()))
+    assert feats.shape == (8, 46) and rel.shape == (8,)
+
+
+# ---------------------------------------------------------------------------
+# debugger / average / evaluator
+# ---------------------------------------------------------------------------
+
+
+def test_debugger_print_and_dot(tmp_path):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="dx", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3, act="relu")
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    text = fluid.debugger.pprint_program_codes(main)
+    assert "block_0 {" in text and "mul(" in text
+    # backward hidden by default
+    assert "mul_grad" not in text
+    assert "mul_grad" in fluid.debugger.pprint_program_codes(main, show_backward=True)
+    dot = fluid.debugger.draw_block_graphviz(
+        main.global_block(), path=str(tmp_path / "g.dot")
+    )
+    assert dot.startswith("digraph G {") and '"v_dx"' in dot
+    assert (tmp_path / "g.dot").exists()
+
+
+def test_weighted_average():
+    wa = fluid.average.WeightedAverage()
+    wa.add(2.0, 1.0)
+    wa.add(4.0, 3.0)
+    assert wa.eval() == pytest.approx(3.5)
+    wa.reset()
+    with pytest.raises(ValueError):
+        wa.eval()
+
+
+def test_detection_map_evaluator():
+    ev = fluid.evaluator.DetectionMAP(class_num=3)
+    # one image: perfect detection of class 1, missed class 2
+    ev.update(
+        detections=[[1, 0.9, 0, 0, 10, 10]],
+        gt_labels=[1, 2],
+        gt_boxes=[[0, 0, 10, 10], [20, 20, 30, 30]],
+    )
+    m = ev.eval()
+    assert 0.0 < m <= 1.0  # AP(class1)=1, AP(class2)=0 -> mAP=0.5
+    assert m == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# fault-tolerant master
+# ---------------------------------------------------------------------------
+
+
+def _make_recordio(td, name, n=40):
+    path = os.path.join(td, name)
+    creator.convert_reader_to_recordio_file(
+        path, lambda: iter(range(n)), max_num_records=10
+    )
+    return path
+
+
+def test_master_dispatch_and_failover():
+    with tempfile.TemporaryDirectory() as td:
+        path = _make_recordio(td, "a.recordio")
+        snap = os.path.join(td, "master.snap")
+        m = Master(
+            chunks_per_task=2, timeout_s=60.0, failure_max=2, snapshot_path=snap
+        ).start()
+        m.set_dataset([path])
+        c = MasterClient(m.endpoint)
+        seen = []
+        t1 = c.get_task()
+        assert t1 is not None
+        # read the shard the task describes
+        recs = list(creator.recordio(t1["path"], t1["begin"], t1["end"])())
+        assert recs == list(range(20))
+        c.task_finished(t1["id"])
+        # fail the second task once -> requeued, finish on retry
+        t2 = c.get_task()
+        c.task_failed(t2["id"])
+        t2b = c.get_task()
+        assert t2b["id"] == t2["id"]
+        c.task_finished(t2b["id"])
+        assert c.get_task() is None
+        stats = c.stats()
+        assert stats["done"] == 2 and stats["todo"] == 0
+        c.close()
+        m.close()
+        # snapshot recovery: fresh master from the snapshot has no todo left
+        m2 = Master(snapshot_path=snap)
+        assert not m2.todo
+        m2.close()
+
+
+def test_master_discards_after_failure_max():
+    with tempfile.TemporaryDirectory() as td:
+        path = _make_recordio(td, "b.recordio", n=10)
+        m = Master(chunks_per_task=10, failure_max=2).start()
+        m.set_dataset([path])
+        c = MasterClient(m.endpoint)
+        t = c.get_task()
+        c.task_failed(t["id"])
+        t = c.get_task()
+        c.task_failed(t["id"])  # second failure -> discard
+        assert c.get_task() is None
+        assert c.stats()["discarded"] == 1
+        c.close()
+        m.close()

@@ -1,0 +1,108 @@
+"""Eager-mode tests (reference unittests/test_imperative.py: PyLayer with
+custom numpy fwd/bwd, a small Layer MLP, gradients checked against manual
+math)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.imperative import Layer, PyLayer, guard, to_variable
+
+
+class MyPyLayer(PyLayer):
+    @staticmethod
+    def forward(x):
+        return np.tanh(x)
+
+    @staticmethod
+    def backward(dout):
+        # caller stashes the forward input on the class (mirrors the
+        # reference test's closure over inputs)
+        x = MyPyLayer.saved
+        return dout * (1.0 - np.tanh(x) ** 2)
+
+
+def test_pylayer_forward_backward():
+    x = np.random.rand(3, 4).astype("float32") - 0.5
+    MyPyLayer.saved = x
+    with guard():
+        vx = to_variable(x)
+        out = MyPyLayer.apply(vx)
+        loss = _sum_layer()(out)
+        loss.backward()
+        grad = vx.gradient()
+    np.testing.assert_allclose(
+        np.asarray(out.value), np.tanh(x), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(grad, 1.0 - np.tanh(x) ** 2, rtol=1e-4, atol=1e-5)
+
+
+def _sum_layer():
+    class SumAll(Layer):
+        def forward(self, x):
+            return x.sum()
+
+    return SumAll()
+
+
+class MLP(Layer):
+    def __init__(self, din, hidden, dout):
+        super().__init__()
+        self.w1 = self.create_parameter([din, hidden])
+        self.b1 = self.create_parameter([hidden], initializer=0.0)
+        self.w2 = self.create_parameter([hidden, dout])
+
+    def forward(self, x, w1, b1, w2):
+        import jax.numpy as jnp
+
+        h = jnp.maximum(x @ w1 + b1, 0.0)
+        return (h @ w2).mean()
+
+
+def test_layer_trains_sgd():
+    np.random.seed(5)
+    mlp = MLP(4, 8, 1)
+    x = np.random.rand(16, 4).astype("float32")
+    losses = []
+    for _ in range(15):
+        with guard():
+            loss = mlp(x)
+            loss.backward()
+            losses.append(float(loss.numpy()))
+            for p in mlp.parameters():
+                g = p.gradient()
+                assert g is not None
+                p.value = p.value - 0.5 * g
+                p.clear_gradient()
+    assert losses[-1] < losses[0]
+
+
+def test_layer_jit_matches_eager():
+    np.random.seed(6)
+    mlp = MLP(4, 8, 1)
+    x = np.random.rand(3, 4).astype("float32")
+    with guard():
+        eager = float(mlp(x).numpy())
+    mlp.jit()
+    with guard():
+        jitted_loss = mlp(x)
+        jitted_loss.backward()
+        jitted = float(jitted_loss.numpy())
+    assert jitted == pytest.approx(eager, rel=1e-5)
+    assert mlp.parameters()[0].gradient() is not None
+
+
+def test_stop_gradient_blocks_flow():
+    with guard():
+        vx = to_variable(np.ones((2, 2), "float32"))
+        vy = to_variable(np.ones((2, 2), "float32"))
+        vy.stop_gradient = True
+
+        class Mul(Layer):
+            def forward(self, a, b):
+                return (a * b).sum()
+
+        loss = Mul()(vx, vy)
+        loss.backward()
+        assert vx.gradient() is not None
+        assert vy.gradient() is None
