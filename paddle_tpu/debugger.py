@@ -38,7 +38,12 @@ def pprint_block_codes(block, show_backward=False):
         lines.append("  var %s%s" % (_repr_var(v), " persist" if v.persistable else ""))
     for op in block.ops:
         role = op.attrs.get(framework.OpRole.OP_ROLE_KEY)
-        if not show_backward and role == framework.OpRole.Backward:
+        # op_role is a bitflag (Backward|Loss on the loss-seed op): test the bit
+        if (
+            not show_backward
+            and role is not None
+            and int(role) & int(framework.OpRole.Backward)
+        ):
             continue
         lines.append("  " + _repr_op(op))
     lines.append("}")

@@ -858,3 +858,235 @@ def _ssd_loss(ctx, ins, attrs):
 
     loss = jax.vmap(per_image)(loc, conf, gtbox, glabel, glen)
     return {"Loss": [loss.reshape(b, 1)]}
+
+
+# ---------------------------------------------------------------------------
+# training-time target assignment (reference detection/rpn_target_assign_op.cc,
+# generate_proposal_labels_op.cc) — fixed-capacity redesign: the reference
+# randomly subsamples fg/bg to a quota with dynamic-size index outputs; here
+# every anchor/roi gets a label in place (-1 ignore, 0 bg, 1..C fg) and
+# per-row weights carry the subsampling quota deterministically (score-ranked
+# instead of randomly drawn), so shapes stay static for XLA
+# ---------------------------------------------------------------------------
+
+
+def _box_deltas(src, gt):
+    """Encode gt relative to src (the reference's BoxToDelta)."""
+    scx, scy, sw, sh = _center_size(src, True)
+    gcx, gcy, gw, gh = _center_size(gt, True)
+    return jnp.stack(
+        [
+            (gcx - scx) / jnp.maximum(sw, 1e-6),
+            (gcy - scy) / jnp.maximum(sh, 1e-6),
+            jnp.log(jnp.maximum(gw, 1e-6) / jnp.maximum(sw, 1e-6)),
+            jnp.log(jnp.maximum(gh, 1e-6) / jnp.maximum(sh, 1e-6)),
+        ],
+        axis=1,
+    )
+
+
+@register("rpn_target_assign", no_grad=True, stochastic=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    """Per-anchor RPN labels/targets. Inputs: Anchor [N,4], GtBox [B,G,4],
+    GtLen [B]. Outputs: TargetLabel [B,N] (-1 ignore / 0 bg / 1 fg),
+    TargetBBox [B,N,4] deltas, ScoreWeight/LocWeight [B,N] marking the
+    sampled quota rows."""
+    (anchors,) = ins["Anchor"]
+    (gtboxes,) = ins["GtBox"]
+    (gtlen,) = ins["GtLen"]
+    pos_thr = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thr = float(attrs.get("rpn_negative_overlap", 0.3))
+    quota = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    n = anchors.shape[0]
+
+    def per_image(gt, g_len):
+        gmask = jnp.arange(gt.shape[0]) < g_len
+        iou = _iou_matrix(anchors, gt) * gmask[None, :].astype(anchors.dtype)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        # anchors that are the best for some VALID gt are fg too (ref :167);
+        # accumulate with .max so a padded gt row (argmax lands on anchor 0)
+        # can never overwrite a real gt's forced-fg write
+        best_per_gt = jnp.argmax(iou, axis=0)
+        forced_fg = jnp.zeros((n,), jnp.bool_).at[best_per_gt].max(gmask)
+        is_fg = forced_fg | (best_iou >= pos_thr)
+        label = jnp.where(is_fg, 1, -1)
+        label = jnp.where((best_iou < neg_thr) & ~is_fg, 0, label)
+        deltas = _box_deltas(anchors, gt[best_gt])
+        n_fg = int(quota * fg_frac)
+        fg_rank = lax.top_k(jnp.where(label == 1, best_iou, -1.0), min(n_fg, n))[0]
+        fg_cut = fg_rank[-1]
+        fg_w = (label == 1) & (best_iou >= jnp.maximum(fg_cut, 0.0))
+        n_bg = quota - n_fg
+        bg_score = jnp.where(label == 0, -best_iou, -2.0)  # prefer low overlap
+        bg_rank = lax.top_k(bg_score, min(n_bg, n))[0]
+        bg_w = (label == 0) & (bg_score >= bg_rank[-1])
+        return label, deltas, (fg_w | bg_w).astype(anchors.dtype), fg_w.astype(
+            anchors.dtype
+        )
+
+    label, deltas, sw, lw = jax.vmap(per_image)(
+        gtboxes, gtlen.reshape(-1).astype(jnp.int32)
+    )
+    return {
+        "TargetLabel": [label.astype(jnp.int32)],
+        "TargetBBox": [deltas],
+        "ScoreWeight": [sw],
+        "LocWeight": [lw],
+    }
+
+
+@register("generate_proposal_labels", no_grad=True, stochastic=True)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """Assign class labels + box targets to RoIs (reference
+    generate_proposal_labels_op.cc). Inputs: RpnRois [B,R,4], GtClasses
+    [B,G], GtBoxes [B,G,4], GtLen [B]. Outputs Rois (passthrough),
+    LabelsInt32 [B,R], BboxTargets [B,R,4], BboxInsideWeights /
+    BboxOutsideWeights [B,R,4], SampleWeight [B,R]."""
+    (rois,) = ins["RpnRois"]
+    (gtcls,) = ins["GtClasses"]
+    (gtboxes,) = ins["GtBoxes"]
+    (gtlen,) = ins["GtLen"]
+    fg_thr = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    quota = int(attrs.get("batch_size_per_im", 512))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    r = rois.shape[1]
+
+    def per_image(rs, gcls, gbx, g_len):
+        gmask = jnp.arange(gbx.shape[0]) < g_len
+        valid_roi = rs[:, 2] > rs[:, 0]
+        iou = _iou_matrix(rs, gbx) * gmask[None, :].astype(rs.dtype)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        is_fg = (best_iou >= fg_thr) & valid_roi
+        is_bg = (best_iou < bg_hi) & (best_iou >= bg_lo) & valid_roi
+        labels = jnp.where(is_fg, gcls[best_gt].astype(jnp.int32), 0)
+        deltas = _box_deltas(rs, gbx[best_gt])
+        n_fg = int(quota * fg_frac)
+        fg_rank = lax.top_k(jnp.where(is_fg, best_iou, -1.0), min(n_fg, r))[0]
+        fg_w = is_fg & (best_iou >= jnp.maximum(fg_rank[-1], 0.0))
+        n_bg = quota - n_fg
+        bg_score = jnp.where(is_bg, -best_iou, -2.0)
+        bg_rank = lax.top_k(bg_score, min(n_bg, r))[0]
+        bg_w = is_bg & (bg_score >= bg_rank[-1])
+        inside = jnp.where(fg_w[:, None], 1.0, 0.0) * jnp.ones((1, 4))
+        sample_w = (fg_w | bg_w).astype(rs.dtype)
+        return labels, deltas, inside, sample_w
+
+    labels, deltas, inside, sample_w = jax.vmap(per_image)(
+        rois, gtcls, gtboxes, gtlen.reshape(-1).astype(jnp.int32)
+    )
+    return {
+        "Rois": [rois],
+        "LabelsInt32": [labels],
+        "BboxTargets": [deltas],
+        "BboxInsideWeights": [inside],
+        "BboxOutsideWeights": [inside],
+        "SampleWeight": [sample_w],
+    }
+
+
+@register("roi_perspective_transform")
+def _roi_perspective_transform(ctx, ins, attrs):
+    """Warp quadrilateral text regions to axis-aligned crops (reference
+    detection/roi_perspective_transform_op.cc): per ROI of 8 coords
+    (x1..y4 clockwise), solve the homography mapping the output rect onto the
+    quad and bilinear-sample. ROIs ride as [B, R, 8] + RoisLen."""
+    (x,) = ins["X"]  # [B, C, H, W]
+    (rois,) = ins["ROIs"]  # [B, R, 8]
+    oh = int(attrs.get("transformed_height", 8))
+    ow = int(attrs.get("transformed_width", 8))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    b, c, h, w = x.shape
+
+    # output-rect corners in (col,row), clockwise from top-left
+    dst = jnp.asarray(
+        [[0.0, 0.0], [ow - 1.0, 0.0], [ow - 1.0, oh - 1.0], [0.0, oh - 1.0]]
+    )
+
+    def homography(quad):
+        # solve the 8 projective params a..h with i=1 from 4 correspondences
+        rows = []
+        rhs = []
+        for k in range(4):
+            sx, sy = dst[k, 0], dst[k, 1]
+            tx, ty = quad[2 * k] * scale, quad[2 * k + 1] * scale
+            rows.append(
+                jnp.stack([sx, sy, 1.0, 0.0 * sx, 0.0 * sx, 0.0 * sx, -sx * tx, -sy * tx])
+            )
+            rhs.append(tx)
+            rows.append(
+                jnp.stack([0.0 * sx, 0.0 * sx, 0.0 * sx, sx, sy, 1.0, -sx * ty, -sy * ty])
+            )
+            rhs.append(ty)
+        A = jnp.stack(rows)
+        bvec = jnp.stack(rhs)
+        p = jnp.linalg.solve(A + 1e-8 * jnp.eye(8), bvec)
+        return jnp.concatenate([p, jnp.ones((1,))]).reshape(3, 3)
+
+    gy, gx = jnp.meshgrid(jnp.arange(oh, dtype=jnp.float32), jnp.arange(ow, dtype=jnp.float32), indexing="ij")
+    ones = jnp.ones_like(gx)
+    grid = jnp.stack([gx, gy, ones], axis=-1)  # (oh, ow, 3)
+
+    def warp_one(img, quad):
+        m = homography(quad)
+        src = grid @ m.T  # (oh, ow, 3)
+        sx = src[..., 0] / jnp.maximum(src[..., 2], 1e-8)
+        sy = src[..., 1] / jnp.maximum(src[..., 2], 1e-8)
+        x0 = jnp.floor(sx)
+        y0 = jnp.floor(sy)
+        out = jnp.zeros((c, oh, ow), img.dtype)
+        for dx in (0, 1):
+            for dy in (0, 1):
+                xi = x0 + dx
+                yi = y0 + dy
+                wgt = (1 - jnp.abs(sx - xi)) * (1 - jnp.abs(sy - yi))
+                inb = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+                xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                out = out + img[:, yc, xc] * (wgt * inb)[None]
+        return out
+
+    def per_image(img, img_rois):
+        return jax.vmap(lambda q: warp_one(img, q))(img_rois)
+
+    out = jax.vmap(per_image)(x, rois)  # [B, R, C, oh, ow]
+    return {"Out": [out]}
+
+
+# detection_map runs on the HOST (reference registers it CPU-only too —
+# detection/detection_map_op.cc has no CUDA kernel): mAP is a metric over
+# variable-length match lists, a poor fit for static-shape XLA, and never on
+# the training hot path. Inputs ride padded: DetectRes [B,N,6]
+# ([label, score, x1, y1, x2, y2], rows with label<0 ignored), Label
+# [B,G,5] ([label, x1, y1, x2, y2], label<0 padding).
+
+
+def _detection_map_host(op, scope):
+    import numpy as np
+
+    from ..evaluator import DetectionMAP as _MAP
+
+    dets = np.asarray(scope.find_var(op.input("DetectRes")[0]))
+    labels = np.asarray(scope.find_var(op.input("Label")[0]))
+    ev = _MAP(
+        class_num=int(op.attrs.get("class_num", 0) or 0) or None,
+        background_label=int(op.attrs.get("background_label", 0)),
+        overlap_threshold=float(op.attrs.get("overlap_threshold", 0.5)),
+        ap_version=op.attrs.get("ap_type", op.attrs.get("ap_version", "integral")),
+    )
+    for img_dets, img_gts in zip(dets, labels):
+        valid_d = img_dets[img_dets[:, 0] >= 0]
+        valid_g = img_gts[img_gts[:, 0] >= 0]
+        ev.update(valid_d, valid_g[:, 0], valid_g[:, 1:5])
+    import jax.numpy as jnp
+
+    scope.set_var(op.output("MAP")[0], jnp.asarray([ev.eval()], jnp.float32))
+
+
+from .registry import register_host as _register_host  # noqa: E402
+
+_register_host("detection_map")(_detection_map_host)

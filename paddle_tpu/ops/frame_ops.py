@@ -303,3 +303,22 @@ def _gen_nccl_id(op, scope):
     slot = "NCCLID" if op.outputs.get("NCCLID") else "Out"
     for out in op.output(slot):
         scope.set_var(out, jnp.zeros((1,), jnp.int32))
+
+
+# program-compat registrations for reader ops: this framework's py_reader
+# path stages batches in Executor.run directly (executor.py pulls
+# program._py_readers), so `read`/`create_*_reader` nodes in imported
+# reference programs are markers, not compute (reference reader/read_op.cc)
+from .registry import register_no_lower
+
+for _t in (
+    "read",
+    "create_custom_reader",
+    "create_recordio_file_reader",
+    "create_shuffle_reader",
+    "create_batch_reader",
+    "create_double_buffer_reader",
+    "create_py_reader",
+    "open_files",
+):
+    register_no_lower(_t)

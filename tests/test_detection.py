@@ -10,7 +10,7 @@ import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu import framework
-from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.executor import Executor, Scope, scope_guard
 
 
 def _fresh():
@@ -302,3 +302,120 @@ def test_yolov3_loss_trains():
             losses.append(float(np.asarray(lv).reshape(())))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_rpn_target_assign():
+    main = framework.Program()
+    blk = main.global_block()
+    anchors = np.array(
+        [[0, 0, 10, 10], [20, 20, 30, 30], [100, 100, 110, 110]], "float32"
+    )
+    gt = np.array([[[1, 1, 9, 9], [21, 21, 31, 31]]], "float32")
+    gtlen = np.array([2], "int64")
+    for name, arr in [("an", anchors), ("gt", gt), ("gl", gtlen)]:
+        blk.create_var(name=name, shape=arr.shape, dtype=str(arr.dtype))
+    for out in ["tl", "tb", "sw", "lw"]:
+        blk.create_var(name=out, shape=None, dtype=None)
+    blk.append_op(
+        type="rpn_target_assign",
+        inputs={"Anchor": ["an"], "GtBox": ["gt"], "GtLen": ["gl"]},
+        outputs={
+            "TargetLabel": ["tl"],
+            "TargetBBox": ["tb"],
+            "ScoreWeight": ["sw"],
+            "LocWeight": ["lw"],
+        },
+        attrs={"rpn_positive_overlap": 0.7, "rpn_negative_overlap": 0.3},
+    )
+    exe = Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        tl, tb, sw = exe.run(
+            main,
+            feed={"an": anchors, "gt": gt, "gl": gtlen},
+            fetch_list=["tl", "tb", "sw"],
+        )
+    assert tl.shape == (1, 3)
+    assert tl[0, 0] == 1 and tl[0, 1] == 1  # high-IoU anchors are fg
+    assert tl[0, 2] == 0  # no-overlap anchor is bg
+    assert tb.shape == (1, 3, 4)
+
+
+def test_generate_proposal_labels():
+    main = framework.Program()
+    blk = main.global_block()
+    rois = np.array([[[0, 0, 10, 10], [18, 18, 32, 32], [50, 50, 60, 60]]], "float32")
+    gtcls = np.array([[3, 7]], "int64")
+    gtbox = np.array([[[1, 1, 9, 9], [20, 20, 30, 30]]], "float32")
+    gtlen = np.array([2], "int64")
+    feeds = {"rr": rois, "gc": gtcls, "gb": gtbox, "gl": gtlen}
+    for name, arr in feeds.items():
+        blk.create_var(name=name, shape=arr.shape, dtype=str(arr.dtype))
+    for out in ["ro", "li", "bt", "biw", "bow", "sw2"]:
+        blk.create_var(name=out, shape=None, dtype=None)
+    blk.append_op(
+        type="generate_proposal_labels",
+        inputs={
+            "RpnRois": ["rr"],
+            "GtClasses": ["gc"],
+            "GtBoxes": ["gb"],
+            "GtLen": ["gl"],
+        },
+        outputs={
+            "Rois": ["ro"],
+            "LabelsInt32": ["li"],
+            "BboxTargets": ["bt"],
+            "BboxInsideWeights": ["biw"],
+            "BboxOutsideWeights": ["bow"],
+            "SampleWeight": ["sw2"],
+        },
+        attrs={"fg_thresh": 0.5},
+    )
+    exe = Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        li, bt = exe.run(main, feed=feeds, fetch_list=["li", "bt"])
+    assert li.shape == (1, 3)
+    assert li[0, 0] == 3 and li[0, 1] == 7  # fg rois take gt class
+    assert li[0, 2] == 0  # far roi is background
+
+
+def test_roi_perspective_transform_identity():
+    main = framework.Program()
+    blk = main.global_block()
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    # axis-aligned quad covering the image corner-to-corner, clockwise
+    rois = np.array([[[0, 0, 3, 0, 3, 3, 0, 3]]], "float32")
+    blk.create_var(name="img", shape=x.shape, dtype="float32")
+    blk.create_var(name="rois", shape=rois.shape, dtype="float32")
+    blk.create_var(name="warped", shape=None, dtype=None)
+    blk.append_op(
+        type="roi_perspective_transform",
+        inputs={"X": ["img"], "ROIs": ["rois"]},
+        outputs={"Out": ["warped"]},
+        attrs={"transformed_height": 4, "transformed_width": 4, "spatial_scale": 1.0},
+    )
+    exe = Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        (out,) = exe.run(main, feed={"img": x, "rois": rois}, fetch_list=["warped"])
+    np.testing.assert_allclose(out[0, 0, 0], x[0, 0], atol=1e-3)
+
+
+def test_detection_map_host_op():
+    main = framework.Program()
+    blk = main.global_block()
+    dets = np.array([[[1, 0.9, 0, 0, 10, 10], [-1, 0, 0, 0, 0, 0]]], "float32")
+    gts = np.array([[[1, 0, 0, 10, 10], [2, 20, 20, 30, 30]]], "float32")
+    blk.create_var(name="dets", shape=dets.shape, dtype="float32")
+    blk.create_var(name="gts", shape=gts.shape, dtype="float32")
+    blk.create_var(name="map_out", shape=None, dtype=None)
+    blk.append_op(
+        type="detection_map",
+        inputs={"DetectRes": ["dets"], "Label": ["gts"]},
+        outputs={"MAP": ["map_out"]},
+        attrs={"overlap_threshold": 0.5},
+    )
+    exe = Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        (m,) = exe.run(
+            main, feed={"dets": dets, "gts": gts}, fetch_list=["map_out"]
+        )
+    assert abs(float(m[0]) - 0.5) < 1e-6
