@@ -347,3 +347,24 @@ def _attention_lstm(ctx, ins, attrs):
         "Hidden": [jnp.moveaxis(hs, 0, 1) * mask],
         "Cell": [jnp.moveaxis(cs, 0, 1) * mask],
     }
+
+
+@register("conv2d_fusion")
+def _conv2d_fusion(ctx, ins, attrs):
+    """conv + bias + activation (+ residual) in one op (reference
+    conv_fusion_op.cu.cc over cudnnConvolutionBiasActivationForward). XLA
+    performs this fusion automatically; registered so imported inference
+    programs run."""
+    from .core_ops import _conv2d
+
+    out = _conv2d(ctx, ins, attrs)["Output"][0]
+    bias = _opt(ins, "Bias")
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    residual = _opt(ins, "ResidualData")
+    if residual is not None:
+        out = out + residual
+    act = attrs.get("activation", "relu")
+    if act and act != "identity":
+        out = _ACT[act](out)
+    return {"Output": [out]}

@@ -311,3 +311,20 @@ def _print(ctx, ins, attrs):
     fmt = "%s shape=%s mean={m} first={f}" % (msg, tuple(x.shape))
     jax.debug.print(fmt, m=jnp.mean(x.astype(jnp.float32)), f=flat)
     return {"Out": [x]}
+
+
+@register("parallel_do", infer_shape=_noop_infer)
+def _parallel_do(ctx, ins, attrs):
+    """Deprecated intra-graph data-parallel islands (reference
+    controlflow/parallel_do_op.cc: split the batch across places, run the
+    sub-block per device, gather). Under SPMD compilation the whole program
+    is already sharded over the mesh (parallel_executor.py), so the correct
+    TPU lowering is: run the sub-block once on the full batch — XLA's GSPMD
+    partitioner does the splitting the reference did manually."""
+    sub = attrs["sub_block"]
+    x_names = list(attrs.get("x_names", []))
+    out_names = list(attrs.get("out_names", []))
+    env = dict(zip(x_names, ins.get("X", [])))
+    c = LowerCtx(ctx.next_rng(), is_test=ctx.is_test, mesh=ctx.mesh)
+    lower_ops(c, sub.ops, env)
+    return {"Out": [env[n] for n in out_names]}

@@ -1,0 +1,31 @@
+"""API-stability gate (reference tools/diff_api.py against
+paddle/fluid/API.spec, wired into paddle_build.sh): the committed
+paddle_tpu/API.spec must match the current public surface; intentional API
+changes regenerate it with `python tools/print_signatures.py >
+paddle_tpu/API.spec`."""
+
+import os
+import sys
+
+HERE = os.path.dirname(__file__)
+SPEC = os.path.join(HERE, "..", "paddle_tpu", "API.spec")
+
+
+def test_api_spec_up_to_date():
+    sys.path.insert(0, os.path.join(HERE, "..", "tools"))
+    try:
+        import print_signatures
+
+        current = print_signatures.collect()
+    finally:
+        sys.path.pop(0)
+    with open(SPEC) as f:
+        committed = f.read().splitlines()
+    cur_set, com_set = set(current), set(committed)
+    added = sorted(cur_set - com_set)
+    removed = sorted(com_set - cur_set)
+    assert not added and not removed, (
+        "public API changed; review and regenerate API.spec\n"
+        "added:\n  %s\nremoved:\n  %s"
+        % ("\n  ".join(added[:40]), "\n  ".join(removed[:40]))
+    )

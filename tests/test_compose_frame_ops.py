@@ -400,3 +400,60 @@ class TestDeleteVar(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestConv2dFusion(OpTest):
+    def setUp(self):
+        self.op_type = "conv2d_fusion"
+        x = np.random.rand(2, 3, 5, 5).astype("float32")
+        w = np.random.rand(4, 3, 3, 3).astype("float32")
+        b = np.random.rand(4).astype("float32")
+        self.inputs = {"Input": x, "Filter": w, "Bias": b}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1], "activation": "relu"}
+        import itertools
+
+        out = np.zeros((2, 4, 5, 5), "float32")
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        for i, j in itertools.product(range(5), range(5)):
+            patch = xp[:, :, i : i + 3, j : j + 3]
+            out[:, :, i, j] = np.tensordot(patch, w, axes=([1, 2, 3], [1, 2, 3]))
+        out = np.maximum(out + b.reshape(1, 4, 1, 1), 0)
+        self.outputs = {"Output": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestParallelDo(unittest.TestCase):
+    def test_runs_sub_block_on_full_batch(self):
+        """parallel_do lowers to one full-batch run of the sub-block (GSPMD
+        handles the splitting the reference did manually)."""
+        main = framework.Program()
+        blk = main.global_block()
+        x = np.random.rand(6, 4).astype("float32")
+        blk.create_var(name="pd_x", shape=x.shape, dtype="float32")
+        blk.create_var(name="pd_out", shape=None, dtype=None)
+        sub = main._create_block()
+        sub_in = sub.create_var(name="pd_x_inner", shape=[6, 4], dtype="float32")
+        sub_out = sub.create_var(name="pd_out_inner", shape=None, dtype=None)
+        sub.append_op(
+            type="scale",
+            inputs={"X": ["pd_x_inner"]},
+            outputs={"Out": ["pd_out_inner"]},
+            attrs={"scale": 3.0},
+        )
+        main._rollback()
+        blk.append_op(
+            type="parallel_do",
+            inputs={"X": ["pd_x"]},
+            outputs={"Out": ["pd_out"]},
+            attrs={
+                "sub_block": sub,
+                "x_names": ["pd_x_inner"],
+                "out_names": ["pd_out_inner"],
+            },
+        )
+        exe = Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            (out,) = exe.run(main, feed={"pd_x": x}, fetch_list=["pd_out"])
+        np.testing.assert_allclose(out, x * 3.0, rtol=1e-6)

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Print the public API surface as `module.name (signature)` lines.
+
+Reference analog: tools/print_signatures.py + tools/diff_api.py — the
+API-stability gate: CI regenerates the spec and diffs it against the
+committed paddle_tpu/API.spec; an unreviewed surface change fails the build
+(tests/test_api_spec.py is the gate here).
+
+Usage: python tools/print_signatures.py > paddle_tpu/API.spec
+"""
+
+import inspect
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+MODULES = [
+    "paddle_tpu.fluid",
+    "paddle_tpu.layers",
+    "paddle_tpu.layers.control_flow",
+    "paddle_tpu.layers.detection",
+    "paddle_tpu.layers.io",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.initializer",
+    "paddle_tpu.io",
+    "paddle_tpu.metrics",
+    "paddle_tpu.nets",
+    "paddle_tpu.clip",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.profiler",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.reader",
+    "paddle_tpu.reader.creator",
+    "paddle_tpu.imperative",
+    "paddle_tpu.average",
+    "paddle_tpu.backward",
+    "paddle_tpu.data_feed_desc",
+    "paddle_tpu.async_executor",
+    "paddle_tpu.lod_tensor",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def collect():
+    import importlib
+
+    lines = []
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(set(names)):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                lines.append("%s.%s.__init__ %s" % (modname, name, _sig(obj.__init__)))
+                for mname, m in sorted(inspect.getmembers(obj, inspect.isfunction)):
+                    if not mname.startswith("_"):
+                        lines.append("%s.%s.%s %s" % (modname, name, mname, _sig(m)))
+            elif callable(obj):
+                lines.append("%s.%s %s" % (modname, name, _sig(obj)))
+    return lines
+
+
+if __name__ == "__main__":
+    # direct script invocation runs from tools/: make the repo importable
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    print("\n".join(collect()))
