@@ -33,6 +33,8 @@ from . import debugger  # noqa: F401
 from . import average  # noqa: F401
 from . import evaluator  # noqa: F401
 from . import lod_tensor  # noqa: F401
+from . import contrib  # noqa: F401
+from . import inference  # noqa: F401
 from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
 from . import native  # noqa: F401
 from .batch import batch

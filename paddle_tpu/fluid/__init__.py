@@ -6,7 +6,9 @@ from .. import (  # noqa: F401
     backward,
     clip,
     average,
+    contrib,
     debugger,
+    inference,
     evaluator,
     framework,
     imperative,

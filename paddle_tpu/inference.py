@@ -1,0 +1,148 @@
+"""Inference deployment: Predictor API + ahead-of-time compiled export.
+
+Reference analog: paddle/fluid/inference/ (§2.9 of SURVEY.md) —
+`PaddlePredictor`/`AnalysisPredictor` (api/analysis_predictor.cc) load a
+saved inference program, run the analysis/fusion pass pipeline, and serve
+Run() through the NaiveExecutor, optionally capturing subgraphs into
+TensorRT engines.
+
+TPU-first redesign: the analysis pipeline's job (fuse, place, capture
+subgraphs for a faster runtime) IS XLA compilation here, so:
+- `Predictor` = load_inference_model + a compile-once, shape-keyed serve
+  loop (the AnalysisPredictor role; InferenceTranspiler covers the
+  program-level rewrites the reference ran before compilation).
+- `export_compiled`/`load_compiled` = jax.export round-trip of the fully
+  compiled StableHLO artifact — the "inference library" deliverable the
+  reference built with fluid_lib_dist/TensorRT engines: the serving side
+  needs no Python model code, just the artifact.
+"""
+
+import os
+
+import numpy as np
+
+from . import framework, io
+from .executor import Executor, Scope, scope_guard
+
+__all__ = ["Predictor", "export_compiled", "load_compiled"]
+
+
+class Predictor:
+    """Load-and-serve (reference CreatePaddlePredictor → Run). Feeds are a
+    dict name->array; returns numpy arrays for the model's fetch targets."""
+
+    def __init__(self, model_dir, place=None, params_filename=None):
+        import jax
+
+        self.scope = Scope()
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            program, feed_names, fetch_vars = io.load_inference_model(
+                model_dir, self.exe, params_filename=params_filename
+            )
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = [v.name for v in fetch_vars]
+
+    def run(self, feed):
+        if isinstance(feed, (list, tuple)):
+            feed = dict(zip(self.feed_names, feed))
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise ValueError("missing feeds: %s" % missing)
+        with scope_guard(self.scope):
+            outs = self.exe.run(
+                self.program, feed=feed, fetch_list=self.fetch_names
+            )
+        return [np.asarray(o) for o in outs]
+
+    # reference PaddlePredictor names
+    def get_input_names(self):
+        return list(self.feed_names)
+
+    def get_output_names(self):
+        return list(self.fetch_names)
+
+
+def export_compiled(model_dir, example_feed, out_path, place=None):
+    """AOT-compile the inference program for the example feed shapes and
+    serialize the compiled artifact (StableHLO via jax.export) together with
+    the parameters — deployable without the model-building code."""
+    import jax
+    from jax import export as jax_export
+    import jax.numpy as jnp
+
+    pred = Predictor(model_dir, place)
+    with scope_guard(pred.scope):
+        from .executor import _CompiledBlock
+
+        feed = {
+            k: np.asarray(v) for k, v in zip(pred.feed_names, example_feed)
+        } if isinstance(example_feed, (list, tuple)) else {
+            k: np.asarray(v) for k, v in example_feed.items()
+        }
+        block = pred.program.global_block()
+        compiled = _CompiledBlock(
+            pred.program, block, list(feed.keys()), pred.fetch_names, pred.scope
+        )
+        ro = {n: pred.scope.vars[n] for n in compiled.ro_names}
+        mut = {n: pred.scope.vars[n] for n in compiled.mut_names}
+        rng_key = pred.scope.rng_key
+
+        def serve(feeds, ro_, mut_):
+            # compiled.fn is the un-jitted lowering: (feeds, ro, mut, key) ->
+            # (fetches, new_mut, created, key); inference serves fetches only
+            fetches, _, _, _ = compiled.fn(feeds, ro_, mut_, rng_key)
+            return fetches
+
+        exported = jax_export.export(jax.jit(serve))(
+            {k: jnp.asarray(v) for k, v in feed.items()}, ro, mut
+        )
+        blob = exported.serialize()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    np.savez(
+        out_path,
+        __stablehlo__=np.frombuffer(blob, np.uint8),
+        __feed_names__=np.array(list(feed.keys())),
+        __fetch_names__=np.array(pred.fetch_names),
+        **{"ro:" + k: np.asarray(v) for k, v in ro.items()},
+        **{"mut:" + k: np.asarray(v) for k, v in mut.items()},
+    )
+    return out_path
+
+
+class _CompiledPredictor:
+    def __init__(self, exported, feed_names, fetch_names, ro, mut):
+        self._exported = exported
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+        self._ro = ro
+        self._mut = mut
+
+    def run(self, feed):
+        import jax.numpy as jnp
+
+        if isinstance(feed, (list, tuple)):
+            feed = dict(zip(self.feed_names, feed))
+        feeds = {k: jnp.asarray(feed[k]) for k in self.feed_names}
+        outs = self._exported.call(feeds, self._ro, self._mut)
+        return [np.asarray(o) for o in outs]
+
+
+def load_compiled(path):
+    """Deserialize an export_compiled artifact; serving needs only this file
+    (the reference's fluid_lib_dist/TRT-engine deployment analog)."""
+    from jax import export as jax_export
+    import jax.numpy as jnp
+
+    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    exported = jax_export.deserialize(data["__stablehlo__"].tobytes())
+    feed_names = [str(s) for s in data["__feed_names__"]]
+    fetch_names = [str(s) for s in data["__fetch_names__"]]
+    ro = {
+        k[3:]: jnp.asarray(data[k]) for k in data.files if k.startswith("ro:")
+    }
+    mut = {
+        k[4:]: jnp.asarray(data[k]) for k in data.files if k.startswith("mut:")
+    }
+    return _CompiledPredictor(exported, feed_names, fetch_names, ro, mut)

@@ -8,6 +8,7 @@ from .distribute_transpiler import (  # noqa: F401
     DistributeTranspiler,
     DistributeTranspilerConfig,
 )
+from .gradient_merge import gradient_merge_transpile  # noqa: F401
 from .inference_transpiler import InferenceTranspiler  # noqa: F401
 from .memory_optimization_transpiler import (  # noqa: F401
     memory_optimize,

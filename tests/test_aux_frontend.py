@@ -190,3 +190,15 @@ def test_master_discards_after_failure_max():
         assert c.stats()["discarded"] == 1
         c.close()
         m.close()
+
+
+def test_contrib_memory_usage():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="mu_x", shape=[10], dtype="float32")
+        fluid.layers.fc(input=x, size=5)
+    est = fluid.contrib.memory_usage(main, batch_size=32)
+    # at least feed (32*10*4) + weight (10*5*4) + out (32*5*4)
+    assert est >= 32 * 10 * 4 + 10 * 5 * 4 + 32 * 5 * 4
+    with pytest.raises(ValueError):
+        fluid.contrib.memory_usage(main, batch_size=0)

@@ -398,8 +398,6 @@ class TestDeleteVar(unittest.TestCase):
             self.assertIsNone(scope.find_var("v"))
 
 
-if __name__ == "__main__":
-    unittest.main()
 
 
 class TestConv2dFusion(OpTest):
@@ -457,3 +455,7 @@ class TestParallelDo(unittest.TestCase):
         with scope_guard(Scope()):
             (out,) = exe.run(main, feed={"pd_x": x}, fetch_list=["pd_out"])
         np.testing.assert_allclose(out, x * 3.0, rtol=1e-6)
+
+
+if __name__ == "__main__":
+    unittest.main()

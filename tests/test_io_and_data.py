@@ -226,3 +226,40 @@ def test_dataset_shims():
     assert x.shape == (13,) and y.shape == (1,)
     seq, lbl = next(paddle.dataset.imdb.train()())
     assert isinstance(seq, list) and lbl in (0, 1)
+
+
+def test_predictor_and_compiled_export(tmp_path):
+    """Inference deployment tier (reference AnalysisPredictor +
+    fluid_lib_dist): save_inference_model -> Predictor.run, then AOT
+    export_compiled -> load_compiled serves identically from the artifact
+    alone."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework, inference
+    from paddle_tpu.executor import Scope, scope_guard
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="inf_x", shape=[6], dtype="float32")
+            h = fluid.layers.fc(input=x, size=8, act="relu")
+            y = fluid.layers.fc(input=h, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    model_dir = str(tmp_path / "model")
+    with scope_guard(Scope(seed=3)):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["inf_x"], [y], exe, main_program=main)
+
+    pred = inference.Predictor(model_dir)
+    assert pred.get_input_names() == ["inf_x"]
+    feed = np.random.RandomState(0).rand(4, 6).astype("float32")
+    (out1,) = pred.run({"inf_x": feed})
+    assert out1.shape == (4, 3)
+    np.testing.assert_allclose(out1.sum(axis=1), 1.0, rtol=1e-5)
+
+    artifact = str(tmp_path / "compiled.npz")
+    inference.export_compiled(model_dir, {"inf_x": feed}, artifact)
+    served = inference.load_compiled(artifact)
+    (out2,) = served.run({"inf_x": feed})
+    np.testing.assert_allclose(out2, out1, rtol=1e-5, atol=1e-6)

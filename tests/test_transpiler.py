@@ -9,13 +9,14 @@ and quantize/bf16 rewrites.
 """
 
 import threading
+import unittest
 
 import numpy as np
 import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu import framework
-from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.executor import Executor, Scope, scope_guard
 from paddle_tpu.transpiler import (
     Bf16Transpiler,
     DistributeTranspiler,
@@ -422,3 +423,59 @@ class TestRPCWireFormat:
         finally:
             client.close()
             server.stop()
+
+
+class TestGradientMerge(unittest.TestCase):
+    """Batch-merge equivalence (reference ir/multi_batch_merge_pass.cc via
+    test_dist_mnist_batch_merge.py): k merged micro-batches of size b must
+    update params like one step on the concatenated batch of size k*b."""
+
+    def _build(self, merge_k=None):
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="gm_x", shape=[4], dtype="float32")
+                y = fluid.layers.data(name="gm_y", shape=[1], dtype="float32")
+                pred = fluid.layers.fc(input=x, size=1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(input=pred, label=y)
+                )
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        if merge_k:
+            from paddle_tpu.transpiler import gradient_merge_transpile
+
+            gradient_merge_transpile(main, startup, merge_k)
+        return main, startup, loss
+
+    def test_equivalence_with_big_batch(self):
+        rng = np.random.RandomState(11)
+        xs = rng.rand(8, 4).astype("float32")
+        ys = rng.rand(8, 1).astype("float32")
+
+        # merged: 2 micro-batches of 4
+        main_m, startup_m, loss_m = self._build(merge_k=2)
+        exe = Executor(fluid.CPUPlace())
+        scope_m = Scope(seed=1)
+        with scope_guard(scope_m):
+            exe.run(startup_m)
+            w0 = np.asarray(scope_m.find_var("fc_0.w_0")).copy()
+            exe.run(main_m, feed={"gm_x": xs[:4], "gm_y": ys[:4]}, fetch_list=[])
+            w_mid = np.asarray(scope_m.find_var("fc_0.w_0"))
+            # first micro-batch must NOT apply the update yet
+            np.testing.assert_allclose(w_mid, w0)
+            exe.run(main_m, feed={"gm_x": xs[4:], "gm_y": ys[4:]}, fetch_list=[])
+            w_merged = np.asarray(scope_m.find_var("fc_0.w_0"))
+        self.assertFalse(np.allclose(w_merged, w0))
+
+        # unmerged big batch, same init: one step on all 8 rows
+        main_b, startup_b, loss_b = self._build()
+        scope_b = Scope(seed=1)
+        with scope_guard(scope_b):
+            exe.run(startup_b)
+            np.testing.assert_allclose(
+                np.asarray(scope_b.find_var("fc_0.w_0")), w0
+            )
+            exe.run(main_b, feed={"gm_x": xs, "gm_y": ys}, fetch_list=[])
+            w_big = np.asarray(scope_b.find_var("fc_0.w_0"))
+
+        np.testing.assert_allclose(w_merged, w_big, rtol=1e-4, atol=1e-6)

@@ -39,6 +39,9 @@ MODULES = [
     "paddle_tpu.data_feed_desc",
     "paddle_tpu.async_executor",
     "paddle_tpu.lod_tensor",
+    "paddle_tpu.inference",
+    "paddle_tpu.contrib",
+    "paddle_tpu.contrib.memory_usage_calc",
 ]
 
 
