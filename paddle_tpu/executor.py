@@ -318,6 +318,18 @@ class _SegmentedBlock:
             else:
                 later_consumed.update(payload.input_arg_names)
         self._compiled = [None] * len(self.segments)
+        # persistables any op writes — lets FLAGS_check_nan_inf scan updated
+        # state on segmented (host-op) programs too, like _CompiledBlock
+        self.mut_names = sorted(
+            {
+                n
+                for op in block.ops
+                for n in op.output_arg_names
+                if n != registry.EMPTY_VAR_NAME
+                and block.has_var_recursive(n)
+                and block._var_recursive(n).persistable
+            }
+        )
 
     def __call__(self, scope, feed_arrays):
         for name, value in feed_arrays.items():
@@ -446,10 +458,29 @@ class Executor:
             if use_program_cache:
                 self._cache[key] = compiled
 
+        from . import flags as _flags
+
         with _prof.RecordEvent("run/block0"):
             fetches = compiled(scope, feed_arrays)
-            if _prof.is_profiling():
+            if _prof.is_profiling() or _flags.get_flags("benchmark")["benchmark"]:
+                # reference FLAGS_benchmark: wait so host timing is real step
+                # time (operator.cc:769 dev_ctx->Wait)
                 fetches = [jax.block_until_ready(f) for f in fetches]
+        if _flags.get_flags("check_nan_inf")["check_nan_inf"]:
+            # reference FLAGS_check_nan_inf (operator.cc:778): scan results +
+            # updated persistable state; raise naming the bad var
+            def _scan(name, val):
+                arr = np.asarray(val)
+                if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                    raise FloatingPointError(
+                        "check_nan_inf: variable %r contains NaN/Inf" % name
+                    )
+
+            for name, f in zip(fetch_names, fetches):
+                _scan(name, f)
+            for name in getattr(compiled, "mut_names", ()):
+                if name in scope.vars:
+                    _scan(name, scope.vars[name])
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

@@ -1,0 +1,65 @@
+"""Runtime flags (reference gflags tier, SURVEY.md §5.6: ~45 DEFINE_* knobs
+surfaced to Python via core.init_gflags and FLAGS_* env vars,
+fluid/__init__.py:125-150).
+
+TPU-first mapping: most reference flags governed machinery XLA now owns
+(memory fractions → XLA allocator; cudnn knobs → compiler choices), so the
+surviving knobs are debug/determinism switches. Flags initialize from
+FLAGS_* environment variables exactly like the reference, and can be set
+programmatically with set_flags (the modern fluid API shape).
+
+Honored flags:
+- check_nan_inf: executor scans every fetch/updated state for NaN/Inf after
+  each run and raises (reference operator.cc:778 FLAGS_check_nan_inf).
+- benchmark: executor blocks until device work completes each run, so host
+  timing brackets real step time (reference operator.cc:769 FLAGS_benchmark).
+- eager_delete_tensor_gb / fraction_of_gpu_memory_to_use /
+  paddle_num_threads: accepted for API compatibility; storage lifetime and
+  threading are XLA/PJRT-owned here (documented no-ops).
+"""
+
+import os
+
+__all__ = ["get_flags", "set_flags"]
+
+_DEFAULTS = {
+    "check_nan_inf": False,
+    "benchmark": False,
+    "eager_delete_tensor_gb": -1.0,
+    "fraction_of_gpu_memory_to_use": 0.92,
+    "paddle_num_threads": 1,
+    "cpu_deterministic": False,
+}
+
+_flags = {}
+
+
+def _coerce(template, raw):
+    if isinstance(template, bool):
+        return str(raw).lower() in ("1", "true", "yes", "on")
+    return type(template)(raw)
+
+
+def _init():
+    for name, default in _DEFAULTS.items():
+        env = os.environ.get("FLAGS_" + name)
+        _flags[name] = _coerce(default, env) if env is not None else default
+
+
+_init()
+
+
+def get_flags(names=None):
+    if names is None:
+        return dict(_flags)
+    if isinstance(names, str):
+        return {names: _flags[names]}
+    return {n: _flags[n] for n in names}
+
+
+def set_flags(flags):
+    for name, value in flags.items():
+        name = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+        if name not in _flags:
+            raise KeyError("unknown flag %r (known: %s)" % (name, sorted(_flags)))
+        _flags[name] = _coerce(_DEFAULTS[name], value)

@@ -33,6 +33,7 @@ from ..transpiler import (  # noqa: F401
 )
 from ..data_feeder import DataFeeder  # noqa: F401
 from ..lod_tensor import create_lod_tensor, create_random_int_lodtensor  # noqa: F401
+from ..flags import get_flags, set_flags  # noqa: F401
 from ..py_reader import EOFException  # noqa: F401
 from ..executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from ..async_executor import AsyncExecutor  # noqa: F401

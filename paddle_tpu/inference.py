@@ -32,8 +32,6 @@ class Predictor:
     dict name->array; returns numpy arrays for the model's fetch targets."""
 
     def __init__(self, model_dir, place=None, params_filename=None):
-        import jax
-
         self.scope = Scope()
         self.exe = Executor(place)
         with scope_guard(self.scope):
@@ -64,7 +62,7 @@ class Predictor:
         return list(self.fetch_names)
 
 
-def export_compiled(model_dir, example_feed, out_path, place=None):
+def export_compiled(model_dir, example_feed, out_path, place=None, params_filename=None):
     """AOT-compile the inference program for the example feed shapes and
     serialize the compiled artifact (StableHLO via jax.export) together with
     the parameters — deployable without the model-building code."""
@@ -72,7 +70,7 @@ def export_compiled(model_dir, example_feed, out_path, place=None):
     from jax import export as jax_export
     import jax.numpy as jnp
 
-    pred = Predictor(model_dir, place)
+    pred = Predictor(model_dir, place, params_filename=params_filename)
     with scope_guard(pred.scope):
         from .executor import _CompiledBlock
 

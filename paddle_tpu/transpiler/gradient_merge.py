@@ -150,13 +150,15 @@ def gradient_merge_transpile(main_program, startup_program, k_steps, avg=True):
                     aname = accum_of[gname]
                     s_name = aname + ".scaled"
                     if not sub.has_var(s_name):
+                        # one scale per accumulator even when several
+                        # optimizer ops consume the same gradient
                         sub.create_var(name=s_name, shape=None, dtype=None)
-                    sub.append_op(
-                        type="scale",
-                        inputs={"X": [aname]},
-                        outputs={"Out": [s_name]},
-                        attrs={"scale": scale},
-                    )
+                        sub.append_op(
+                            type="scale",
+                            inputs={"X": [aname]},
+                            outputs={"Out": [s_name]},
+                            attrs={"scale": scale},
+                        )
                     scaled.append(s_name)
                 new_inputs[slot] = scaled
             else:
@@ -186,7 +188,6 @@ def gradient_merge_transpile(main_program, startup_program, k_steps, avg=True):
         written.append(aname)
     main_program._rollback()
 
-    # closure of names the sub-block reads from the outer scope
     written = sorted(set(written))
     # closure of names the sub-block reads from the outer scope; written
     # names must ride in X too — conditional_block takes their prior values

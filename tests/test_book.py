@@ -207,3 +207,81 @@ def test_label_semantic_roles_crf():
         np.mean(dv[b, :lens[b]] == tg[b, :lens[b], 0]) for b in range(B)
     ])
     assert acc > 0.9, acc
+
+
+def test_recommender_system_movielens():
+    """reference tests/book/test_recommender_system.py: twin-tower user/movie
+    embedding model over movielens, cosine-similarity scaled to the rating
+    range, square loss decreasing."""
+    from paddle_tpu import dataset
+
+    main, startup = _fresh()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        uid = fluid.layers.data(name="user_id", shape=[1], dtype="int64")
+        gender = fluid.layers.data(name="gender_id", shape=[1], dtype="int64")
+        age = fluid.layers.data(name="age_id", shape=[1], dtype="int64")
+        job = fluid.layers.data(name="job_id", shape=[1], dtype="int64")
+        mid = fluid.layers.data(name="movie_id", shape=[1], dtype="int64")
+        cat = fluid.layers.data(name="category_id", shape=[-1], dtype="int64")
+        rating = fluid.layers.data(name="score", shape=[1], dtype="float32")
+
+        def tower(parts, size=32):
+            feats = [fluid.layers.fc(p, size=size) for p in parts]
+            concat = fluid.layers.concat(feats, axis=1)
+            return fluid.layers.fc(concat, size=size, act="tanh")
+
+        usr_emb = fluid.layers.embedding(uid, size=[dataset.movielens.max_user_id() + 1, 16])
+        gender_emb = fluid.layers.embedding(gender, size=[2, 8])
+        age_emb = fluid.layers.embedding(age, size=[len(dataset.movielens.age_table), 8])
+        job_emb = fluid.layers.embedding(job, size=[dataset.movielens.max_job_id() + 1, 8])
+        usr = tower([
+            fluid.layers.reshape(usr_emb, [0, 16]),
+            fluid.layers.reshape(gender_emb, [0, 8]),
+            fluid.layers.reshape(age_emb, [0, 8]),
+            fluid.layers.reshape(job_emb, [0, 8]),
+        ])
+
+        mov_emb = fluid.layers.embedding(mid, size=[dataset.movielens.max_movie_id() + 1, 16])
+        # category bag: padded ids (-1) -> zero rows -> sum pool
+        cat_emb = fluid.layers.embedding(cat, size=[18, 8])
+        cat_pool = fluid.layers.reduce_sum(cat_emb, dim=1)
+        mov = tower([fluid.layers.reshape(mov_emb, [0, 16]), cat_pool])
+
+        sim = fluid.layers.cos_sim(X=usr, Y=mov)
+        pred = fluid.layers.scale(sim, scale=5.0)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, rating))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    rows = list(dataset.movielens.train()())[:512]
+
+    def batches(bs=64):
+        for i in range(0, len(rows), bs):
+            chunk = rows[i : i + bs]
+            maxc = max(len(r[5]) for r in chunk)
+            cats = np.full((len(chunk), maxc), -1, "int64")
+            for j, r in enumerate(chunk):
+                cats[j, : len(r[5])] = r[5]
+            yield {
+                "user_id": np.array([[r[0]] for r in chunk], "int64"),
+                "gender_id": np.array([[r[1]] for r in chunk], "int64"),
+                "age_id": np.array([[r[2]] for r in chunk], "int64"),
+                "job_id": np.array([[r[3]] for r in chunk], "int64"),
+                "movie_id": np.array([[r[4]] for r in chunk], "int64"),
+                "category_id": cats,
+                "score": np.array([[r[7]] for r in chunk], "float32"),
+            }
+
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        first = last = None
+        for epoch in range(4):
+            for feed in batches():
+                (lv,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+                lv = float(np.asarray(lv).reshape(-1)[0])
+                if first is None:
+                    first = lv
+                last = lv
+    assert np.isfinite(last)
+    assert last < first * 0.8, (first, last)

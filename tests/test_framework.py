@@ -180,3 +180,30 @@ def test_profiler_context_and_timeline(tmp_path):
         finally:
             sys.path.pop(0)
     fluid.profiler.reset_profiler()
+
+
+def test_flags_check_nan_inf():
+    """FLAGS tier (reference SURVEY.md §5.6 + operator.cc:778
+    FLAGS_check_nan_inf): a program producing NaN raises naming the var when
+    the flag is on, runs silently when off."""
+    from paddle_tpu.executor import Scope, scope_guard
+
+    main = Program()
+    blk = main.global_block()
+    blk.create_var(name="nan_x", shape=[2], dtype="float32")
+    blk.create_var(name="nan_y", shape=None, dtype=None)
+    blk.append_op(
+        type="log", inputs={"X": ["nan_x"]}, outputs={"Out": ["nan_y"]}, attrs={}
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    bad = np.array([-1.0, 1.0], "float32")  # log(-1) = nan
+    with scope_guard(Scope()):
+        exe.run(main, feed={"nan_x": bad}, fetch_list=["nan_y"])  # off: fine
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with scope_guard(Scope()):
+            with pytest.raises(FloatingPointError, match="nan_y"):
+                exe.run(main, feed={"nan_x": bad}, fetch_list=["nan_y"])
+    finally:
+        fluid.set_flags({"check_nan_inf": False})
+    assert fluid.get_flags("check_nan_inf") == {"check_nan_inf": False}
