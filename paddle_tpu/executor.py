@@ -470,8 +470,13 @@ class Executor:
             # reference FLAGS_check_nan_inf (operator.cc:778): scan results +
             # updated persistable state; raise naming the bad var
             def _scan(name, val):
-                arr = np.asarray(val)
-                if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                # finiteness reduces ON DEVICE; only the boolean scalar
+                # crosses to host (full-state device->host copies per step
+                # would dominate step time on a real model)
+                arr = jnp.asarray(val)
+                if jnp.issubdtype(arr.dtype, jnp.floating) and not bool(
+                    jnp.isfinite(arr).all()
+                ):
                     raise FloatingPointError(
                         "check_nan_inf: variable %r contains NaN/Inf" % name
                     )

@@ -41,9 +41,22 @@ def _coerce(template, raw):
 
 
 def _init():
+    import warnings
+
     for name, default in _DEFAULTS.items():
         env = os.environ.get("FLAGS_" + name)
-        _flags[name] = _coerce(default, env) if env is not None else default
+        if env is None:
+            _flags[name] = default
+            continue
+        try:
+            _flags[name] = _coerce(default, env)
+        except (TypeError, ValueError):
+            # a malformed env var must not break `import paddle_tpu`
+            warnings.warn(
+                "ignoring malformed FLAGS_%s=%r (expected %s)"
+                % (name, env, type(default).__name__)
+            )
+            _flags[name] = default
 
 
 _init()

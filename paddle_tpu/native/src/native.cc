@@ -237,36 +237,44 @@ struct MultiSlotFeed {
     }
   }
 
+  // gzip-transparent line iteration (reference CTRReader reads .gz shards;
+  // gzFile handles plain files too, so every input goes through zlib)
   void Run() {
     std::string packed;
+    std::string line;
+    std::vector<char> buf(1 << 16);
     bool queue_closed = false;
     for (;;) {
       if (queue_closed) break;  // consumer gone: skip remaining files
       size_t i = next_file.fetch_add(1);
       if (i >= files.size()) break;
-      FILE* f = fopen(files[i].c_str(), "r");
+      gzFile f = gzopen(files[i].c_str(), "rb");
       if (!f) {
         file_errors.fetch_add(1);
         continue;
       }
-      char* line = nullptr;
-      size_t cap = 0;
-      ssize_t len;
-      while ((len = getline(&line, &cap, f)) != -1) {
-        if (len == 0 || line[0] == '\n') continue;
+      while (!queue_closed && gzgets(f, buf.data(), buf.size()) != nullptr) {
+        line.assign(buf.data());
+        // reassemble lines longer than one buffer
+        while (!line.empty() && line.back() != '\n' &&
+               gzgets(f, buf.data(), buf.size()) != nullptr) {
+          line.append(buf.data());
+        }
+        if (line.empty() || line[0] == '\n') continue;
         try {
-          ParseLine(line, &packed);
+          ParseLine(line.c_str(), &packed);
         } catch (...) {
           parse_errors.fetch_add(1);
           continue;
         }
-        if (!queue->Push(packed)) {  // queue closed: stop early
-          queue_closed = true;
-          break;
-        }
+        if (!queue->Push(packed)) queue_closed = true;
       }
-      free(line);
-      fclose(f);
+      // gzgets returning NULL mid-file on a corrupt/truncated stream must
+      // not masquerade as clean EOF
+      int errnum = Z_OK;
+      gzerror(f, &errnum);
+      if (errnum != Z_OK && errnum != Z_STREAM_END) file_errors.fetch_add(1);
+      gzclose(f);
     }
   }
 };

@@ -1,0 +1,87 @@
+"""Subprocess role runner for the distributed tests (reference
+test_dist_base.py's model-file pattern: the same script is Popen'd as pserver
+or trainer with role flags; trainer pickles losses to stdout)."""
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def build():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", choices=["pserver", "trainer"], required=True)
+    ap.add_argument("--endpoints", required=True)  # comma-separated pservers
+    ap.add_argument("--current_endpoint", default="")
+    ap.add_argument("--trainer_id", type=int, default=0)
+    ap.add_argument("--trainers", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--sync_mode", type=int, default=1)
+    args = ap.parse_args()
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.transpiler import (
+        DistributeTranspiler,
+        DistributeTranspilerConfig,
+    )
+
+    main_prog, startup, loss = build()
+    config = DistributeTranspilerConfig()
+    config.min_block_size = 1
+    t = DistributeTranspiler(config)
+    t.transpile(
+        trainer_id=args.trainer_id,
+        program=main_prog,
+        pservers=args.endpoints,
+        trainers=args.trainers,
+        sync_mode=bool(args.sync_mode),
+        startup_program=startup,
+    )
+
+    if args.role == "pserver":
+        prog = t.get_pserver_program(args.current_endpoint)
+        sstartup = t.get_startup_program(args.current_endpoint, prog)
+        with scope_guard(Scope(seed=3)):
+            exe = fluid.Executor()
+            exe.run(sstartup)
+            print("PSERVER_READY", flush=True)
+            exe.run(prog)  # blocks until all trainers send COMPLETE
+        return
+
+    trainer_prog = t.get_trainer_program()
+    rng = np.random.RandomState(100 + args.trainer_id)
+    w_true = np.random.RandomState(0).randn(8, 1).astype(np.float32)
+    losses = []
+    with scope_guard(Scope(seed=5)):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for _ in range(args.steps):
+            xb = rng.randn(16, 8).astype(np.float32)
+            yb = (np.abs(xb) @ np.abs(w_true)) + 0.01 * rng.randn(16, 1).astype(
+                np.float32
+            )
+            (lv,) = exe.run(trainer_prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        exe.close()  # SendComplete → pserver exits when all trainers did
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

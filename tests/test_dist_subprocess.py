@@ -1,0 +1,86 @@
+"""True multi-process distributed training on localhost (reference
+unittests/test_dist_base.py:245-422 — Popen pservers with role flags, then
+trainers, losses pickled over stdout and checked for convergence). The
+threaded variant lives in test_transpiler.py; this one exercises real
+process isolation: separate interpreters, sockets across processes, COMPLETE
+teardown."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(__file__)
+RUNNER = os.path.join(HERE, "dist_runner.py")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.join(HERE, ".."), env.get("PYTHONPATH", "")]
+    )
+    return env
+
+
+def test_two_pservers_two_trainers_subprocess():
+    eps = ["127.0.0.1:%d" % p for p in _free_ports(2)]
+    endpoints = ",".join(eps)
+    env = _env()
+
+    def spawn(role, **kw):
+        cmd = [sys.executable, RUNNER, "--role", role, "--endpoints", endpoints,
+               "--trainers", "2"]
+        for k, v in kw.items():
+            cmd += ["--%s" % k, str(v)]
+        return subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
+        )
+
+    pservers = [spawn("pserver", current_endpoint=ep) for ep in eps]
+    try:
+        # wait until both bind (reference start_pserver waits with timeout)
+        for p in pservers:
+            line = ""
+            while "PSERVER_READY" not in line:
+                line = p.stdout.readline()
+                assert line, "pserver exited early: %s" % p.stderr.read()
+
+        trainers = [spawn("trainer", trainer_id=i) for i in range(2)]
+        all_losses = []
+        for tr in trainers:
+            out, err = tr.communicate(timeout=240)
+            assert tr.returncode == 0, "trainer failed:\n%s" % err
+            loss_lines = [l for l in out.splitlines() if l.startswith("LOSSES ")]
+            assert loss_lines, "no losses in trainer output:\n%s\n%s" % (out, err)
+            all_losses.append(json.loads(loss_lines[0][len("LOSSES "):]))
+
+        for losses in all_losses:
+            assert np.isfinite(losses).all()
+            assert np.mean(losses[-3:]) < np.mean(losses[:3]) * 0.8, losses
+
+        # pservers exit cleanly after both trainers COMPLETE
+        for p in pservers:
+            p.wait(timeout=60)
+            assert p.returncode == 0
+    finally:
+        for p in pservers:
+            if p.poll() is None:
+                p.kill()

@@ -162,5 +162,31 @@ class TestMultiSlotDataFeed(unittest.TestCase):
             self.assertEqual(feed.join(), 1)
 
 
+
+
+class TestGzipFeed(unittest.TestCase):
+    def test_parses_gzip_shards(self):
+        """gzip-transparent input (reference operators/reader/ctr_reader.cc
+        reads .gz text shards): same slot format, compressed files."""
+        import gzip
+
+        with tempfile.TemporaryDirectory() as td:
+            paths = []
+            for fi in range(2):
+                p = os.path.join(td, "part-%d.txt.gz" % fi)
+                with gzip.open(p, "wt") as f:
+                    for li in range(15):
+                        f.write("1 %d 2 0.25 0.75\n" % (fi * 15 + li))
+                paths.append(p)
+            feed = native.MultiSlotDataFeed(
+                [native.INT64_SLOT, native.FLOAT32_SLOT]
+            )
+            feed.start(paths, nthreads=2)
+            samples = list(feed)
+            self.assertEqual(feed.join(), 0)
+            self.assertEqual(len(samples), 30)
+            self.assertEqual(sorted(int(s[0][0]) for s in samples), list(range(30)))
+
+
 if __name__ == "__main__":
     unittest.main()
