@@ -467,25 +467,30 @@ class Executor:
                 # time (operator.cc:769 dev_ctx->Wait)
                 fetches = [jax.block_until_ready(f) for f in fetches]
         if _flags.get_flags("check_nan_inf")["check_nan_inf"]:
-            # reference FLAGS_check_nan_inf (operator.cc:778): scan results +
-            # updated persistable state; raise naming the bad var
-            def _scan(name, val):
-                # finiteness reduces ON DEVICE; only the boolean scalar
-                # crosses to host (full-state device->host copies per step
-                # would dominate step time on a real model)
-                arr = jnp.asarray(val)
-                if jnp.issubdtype(arr.dtype, jnp.floating) and not bool(
-                    jnp.isfinite(arr).all()
-                ):
-                    raise FloatingPointError(
-                        "check_nan_inf: variable %r contains NaN/Inf" % name
-                    )
-
-            for name, f in zip(fetch_names, fetches):
-                _scan(name, f)
-            for name in getattr(compiled, "mut_names", ()):
-                if name in scope.vars:
-                    _scan(name, scope.vars[name])
+            # reference FLAGS_check_nan_inf (operator.cc:778): finiteness
+            # reduces ON DEVICE into one stacked scalar (a single host sync
+            # per step); only when it trips does the per-var rescan run to
+            # name the culprit
+            watched = list(zip(fetch_names, fetches)) + [
+                (n, scope.vars[n])
+                for n in getattr(compiled, "mut_names", ())
+                if n in scope.vars
+            ]
+            finite_flags = [
+                jnp.isfinite(a).all()
+                for _, v in watched
+                for a in (jnp.asarray(v),)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+            ]
+            if finite_flags and not bool(jnp.stack(finite_flags).all()):
+                for name, val in watched:
+                    arr = jnp.asarray(val)
+                    if jnp.issubdtype(arr.dtype, jnp.floating) and not bool(
+                        jnp.isfinite(arr).all()
+                    ):
+                        raise FloatingPointError(
+                            "check_nan_inf: variable %r contains NaN/Inf" % name
+                        )
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

@@ -272,6 +272,10 @@ def _fusion_seqconv_eltadd_relu(ctx, ins, attrs):
         attrs,
     )["Out"][0]
     out = jax.nn.relu(out + ins["Bias"][0].reshape(1, 1, -1))
+    # re-mask: bias+relu puts relu(bias) into padded rows, and downstream
+    # sequence ops rely on padding staying zero
+    lens = ins["SeqLen"][0].reshape(-1).astype(jnp.int32)
+    out = sequence_ops._masked(out, lens)
     return {"Out": [out]}
 
 

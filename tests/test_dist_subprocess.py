@@ -7,27 +7,16 @@ teardown."""
 
 import json
 import os
-import socket
 import subprocess
 import sys
+import time
 
 import numpy as np
-import pytest
+
+from port_utils import free_ports
 
 HERE = os.path.dirname(__file__)
 RUNNER = os.path.join(HERE, "dist_runner.py")
-
-
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
 
 
 def _env():
@@ -41,7 +30,7 @@ def _env():
 
 
 def test_two_pservers_two_trainers_subprocess():
-    eps = ["127.0.0.1:%d" % p for p in _free_ports(2)]
+    eps = ["127.0.0.1:%d" % p for p in free_ports(2)]
     endpoints = ",".join(eps)
     env = _env()
 
@@ -50,26 +39,38 @@ def test_two_pservers_two_trainers_subprocess():
                "--trainers", "2"]
         for k, v in kw.items():
             cmd += ["--%s" % k, str(v)]
+        # stderr -> DEVNULL: an undrained pipe filling with jax/absl warnings
+        # would deadlock the child; stdout carries the protocol lines
         return subprocess.Popen(
-            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            env=env,
         )
 
-    pservers = [spawn("pserver", current_endpoint=ep) for ep in eps]
+    procs = []
     try:
-        # wait until both bind (reference start_pserver waits with timeout)
+        pservers = [spawn("pserver", current_endpoint=ep) for ep in eps]
+        procs += pservers
+        # wait until both bind (reference start_pserver waits with timeout);
+        # poll with a deadline so a wedged pserver fails instead of hanging
+        deadline = time.time() + 120
         for p in pservers:
             line = ""
             while "PSERVER_READY" not in line:
+                assert time.time() < deadline, "pserver not ready in time"
                 line = p.stdout.readline()
-                assert line, "pserver exited early: %s" % p.stderr.read()
+                assert line or p.poll() is None, "pserver exited early"
 
         trainers = [spawn("trainer", trainer_id=i) for i in range(2)]
+        procs += trainers
         all_losses = []
         for tr in trainers:
-            out, err = tr.communicate(timeout=240)
-            assert tr.returncode == 0, "trainer failed:\n%s" % err
+            out, _ = tr.communicate(timeout=240)
+            assert tr.returncode == 0, "trainer failed (rc=%s)" % tr.returncode
             loss_lines = [l for l in out.splitlines() if l.startswith("LOSSES ")]
-            assert loss_lines, "no losses in trainer output:\n%s\n%s" % (out, err)
+            assert loss_lines, "no losses in trainer output:\n%s" % out
             all_losses.append(json.loads(loss_lines[0][len("LOSSES "):]))
 
         for losses in all_losses:
@@ -81,6 +82,6 @@ def test_two_pservers_two_trainers_subprocess():
             p.wait(timeout=60)
             assert p.returncode == 0
     finally:
-        for p in pservers:
+        for p in procs:
             if p.poll() is None:
                 p.kill()

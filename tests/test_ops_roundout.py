@@ -1,0 +1,264 @@
+"""Round-out tests for ops added for registry parity that lacked direct
+coverage: attention_lstm, fused_embedding_fc_lstm, fusion_seqconv_eltadd_relu,
+tensor_array_to_tensor, rnn_memory_helper, go, get_places, and the prefetch
+host op against a live pserver (reference rpc_server_test.cc prefetch test)."""
+
+import threading
+import time
+import unittest
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from op_test import OpTest
+from paddle_tpu import framework
+from paddle_tpu.executor import Executor, Scope, scope_guard
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestFusionSeqconvEltaddRelu(OpTest):
+    def setUp(self):
+        self.op_type = "fusion_seqconv_eltadd_relu"
+        b, t, d, o = 2, 4, 3, 5
+        x = np.random.rand(b, t, d).astype("float32") - 0.5
+        w = np.random.rand(3 * d, o).astype("float32") - 0.5
+        bias = np.random.rand(o).astype("float32") - 0.5
+        lens = np.array([4, 3], dtype="int64")
+        self.inputs = {"X": x, "Filter": w, "Bias": bias, "SeqLen": lens}
+        self.attrs = {"contextLength": 3, "contextStart": -1}
+        xm = x.copy()
+        for bi, l in enumerate(lens):
+            xm[bi, l:] = 0
+        out = np.zeros((b, t, o), "float32")
+        for bi in range(b):
+            for ti in range(t):
+                ctx = []
+                for k in range(3):
+                    src = ti - 1 + k
+                    ctx.append(
+                        xm[bi, src] if 0 <= src < t else np.zeros(d, "float32")
+                    )
+                out[bi, ti] = np.concatenate(ctx) @ w
+            out[bi, lens[bi]:] = 0
+        out = np.maximum(out + bias, 0)
+        for bi, l in enumerate(lens):
+            out[bi, l:] = 0
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestFusedEmbeddingFcLstm(OpTest):
+    def setUp(self):
+        self.op_type = "fused_embedding_fc_lstm"
+        b, t, h, vocab = 2, 3, 3, 10
+        ids = np.random.randint(0, vocab, (b, t)).astype("int64")
+        emb = np.random.rand(vocab, 4 * h).astype("float32") - 0.5
+        wh = np.random.rand(h, 4 * h).astype("float32") - 0.5
+        lens = np.array([3, 3], dtype="int64")
+        self.inputs = {"Ids": ids, "Embeddings": emb, "WeightH": wh, "SeqLen": lens}
+        self.attrs = {"use_peepholes": False}
+        proj = emb[ids]
+        hp = np.zeros((b, h))
+        cp = np.zeros((b, h))
+        hidden = np.zeros((b, t, h), "float32")
+        for ti in range(t):
+            gates = proj[:, ti] + hp @ wh
+            gc, gi, gf, go = np.split(gates, 4, axis=1)
+            cp = sigmoid(gf) * cp + sigmoid(gi) * np.tanh(gc)
+            hp = sigmoid(go) * np.tanh(cp)
+            hidden[:, ti] = hp
+        self.outputs = {"Hidden": hidden}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4, no_check_set=["Cell"])
+
+
+class TestAttentionLstm(OpTest):
+    def setUp(self):
+        self.op_type = "attention_lstm"
+        b, t, d, h = 2, 4, 3, 2
+        x = np.random.rand(b, t, d).astype("float32") - 0.5
+        aw = np.random.rand(d + h, 1).astype("float32") - 0.5
+        lw = np.random.rand(d + h, 4 * h).astype("float32") - 0.5
+        lens = np.array([4, 2], dtype="int64")
+        self.inputs = {
+            "X": x,
+            "SeqLen": lens,
+            "AttentionWeight": aw,
+            "LSTMWeight": lw,
+        }
+        self.attrs = {}
+        hp = np.zeros((b, h))
+        cp = np.zeros((b, h))
+        hidden = np.zeros((b, t, h), "float32")
+        valid = np.arange(t)[None, :] < lens[:, None]
+        for step in range(t):
+            score = x @ aw[:d, 0] + (hp @ aw[d:, 0])[:, None]
+            score = np.where(valid, score, -np.inf)
+            alpha = np.exp(score - score.max(1, keepdims=True))
+            alpha /= alpha.sum(1, keepdims=True)
+            atted = np.einsum("bt,btd->bd", alpha, x)
+            gates = np.concatenate([atted, hp], axis=1) @ lw
+            gc, gi, gf, go = np.split(gates, 4, axis=1)
+            cp = sigmoid(gf) * cp + sigmoid(gi) * np.tanh(gc)
+            hp = sigmoid(go) * np.tanh(cp)
+            hidden[:, step] = hp
+        hidden *= valid[..., None]
+        self.outputs = {"Hidden": hidden}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4, no_check_set=["Cell"])
+
+
+class TestTensorArrayToTensor(unittest.TestCase):
+    def test_stack_and_concat(self):
+        from paddle_tpu.layers import control_flow as cf
+
+        main = framework.Program()
+        with fluid.program_guard(main, framework.Program()):
+            x = fluid.layers.data(name="tat_x", shape=[3, 4], dtype="float32")
+            arr = fluid.layers.control_flow.lod_tensor_to_array(x, None)
+            blk = main.global_block()
+            out = blk.create_var(name="tat_out", shape=None, dtype=None)
+            idx = blk.create_var(name="tat_idx", shape=None, dtype=None)
+            blk.append_op(
+                type="tensor_array_to_tensor",
+                inputs={"X": [arr.name]},
+                outputs={"Out": [out.name], "OutIndex": [idx.name]},
+                attrs={"axis": 0, "use_stack": True},
+            )
+        data = np.random.rand(2, 3, 4).astype("float32")
+        exe = Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            (got,) = exe.run(main, feed={"tat_x": data}, fetch_list=["tat_out"])
+        # array is time-major [T, B, ...]; stack on axis 0 re-produces it
+        np.testing.assert_allclose(got, np.swapaxes(data, 0, 1), rtol=1e-6)
+
+
+class TestRnnMemoryHelper(OpTest):
+    def setUp(self):
+        self.op_type = "rnn_memory_helper"
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestGoAndGetPlaces(unittest.TestCase):
+    def test_get_places(self):
+        main = framework.Program()
+        blk = main.global_block()
+        blk.create_var(name="places", shape=None, dtype=None)
+        blk.append_op(
+            type="get_places", inputs={}, outputs={"Out": ["places"]}, attrs={}
+        )
+        exe = Executor(fluid.CPUPlace())
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(main, feed={}, fetch_list=[])
+            places = np.asarray(scope.find_var("places"))
+        self.assertGreaterEqual(len(places), 1)
+
+    def test_go_runs_sub_block_async(self):
+        main = framework.Program()
+        blk = main.global_block()
+        blk.create_var(
+            name="go_in", shape=[4], dtype="float32", persistable=True
+        )
+        sub = main._create_block()
+        sub.create_var(name="go_out", shape=[4], dtype="float32", persistable=True)
+        sub.append_op(
+            type="scale",
+            inputs={"X": ["go_in"]},
+            outputs={"Out": ["go_out"]},
+            attrs={"scale": 2.0},
+        )
+        main._rollback()
+        blk.append_op(
+            type="go", inputs={}, outputs={}, attrs={"sub_block": sub}
+        )
+        exe = Executor(fluid.CPUPlace())
+        scope = Scope()
+        with scope_guard(scope):
+            scope.set_var("go_in", np.ones(4, "float32"))
+            exe.run(main, feed={}, fetch_list=[])
+            for th in scope.find_var("__go_threads__"):
+                th.join(timeout=30)
+            np.testing.assert_allclose(
+                np.asarray(scope.find_var("go_out")), 2 * np.ones(4), rtol=1e-6
+            )
+
+
+class TestPrefetchAgainstPserver(unittest.TestCase):
+    def test_remote_rows(self):
+        """End-to-end sparse-table prefetch (reference rpc_server_test.cc:
+        in-process server + client prefetch of lookup-table rows)."""
+        from paddle_tpu.ops.dist_ops import _listen_and_serv
+
+        table = np.arange(20, dtype="float32").reshape(10, 2)
+
+        ps_prog = framework.Program()
+        ps_block = ps_prog.global_block()
+        ls_op = ps_block.append_op(
+            type="listen_and_serv",
+            inputs={},
+            outputs={},
+            attrs={
+                "endpoint": "127.0.0.1:0",
+                "sync_mode": False,
+                "Fanin": 1,
+                "optimize_blocks": [],
+                "grad_to_block_id": [],
+            },
+        )
+        ps_scope = Scope()
+        ps_scope.set_var("emb_table", table)
+
+        th = threading.Thread(
+            target=_listen_and_serv, args=(ls_op, ps_scope), daemon=True
+        )
+        th.start()
+        deadline = time.time() + 30
+        while "__bound_endpoint__" not in ls_op.attrs:
+            self.assertLess(time.time(), deadline, "pserver did not bind")
+            time.sleep(0.05)
+        ep = ls_op.attrs["__bound_endpoint__"]
+
+        main = framework.Program()
+        blk = main.global_block()
+        blk.create_var(name="pf_ids", shape=[4], dtype="int64")
+        blk.create_var(name="pf_rows", shape=None, dtype=None)
+        blk.append_op(
+            type="prefetch",
+            inputs={"X": ["pf_ids"]},
+            outputs={"Out": ["pf_rows"]},
+            attrs={"epmap": [ep], "table_name": "emb_table", "trainer_id": 0},
+        )
+        ids = np.array([1, 7, 3, 1], "int64")
+        exe = Executor(fluid.CPUPlace())
+        scope = Scope()
+        try:
+            with scope_guard(scope):
+                exe.run(main, feed={"pf_ids": ids}, fetch_list=[])
+                rows = np.asarray(scope.find_var("pf_rows"))
+            np.testing.assert_allclose(rows, table[ids], rtol=1e-6)
+        finally:
+            from paddle_tpu.distributed.rpc import RPCClient
+
+            RPCClient.instance(0).send_complete(ep)
+            th.join(timeout=30)
+            self.assertFalse(th.is_alive(), "pserver did not exit")
+
+
+if __name__ == "__main__":
+    unittest.main()

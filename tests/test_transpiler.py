@@ -152,18 +152,9 @@ class TestDistTrainRoundTrip:
 
     @staticmethod
     def _free_ports(n):
-        """Pre-pick distinct free ports (reference test_dist_base.py:224-243)."""
-        import socket
+        from port_utils import free_ports
 
-        socks, ports = [], []
-        for _ in range(n):
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            socks.append(s)
-            ports.append(s.getsockname()[1])
-        for s in socks:
-            s.close()
-        return ports
+        return free_ports(n)
 
     def test_linear_regression_converges(self):
         main, startup, loss = _build_fc_net(hidden=16, slice_friendly_rows=8)
