@@ -2,7 +2,8 @@
 BASELINE.json (MNIST LeNet, ResNet-50, VGG, Transformer NMT, DeepFM CTR,
 stacked-LSTM LM), mirroring reference benchmark/fluid/models/."""
 
-from . import lenet, resnet, vgg
+from . import lenet, resnet, se_resnext, vgg
 from .lenet import lenet5
 from .resnet import resnet50, resnet_cifar10
+from .se_resnext import se_resnext50
 from .vgg import vgg16

@@ -1,0 +1,82 @@
+"""SE-ResNeXt (reference benchmark/fluid + unittests/dist_se_resnext.py /
+test_parallel_executor_seresnext.py — the heavier conv model of the
+reference's PE-convergence and distributed test suites).
+
+Grouped 3x3 convolutions ride XLA's feature_group_count (MXU-friendly); the
+squeeze-and-excitation block is two tiny fcs around a global pool — left to
+XLA fusion rather than hand-fused."""
+
+from .. import layers
+from ..layers import nn
+
+__all__ = ["se_resnext50", "SE_ResNeXt"]
+
+
+def _conv_bn(input, num_filters, filter_size, stride=1, groups=1, act="relu"):
+    conv = layers.conv2d(
+        input=input,
+        num_filters=num_filters,
+        filter_size=filter_size,
+        stride=stride,
+        padding=(filter_size - 1) // 2,
+        groups=groups,
+        bias_attr=False,
+    )
+    return layers.batch_norm(input=conv, act=act)
+
+
+def _squeeze_excitation(input, num_channels, reduction_ratio=16):
+    pool = layers.pool2d(input=input, pool_type="avg", global_pooling=True)
+    pool = layers.reshape(pool, [0, num_channels])
+    squeeze = layers.fc(input=pool, size=num_channels // reduction_ratio, act="relu")
+    excitation = layers.fc(input=squeeze, size=num_channels, act="sigmoid")
+    excitation = layers.reshape(excitation, [0, num_channels, 1, 1])
+    return input * excitation
+
+
+def _bottleneck(input, num_filters, stride, cardinality=32, reduction_ratio=16):
+    conv0 = _conv_bn(input, num_filters, 1)
+    conv1 = _conv_bn(conv0, num_filters, 3, stride=stride, groups=cardinality)
+    conv2 = _conv_bn(conv1, num_filters * 2, 1, act=None)
+    scaled = _squeeze_excitation(conv2, num_filters * 2, reduction_ratio)
+    if input.shape[1] != num_filters * 2 or stride != 1:
+        shortcut = _conv_bn(input, num_filters * 2, 1, stride=stride, act=None)
+    else:
+        shortcut = input
+    return layers.relu(scaled + shortcut)
+
+
+class SE_ResNeXt:
+    def __init__(self, layers_num=50, depth_override=None, filters_override=None):
+        if layers_num != 50:
+            raise ValueError("only the 50-layer config is provided (like the dist test)")
+        # overrides give tests a structurally-identical but tiny instance
+        self.depth = depth_override or [3, 4, 6, 3]
+        self.num_filters = filters_override or [128, 256, 512, 1024]
+        self.cardinality = 32
+
+    def net(self, input, class_dim=1000):
+        conv = _conv_bn(input, 64, 7, stride=2)
+        conv = layers.pool2d(
+            input=conv, pool_size=3, pool_stride=2, pool_padding=1, pool_type="max"
+        )
+        for block, depth in enumerate(self.depth):
+            for i in range(depth):
+                conv = _bottleneck(
+                    conv,
+                    self.num_filters[block],
+                    stride=2 if i == 0 and block != 0 else 1,
+                    cardinality=self.cardinality,
+                )
+        pool = layers.pool2d(input=conv, pool_type="avg", global_pooling=True)
+        pool = layers.reshape(pool, [0, pool.shape[1]])
+        drop = layers.dropout(x=pool, dropout_prob=0.2)
+        return layers.fc(input=drop, size=class_dim, act="softmax")
+
+
+def se_resnext50(img, label, class_dim=1000, depth_override=None, filters_override=None):
+    out = SE_ResNeXt(50, depth_override, filters_override).net(img, class_dim)
+    cost = layers.cross_entropy(input=out, label=label)
+    loss = layers.mean(x=cost)
+    acc = layers.accuracy(input=out, label=label)
+    return loss, acc, out

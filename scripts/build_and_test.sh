@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# CI entry (reference paddle/scripts/paddle_build.sh: build, ctest, python
+# unittests, API-diff gate). Builds the native runtime, runs the full pytest
+# suite on a virtual 8-device CPU mesh, and regenerates+diffs the API spec.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== build native runtime =="
+python - <<'PY'
+from paddle_tpu import native
+native.lib()
+print("native runtime built:", native._LIB)
+PY
+
+echo "== python unittests (8-device CPU mesh) =="
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/ -q
+
+echo "== API diff gate =="
+python tools/print_signatures.py > /tmp/API.spec.current
+diff -u paddle_tpu/API.spec /tmp/API.spec.current \
+    || { echo "API surface changed; regenerate paddle_tpu/API.spec"; exit 1; }
+
+echo "== graft entry compile checks =="
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('multichip dryrun ok')"
+echo "ALL GREEN"

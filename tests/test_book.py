@@ -285,3 +285,42 @@ def test_recommender_system_movielens():
                 last = lv
     assert np.isfinite(last)
     assert last < first * 0.8, (first, last)
+
+
+def test_se_resnext_trains_tiny():
+    """reference test_parallel_executor_seresnext.py / dist_se_resnext.py
+    model family: SE-ResNeXt-50 builds, trains a few steps on tiny images,
+    loss finite and decreasing."""
+    from paddle_tpu.models import se_resnext
+
+    main, startup = _fresh()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 64, 64], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        loss, acc, _ = se_resnext.se_resnext50(
+            img,
+            label,
+            class_dim=10,
+            depth_override=[1, 1, 1, 1],
+            filters_override=[32, 64, 128, 256],
+        )
+        fluid.optimizer.Adam(learning_rate=0.003).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(8, 3, 64, 64).astype("float32")
+    labels = rng.randint(0, 10, (8, 1)).astype("int64")
+    # learnable: label-dependent channel brightness
+    for i in range(8):
+        imgs[i, labels[i, 0] % 3] += labels[i, 0] / 10.0
+    scope = Scope(seed=0)
+    losses = []
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for _ in range(12):
+            (lv,) = exe.run(
+                main, feed={"img": imgs, "label": labels}, fetch_list=[loss.name]
+            )
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses

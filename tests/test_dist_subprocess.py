@@ -34,20 +34,32 @@ def test_two_pservers_two_trainers_subprocess():
     endpoints = ",".join(eps)
     env = _env()
 
+    import tempfile
+
+    stderr_files = {}
+
     def spawn(role, **kw):
         cmd = [sys.executable, RUNNER, "--role", role, "--endpoints", endpoints,
                "--trainers", "2"]
         for k, v in kw.items():
             cmd += ["--%s" % k, str(v)]
-        # stderr -> DEVNULL: an undrained pipe filling with jax/absl warnings
-        # would deadlock the child; stdout carries the protocol lines
-        return subprocess.Popen(
-            cmd,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL,
-            text=True,
-            env=env,
+        # stderr -> temp file: an undrained PIPE filling with jax/absl
+        # warnings would deadlock the child, DEVNULL would lose the
+        # traceback when it dies; a file keeps both properties
+        ef = tempfile.NamedTemporaryFile(
+            mode="w+", prefix="dist_%s_" % role, suffix=".err", delete=False
         )
+        p = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=ef, text=True, env=env
+        )
+        stderr_files[p] = ef
+        return p
+
+    def child_stderr(p):
+        ef = stderr_files[p]
+        ef.flush()
+        ef.seek(0)
+        return ef.read()
 
     procs = []
     try:
@@ -55,22 +67,43 @@ def test_two_pservers_two_trainers_subprocess():
         procs += pservers
         # wait until both bind (reference start_pserver waits with timeout);
         # poll with a deadline so a wedged pserver fails instead of hanging
-        deadline = time.time() + 120
-        for p in pservers:
+        # a reader thread per pserver makes the readiness wait actually
+        # time-bounded: readline() itself blocks, so the deadline must be
+        # enforced from outside the read
+        import threading
+
+        ready = {}
+
+        def wait_ready(p):
             line = ""
             while "PSERVER_READY" not in line:
-                assert time.time() < deadline, "pserver not ready in time"
                 line = p.stdout.readline()
-                assert line or p.poll() is None, "pserver exited early"
+                if not line and p.poll() is not None:
+                    return
+            ready[p] = True
+
+        waiters = [
+            threading.Thread(target=wait_ready, args=(p,), daemon=True)
+            for p in pservers
+        ]
+        for w in waiters:
+            w.start()
+        for w in waiters:
+            w.join(timeout=120)
+        for p in pservers:
+            assert ready.get(p), "pserver not ready: %s" % child_stderr(p)
 
         trainers = [spawn("trainer", trainer_id=i) for i in range(2)]
         procs += trainers
         all_losses = []
         for tr in trainers:
             out, _ = tr.communicate(timeout=240)
-            assert tr.returncode == 0, "trainer failed (rc=%s)" % tr.returncode
+            assert tr.returncode == 0, "trainer failed:\n%s" % child_stderr(tr)
             loss_lines = [l for l in out.splitlines() if l.startswith("LOSSES ")]
-            assert loss_lines, "no losses in trainer output:\n%s" % out
+            assert loss_lines, "no losses in trainer output:\n%s\n%s" % (
+                out,
+                child_stderr(tr),
+            )
             all_losses.append(json.loads(loss_lines[0][len("LOSSES "):]))
 
         for losses in all_losses:
@@ -85,3 +118,8 @@ def test_two_pservers_two_trainers_subprocess():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        for ef in stderr_files.values():
+            name = ef.name
+            ef.close()
+            if os.path.exists(name):
+                os.unlink(name)

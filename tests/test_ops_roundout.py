@@ -117,8 +117,6 @@ class TestAttentionLstm(OpTest):
 
 class TestTensorArrayToTensor(unittest.TestCase):
     def test_stack_and_concat(self):
-        from paddle_tpu.layers import control_flow as cf
-
         main = framework.Program()
         with fluid.program_guard(main, framework.Program()):
             x = fluid.layers.data(name="tat_x", shape=[3, 4], dtype="float32")
