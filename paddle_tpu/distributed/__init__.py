@@ -9,3 +9,4 @@ from .rpc import RPCClient, RPCServer  # noqa: F401
 
 from . import master  # noqa: F401
 from .master import Master, MasterClient  # noqa: F401
+from .rpc import CollectiveClient  # noqa: F401

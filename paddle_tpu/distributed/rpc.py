@@ -24,6 +24,7 @@ Frame layout (little-endian):
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -108,9 +109,17 @@ class RPCClient:
                 cls._instance = cls(trainer_id)
         return cls._instance
 
-    def __init__(self, trainer_id=0, timeout=120.0):
+    def __init__(self, trainer_id=0, timeout=None):
+        from .. import flags as _flags
+
         self.trainer_id = trainer_id
-        self.timeout = timeout
+        # FLAGS_rpc_deadline governs connects and reply waits (reference
+        # grpc_client.cc FLAGS_rpc_deadline)
+        self.timeout = (
+            float(_flags.get_flags("rpc_deadline")["rpc_deadline"])
+            if timeout is None
+            else timeout
+        )
         self._socks = {}
         self._sock_locks = {}
         self._connect_lock = threading.Lock()
@@ -135,15 +144,60 @@ class RPCClient:
                 self._socks[endpoint] = s
             return self._socks[endpoint], ep_lock
 
+    def _drop_sock(self, endpoint, sock):
+        # drop ONLY the socket this attempt used: a concurrent worker may
+        # already have reconnected a healthy one under the same endpoint
+        with self._connect_lock:
+            if self._socks.get(endpoint) is sock:
+                self._socks.pop(endpoint, None)
+        try:
+            sock.close()
+        except OSError:
+            pass
+
     def _rpc(self, endpoint, frame, want_reply):
-        sock, lock = self._sock(endpoint)
-        with lock:
-            sock.sendall(frame)
-            if want_reply:
-                kind, _, name, arr = read_frame(sock)
-                return arr if kind == VAR_REPLY else None
-            kind, *_ = read_frame(sock)  # ACK keeps sends flow-controlled
-            return None
+        """One request/response, with reconnect-and-retry on connection
+        failure (reference grpc_client.cc FLAGS_max_retry + FLAGS_rpc_deadline:
+        a pserver restarting mid-training must not kill the trainer).
+
+        Retry policy respects idempotency: GET-style calls (want_reply) are
+        repeatable; mutating frames (SEND_VAR, barriers) are retried only
+        while the failure is at the CONNECT stage — once bytes may have
+        reached the server, a resend could double-apply a gradient or
+        double-count a barrier, so the error surfaces instead."""
+        from .. import flags as _flags
+
+        retries = int(_flags.get_flags("rpc_max_retry")["rpc_max_retry"])
+        last_err = None
+        for attempt in range(retries + 1):
+            try:
+                sock, lock = self._sock(endpoint)
+            except OSError as e:
+                last_err = e  # nothing sent: always safe to retry
+                if attempt < retries:
+                    time.sleep(min(0.2 * 2**attempt, 2.0))
+                continue
+            try:
+                with lock:
+                    sock.sendall(frame)
+                    if want_reply:
+                        kind, _, name, arr = read_frame(sock)
+                        return arr if kind == VAR_REPLY else None
+                    kind, *_ = read_frame(sock)  # ACK keeps sends flow-controlled
+                    return None
+            except (OSError, EOFError) as e:
+                last_err = e
+                self._drop_sock(endpoint, sock)
+                if not want_reply:
+                    raise ConnectionError(
+                        "rpc to %s failed after send may have been delivered "
+                        "(not retried: non-idempotent): %r" % (endpoint, e)
+                    )
+                if attempt < retries:
+                    time.sleep(min(0.2 * 2**attempt, 2.0))
+        raise ConnectionError(
+            "rpc to %s failed after %d retries: %r" % (endpoint, retries, last_err)
+        )
 
     # --- async API (reference rpc_client.h:36-79) ---
     def async_send_var(self, endpoint, name, array):
@@ -316,3 +370,31 @@ class RPCServer:
             self._listener.close()
         except OSError:
             pass
+
+
+class CollectiveClient:
+    """Gather a named var from many servers at once (reference
+    distributed/collective_client.h:62 CollectiveClient::Gather of remote
+    SelectedRows slices — the cross-node sparse-allgather building block).
+    Dense redesign: each pserver serves its slice; gather returns them in
+    endpoint order for host-side concat."""
+
+    def __init__(self, trainer_id=0):
+        self._client = RPCClient.instance(trainer_id)
+
+    def gather(self, endpoints, var_name, timeout=None):
+        # one OVERALL deadline across all endpoints (the futures run
+        # concurrently; per-future fresh budgets would multiply the wait)
+        budget = self._client.timeout if timeout is None else timeout
+        deadline = time.monotonic() + budget
+        futures = [
+            (ep, self._client.async_get_var(ep, var_name)) for ep in endpoints
+        ]
+        out = []
+        for ep, f in futures:
+            remaining = max(deadline - time.monotonic(), 0.001)
+            arr = f.result(timeout=remaining)
+            if arr is None:
+                raise KeyError("gather: %s has no var %r" % (ep, var_name))
+            out.append(arr)
+        return out

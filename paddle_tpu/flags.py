@@ -13,6 +13,8 @@ Honored flags:
   each run and raises (reference operator.cc:778 FLAGS_check_nan_inf).
 - benchmark: executor blocks until device work completes each run, so host
   timing brackets real step time (reference operator.cc:769 FLAGS_benchmark).
+- rpc_max_retry / rpc_deadline: socket RPC reconnect-retry count and call
+  timeout (reference grpc_client.cc FLAGS_max_retry / FLAGS_rpc_deadline).
 - eager_delete_tensor_gb / fraction_of_gpu_memory_to_use /
   paddle_num_threads: accepted for API compatibility; storage lifetime and
   threading are XLA/PJRT-owned here (documented no-ops).
@@ -29,6 +31,8 @@ _DEFAULTS = {
     "fraction_of_gpu_memory_to_use": 0.92,
     "paddle_num_threads": 1,
     "cpu_deterministic": False,
+    "rpc_max_retry": 3,
+    "rpc_deadline": 120.0,
 }
 
 _flags = {}

@@ -260,3 +260,63 @@ class TestPrefetchAgainstPserver(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestRpcRetryAndCollectiveGather(unittest.TestCase):
+    def test_gather_from_two_servers(self):
+        """CollectiveClient.gather (reference collective_server_test.cc:
+        in-process servers each serving a slice, client gathers)."""
+        from paddle_tpu.distributed.rpc import CollectiveClient, RPCServer
+
+        slices = [np.arange(6, dtype="float32").reshape(3, 2), 10 + np.arange(4, dtype="float32").reshape(2, 2)]
+        servers = []
+        for sl in slices:
+            srv = RPCServer("127.0.0.1:0", fanin=1)
+            srv.on_get = lambda name, tid, sl=sl: sl if name == "shard" else None
+            srv.on_send = lambda *a: None
+            srv.start()
+            servers.append(srv)
+        try:
+            eps = [s.endpoint for s in servers]
+            got = CollectiveClient(0).gather(eps, "shard")
+            np.testing.assert_allclose(got[0], slices[0])
+            np.testing.assert_allclose(got[1], slices[1])
+            whole = np.concatenate(got, axis=0)
+            self.assertEqual(whole.shape, (5, 2))
+            with self.assertRaises(KeyError):
+                CollectiveClient(0).gather(eps, "missing")
+        finally:
+            for s in servers:
+                s.stop() if hasattr(s, "stop") else None
+
+    def test_rpc_retries_after_reconnect(self):
+        """FLAGS_rpc_max_retry (reference grpc_client.cc FLAGS_max_retry): a
+        server that goes away and comes back on the same port is retried
+        transparently."""
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.distributed.rpc import RPCClient, RPCServer
+        from port_utils import free_ports
+
+        (port,) = free_ports(1)
+        ep = "127.0.0.1:%d" % port
+        table = np.ones((2, 2), "float32")
+
+        srv = RPCServer(ep, fanin=1)
+        srv.on_get = lambda name, tid: table
+        srv.on_send = lambda *a: None
+        srv.start()
+        client = RPCClient(trainer_id=0)
+        got = client.async_get_var(ep, "t").result(timeout=30)
+        np.testing.assert_allclose(got, table)
+        # simulate server death: stop the listener AND sever the client's
+        # cached connection (the established socket would otherwise keep
+        # being served by the old accept thread)
+        srv._listener.close()
+        client._socks[ep].close()
+        time.sleep(0.2)
+        srv2 = RPCServer(ep, fanin=1)
+        srv2.on_get = lambda name, tid: 2 * table
+        srv2.on_send = lambda *a: None
+        srv2.start()
+        got2 = client.async_get_var(ep, "t").result(timeout=30)
+        np.testing.assert_allclose(got2, 2 * table)
