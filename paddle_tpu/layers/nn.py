@@ -10,6 +10,7 @@ from ..initializer import Constant, Normal, Xavier
 from ..layer_helper import LayerHelper
 
 __all__ = [
+    "flash_attention",
     "fc",
     "embedding",
     "dropout",
@@ -1194,3 +1195,21 @@ def beam_search_decode(ids, scores, beam_size, end_id, name=None, parents=None):
     hyp_len.stop_gradient = True
     sentence_ids._hyp_len = hyp_len
     return sentence_ids, sentence_scores
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None, name=None):
+    """Fused blockwise attention over (b, h, t, d) tensors — emits the
+    Pallas flash-attention op (ops/pallas_kernels.py), the hand-tuned-kernel
+    tier analog of the reference's math/jit_kernel fused primitives."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {"causal": bool(causal)}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    helper.append_op(
+        type="flash_attention",
+        inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
+        outputs={"Out": [out.name]},
+        attrs=attrs,
+    )
+    return out

@@ -1,0 +1,189 @@
+"""Pallas TPU kernels — the hand-tuned hot-op tier.
+
+Reference analog: operators/math/jit_kernel.h:33-79 + jit_gen.h:41 — the
+reference JIT-assembles x86 vector kernels (Xbyak) where the compiler's
+codegen wasn't enough; on TPU that role belongs to Pallas kernels lowered
+onto MXU/VPU tiles (SURVEY.md §7.9 perf closure).
+
+First kernel: blockwise flash attention (online-softmax over KV blocks) —
+the transformer hot path. O(t) VMEM instead of the O(t²) score matrix,
+fusing QKᵀ → masked online softmax → PV into one kernel. Backward uses the
+standard recompute-vjp over the mathematically identical dense form (the
+flash-attention-2 trick of saving only the logsumexp), so autodiff works
+through the op while the forward runs the Pallas kernel.
+
+On non-TPU backends (the CPU test mesh) the kernel runs in Pallas interpret
+mode — same code path, no Mosaic compile — keeping tests hermetic.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .registry import register
+
+__all__ = ["flash_attention"]
+
+_DEF_BLOCK_Q = 128
+_DEF_BLOCK_K = 128
+
+
+def _attention_reference(q, k, v, causal, sm_scale):
+    """Dense XLA attention — the numerics contract and the vjp source."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, sm_scale,
+                  q_block_idx_axis, t_q_total):
+    """One (batch*head, q_block) program: stream KV blocks with the online
+    softmax recurrence (m = running max, l = running sum, acc = running PV)."""
+    qi = pl.program_id(q_block_idx_axis)
+    q = q_ref[...].astype(jnp.float32)  # (block_q, d)
+    block_q = q.shape[0]
+    t_k = k_ref.shape[0]
+    nk = pl.cdiv(t_k, block_k)
+
+    def body(ki, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # (block_q, block_k)
+        if causal:
+            # bottom-right alignment (same contract as _attention_reference's
+            # tril(k=tk-tq)): query row i may see keys up to i + (tk - tq)
+            offset = t_k - t_q_total
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos + offset >= k_pos, s, -jnp.inf)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # -inf rows (fully masked so far) must not poison the rescale
+        alpha = jnp.exp(jnp.where(m_prev == -jnp.inf, -jnp.inf, m_prev - m_new))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l_new
+
+    d = q.shape[1]
+    init = (
+        jnp.zeros((block_q, d), jnp.float32),
+        jnp.full((block_q,), -jnp.inf, jnp.float32),
+        jnp.zeros((block_q,), jnp.float32),
+    )
+    if causal:
+        # only KV blocks reaching this q block's last visible key contribute
+        last_key = qi * block_q + block_q - 1 + (t_k - t_q_total)
+        nk_needed = jnp.clip((last_key + block_k) // block_k, 0, nk)
+    else:
+        nk_needed = nk
+    acc, m, l = jax.lax.fori_loop(0, nk_needed, body, init)
+    o_ref[...] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        # ragged tails: fall back to the dense form (shapes are static, so
+        # this is a trace-time decision, not a runtime branch)
+        return _attention_reference(q, k, v, causal, sm_scale)
+    q3 = q.reshape(b * h, tq, d)
+    k3 = k.reshape(b * h, tk, d)
+    v3 = v.reshape(b * h, tk, d)
+    grid = (b * h, tq // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            block_k=block_k,
+            causal=causal,
+            sm_scale=sm_scale,
+            q_block_idx_axis=1,
+            t_q_total=tq,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, tk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, tk, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(b, h, tq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(
+    q,
+    k,
+    v,
+    causal=False,
+    sm_scale=None,
+    block_q=_DEF_BLOCK_Q,
+    block_k=_DEF_BLOCK_K,
+    interpret=None,
+):
+    """softmax(QKᵀ·scale [causal-masked]) V over (b, h, t, d) tensors."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, sm_scale, block_q, block_k, interpret, res, dout):
+    q, k, v = res
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    # recompute-vjp through the dense form: identical math, O(t²) only in
+    # the backward (flash backward kernels are a later perf-closure step)
+    _, vjp = jax.vjp(lambda a, b, c: _attention_reference(a, b, c, causal, sm_scale), q, k, v)
+    return vjp(dout)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+@register("flash_attention")
+def _flash_attention_op(ctx, ins, attrs):
+    """Graph-op form: Q/K/V (b, h, t, d) → Out. The transformer layers can
+    emit this in place of the matmul+softmax+matmul chain."""
+    (q,) = ins["Q"]
+    (k,) = ins["K"]
+    (v,) = ins["V"]
+    return {
+        "Out": [
+            flash_attention(
+                q,
+                k,
+                v,
+                bool(attrs.get("causal", False)),
+                attrs.get("sm_scale"),
+            )
+        ]
+    }

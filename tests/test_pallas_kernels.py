@@ -1,0 +1,171 @@
+"""Flash-attention Pallas kernel vs the dense XLA reference (the OpTest
+numerics contract for the hand-tuned kernel tier, SURVEY.md §7.9)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas_kernels import _attention_reference, flash_attention
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    rng = np.random.RandomState(0)
+    b, h, t, d = 2, 3, 256, 32
+    q = jnp.asarray(rng.randn(b, h, t, d).astype("float32"))
+    k = jnp.asarray(rng.randn(b, h, t, d).astype("float32"))
+    v = jnp.asarray(rng.randn(b, h, t, d).astype("float32"))
+    out = flash_attention(q, k, v, causal, None, 128, 128)
+    ref = _attention_reference(q, k, v, causal, d**-0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_grads_match_dense():
+    rng = np.random.RandomState(1)
+    b, h, t, d = 1, 2, 128, 16
+    q = jnp.asarray(rng.randn(b, h, t, d).astype("float32"))
+    k = jnp.asarray(rng.randn(b, h, t, d).astype("float32"))
+    v = jnp.asarray(rng.randn(b, h, t, d).astype("float32"))
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, True).sum()
+
+    def loss_dense(q, k, v):
+        return _attention_reference(q, k, v, True, d**-0.5).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd), rtol=2e-4, atol=2e-5)
+
+
+def test_ragged_tail_falls_back():
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(1, 1, 100, 16).astype("float32"))  # 100 % 128 != 0
+    out = flash_attention(q, q, q, False)
+    ref = _attention_reference(q, q, q, False, 16**-0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_graph_op():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Executor, Scope, scope_guard
+
+    rng = np.random.RandomState(3)
+    qkv = rng.randn(3, 1, 2, 128, 16).astype("float32")
+    main = framework.Program()
+    blk = main.global_block()
+    for name, arr in zip("qkv", qkv):
+        blk.create_var(name=name, shape=arr.shape, dtype="float32")
+    blk.create_var(name="att_out", shape=None, dtype=None)
+    blk.append_op(
+        type="flash_attention",
+        inputs={"Q": ["q"], "K": ["k"], "V": ["v"]},
+        outputs={"Out": ["att_out"]},
+        attrs={"causal": True},
+    )
+    exe = Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        (got,) = exe.run(
+            main,
+            feed={"q": qkv[0], "k": qkv[1], "v": qkv[2]},
+            fetch_list=["att_out"],
+        )
+    ref = _attention_reference(
+        jnp.asarray(qkv[0]), jnp.asarray(qkv[1]), jnp.asarray(qkv[2]), True, 16**-0.5
+    )
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_multi_head_attention_flash_path_trains():
+    """use_flash=True in the transformer attention emits the Pallas op and
+    the model still trains (grads flow through the custom vjp)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.models.transformer import multi_head_attention
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="mha_x", shape=[128, 32], dtype="float32")
+        label = fluid.layers.data(name="mha_y", shape=[128, 32], dtype="float32")
+        out = multi_head_attention(
+            x, x, x, None, d_key=8, d_value=8, d_model=32, n_head=4,
+            dropout_rate=0.0, use_flash=True, causal=True,
+        )
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(out, label))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    assert any(op.type == "flash_attention" for op in main.global_block().ops)
+
+    rng = np.random.RandomState(4)
+    xs = rng.randn(2, 128, 32).astype("float32")
+    ys = rng.randn(2, 128, 32).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        for _ in range(5):
+            (lv,) = exe.run(
+                main, feed={"mha_x": xs, "mha_y": ys}, fetch_list=[loss.name]
+            )
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_transformer_use_flash_end_to_end():
+    """transformer(use_flash=True) emits flash_attention ops in the decoder
+    self-attention and trains (unpadded batch)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.models.transformer import transformer
+
+    b, t, vocab = 2, 16, 50
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        feeds = {}
+        for name, shape, dtype in [
+            ("src_word", [t], "int64"),
+            ("src_pos", [t], "int64"),
+            ("trg_word", [t], "int64"),
+            ("trg_pos", [t], "int64"),
+            ("label", [t], "int64"),
+            ("label_weight", [t, 1], "float32"),
+        ]:
+            feeds[name] = fluid.layers.data(name=name, shape=shape, dtype=dtype)
+        loss = transformer(
+            feeds["src_word"], feeds["src_pos"], feeds["trg_word"],
+            feeds["trg_pos"], None, None, None,
+            feeds["label"], feeds["label_weight"],
+            src_vocab_size=vocab, trg_vocab_size=vocab,
+            n_layer=1, n_head=2, d_model=16, d_inner=32, d_key=8, d_value=8,
+            dropout=0.0, max_length=t + 1, use_flash=True,
+        )
+        loss = loss if not isinstance(loss, (list, tuple)) else loss[0]
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    assert any(
+        op.type == "flash_attention" for op in main.global_block().ops
+    ), "flash op not emitted"
+
+    rng = np.random.RandomState(5)
+    feed = {
+        "src_word": rng.randint(0, vocab, (b, t)).astype("int64"),
+        "src_pos": np.tile(np.arange(t), (b, 1)).astype("int64"),
+        "trg_word": rng.randint(0, vocab, (b, t)).astype("int64"),
+        "trg_pos": np.tile(np.arange(t), (b, 1)).astype("int64"),
+        "label": rng.randint(0, vocab, (b, t)).astype("int64"),
+        "label_weight": np.ones((b, t, 1), "float32"),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        for _ in range(4):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
