@@ -179,43 +179,106 @@ def _lstmp(ctx, ins, attrs):
     }
 
 
-@register("cudnn_lstm")
+def cudnn_lstm_weight_size(input_size, hidden_size, num_layers=1, is_bidirec=False):
+    """Flat-blob length for cudnn_lstm's layout (documented below) (layer helper for users)."""
+    num_dir = 2 if is_bidirec else 1
+    total = 0
+    d_in = input_size
+    for _ in range(num_layers):
+        for _ in range(num_dir):
+            total += d_in * 4 * hidden_size + hidden_size * 4 * hidden_size + 4 * hidden_size
+        d_in = hidden_size * num_dir
+    return total
+
+
+@register("cudnn_lstm", stochastic=True)
 def _cudnn_lstm(ctx, ins, attrs):
-    """Padded-batch single-layer LSTM over seq-major input (reference
-    cudnn_lstm_op.cu.cc). W is a flat blob [Wx(D,4h) | Wh(h,4h) | b(4h)] —
-    the cuDNN packed-weights analog; multi-layer/bidirectional variants should
-    be built from stacked `lstm` ops instead (models/stacked_lstm.py)."""
+    """Stacked (optionally bidirectional) LSTM over seq-major padded input
+    (reference cudnn_lstm_op.cu.cc). W is a flat blob in layer-major,
+    direction-minor order; per (layer, direction) the segment is
+    [Wx(d_in,4h) | Wh(h,4h) | b(4h)], the cuDNN packed-weights analog
+    (layout documented here, not byte-compatible with cuDNN's). Bidirection
+    concatenates fwd/bwd hidden per layer, doubling the next layer's d_in.
+    InitH/InitC are (num_layers*num_dir, N, h)."""
     (x,) = ins["Input"]  # (T, N, D) seq-major like cuDNN
     (w,) = ins["W"]
-    hidden_size = int(attrs["hidden_size"])
-    if int(attrs.get("num_layers", 1)) != 1 or attrs.get("is_bidirec", False):
-        raise NotImplementedError(
-            "cudnn_lstm: stack lstm ops for multi-layer/bidirectional"
-        )
+    h = int(attrs["hidden_size"])
+    num_layers = int(attrs.get("num_layers", 1))
+    bidirec = bool(attrs.get("is_bidirec", False))
+    num_dir = 2 if bidirec else 1
     t, n, d = x.shape
-    h = hidden_size
     flat = w.reshape(-1)
-    wx = flat[: d * 4 * h].reshape(d, 4 * h)
-    wh = flat[d * 4 * h : (d + h) * 4 * h].reshape(h, 4 * h)
-    b = flat[(d + h) * 4 * h : (d + h) * 4 * h + 4 * h]
-    h0 = _opt(ins, "InitH")
-    c0 = _opt(ins, "InitC")
-    h0 = jnp.zeros((n, h), x.dtype) if h0 is None else h0.reshape(n, h)
-    c0 = jnp.zeros((n, h), x.dtype) if c0 is None else c0.reshape(n, h)
+    expected = cudnn_lstm_weight_size(d, h, num_layers, bidirec)
+    if flat.size != expected:
+        raise ValueError(
+            "cudnn_lstm: W has %d elements but the documented layout needs %d "
+            "(input=%d, hidden=%d, layers=%d, bidirec=%s) — see "
+            "cudnn_lstm_weight_size" % (flat.size, expected, d, h, num_layers, bidirec)
+        )
+    h0_all = _opt(ins, "InitH")
+    c0_all = _opt(ins, "InitC")
 
-    def step(carry, xt):
-        h_prev, c_prev = carry
-        gates = xt @ wx + h_prev @ wh + b
-        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
-        i = jax.nn.sigmoid(gi)
-        f = jax.nn.sigmoid(gf)
-        c_new = f * c_prev + i * jnp.tanh(gc)
-        o = jax.nn.sigmoid(go)
-        h_new = o * jnp.tanh(c_new)
-        return (h_new, c_new), h_new
+    def seg_sizes(d_in):
+        return d_in * 4 * h, h * 4 * h, 4 * h
 
-    (hl, cl), hs = lax.scan(step, (h0, c0), x)
-    return {"Out": [hs], "last_h": [hl[None]], "last_c": [cl[None]]}
+    def run_direction(inp, wx, wh, b, h0, c0, reverse):
+        xs = jnp.flip(inp, axis=0) if reverse else inp
+
+        def step(carry, xt):
+            h_prev, c_prev = carry
+            gates = xt @ wx + h_prev @ wh + b
+            gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(gf) * c_prev + jax.nn.sigmoid(gi) * jnp.tanh(gc)
+            h_new = jax.nn.sigmoid(go) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        (hl, cl), hs = lax.scan(step, (h0, c0), xs)
+        if reverse:
+            hs = jnp.flip(hs, axis=0)
+        return hs, hl, cl
+
+    dropout_prob = float(attrs.get("dropout_prob", 0.0) or 0.0)
+    is_test = bool(attrs.get("is_test", False))
+    pos = 0
+    cur = x
+    last_h, last_c = [], []
+    for layer in range(num_layers):
+        if layer > 0 and dropout_prob and not is_test:
+            # inter-layer dropout (reference cudnn_lstm applies it between
+            # stacked layers, never after the last)
+            keep = jax.random.bernoulli(ctx.next_rng(), 1.0 - dropout_prob, cur.shape)
+            cur = cur * keep.astype(cur.dtype) / (1.0 - dropout_prob)
+        d_in = cur.shape[-1]
+        sx, sh, sb = seg_sizes(d_in)
+        outs = []
+        for direction in range(num_dir):
+            wx = flat[pos : pos + sx].reshape(d_in, 4 * h)
+            pos += sx
+            wh = flat[pos : pos + sh].reshape(h, 4 * h)
+            pos += sh
+            b = flat[pos : pos + sb]
+            pos += sb
+            idx = layer * num_dir + direction
+            h0 = (
+                h0_all.reshape(-1, n, h)[idx]
+                if h0_all is not None
+                else jnp.zeros((n, h), x.dtype)
+            )
+            c0 = (
+                c0_all.reshape(-1, n, h)[idx]
+                if c0_all is not None
+                else jnp.zeros((n, h), x.dtype)
+            )
+            hs, hl, cl = run_direction(cur, wx, wh, b, h0, c0, direction == 1)
+            outs.append(hs)
+            last_h.append(hl)
+            last_c.append(cl)
+        cur = outs[0] if num_dir == 1 else jnp.concatenate(outs, axis=-1)
+    return {
+        "Out": [cur],
+        "last_h": [jnp.stack(last_h)],
+        "last_c": [jnp.stack(last_c)],
+    }
 
 
 def _project_then(ins, wx_slot, extra):

@@ -459,3 +459,63 @@ class TestParallelDo(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestCudnnLstmStackedBidirec(unittest.TestCase):
+    def test_two_layer_bidirectional(self):
+        """Stacked bidirectional cudnn_lstm vs a numpy reference over the
+        documented flat-weight layout."""
+        from paddle_tpu.ops.compose_ops import cudnn_lstm_weight_size
+
+        t, n, d, h = 4, 2, 3, 2
+        rng = np.random.RandomState(9)
+        x = rng.randn(t, n, d).astype("float32") * 0.5
+        size = cudnn_lstm_weight_size(d, h, num_layers=2, is_bidirec=True)
+        w = (rng.randn(size) * 0.3).astype("float32")
+
+        def lstm_dir(inp, wx, wh, b, reverse):
+            seq = inp[::-1] if reverse else inp
+            hp = np.zeros((n, h))
+            cp = np.zeros((n, h))
+            hs = []
+            for xt in seq:
+                gates = xt @ wx + hp @ wh + b
+                gi, gf, gc, go = np.split(gates, 4, axis=1)
+                cp = sigmoid(gf) * cp + sigmoid(gi) * np.tanh(gc)
+                hp = sigmoid(go) * np.tanh(cp)
+                hs.append(hp)
+            out = np.stack(hs)
+            return out[::-1] if reverse else out
+
+        pos = 0
+        cur = x.astype("float64")
+        for layer in range(2):
+            d_in = cur.shape[-1]
+            outs = []
+            for direction in range(2):
+                wx = w[pos : pos + d_in * 4 * h].reshape(d_in, 4 * h); pos += d_in * 4 * h
+                wh = w[pos : pos + h * 4 * h].reshape(h, 4 * h); pos += h * 4 * h
+                b = w[pos : pos + 4 * h]; pos += 4 * h
+                outs.append(lstm_dir(cur, wx, wh, b, direction == 1))
+            cur = np.concatenate(outs, axis=-1)
+
+        main = framework.Program()
+        blk = main.global_block()
+        blk.create_var(name="cl_x", shape=x.shape, dtype="float32")
+        blk.create_var(name="cl_w", shape=w.shape, dtype="float32")
+        for o in ["cl_out", "cl_h", "cl_c"]:
+            blk.create_var(name=o, shape=None, dtype=None)
+        blk.append_op(
+            type="cudnn_lstm",
+            inputs={"Input": ["cl_x"], "W": ["cl_w"]},
+            outputs={"Out": ["cl_out"], "last_h": ["cl_h"], "last_c": ["cl_c"]},
+            attrs={"hidden_size": h, "num_layers": 2, "is_bidirec": True},
+        )
+        exe = Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            out, lh = exe.run(
+                main, feed={"cl_x": x, "cl_w": w}, fetch_list=["cl_out", "cl_h"]
+            )
+        self.assertEqual(out.shape, (t, n, 2 * h))
+        self.assertEqual(lh.shape, (4, n, h))  # 2 layers x 2 directions
+        np.testing.assert_allclose(out, cur, rtol=1e-4, atol=1e-5)
