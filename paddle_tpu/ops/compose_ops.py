@@ -191,7 +191,7 @@ def cudnn_lstm_weight_size(input_size, hidden_size, num_layers=1, is_bidirec=Fal
     return total
 
 
-@register("cudnn_lstm", stochastic=True)
+@register("cudnn_lstm")
 def _cudnn_lstm(ctx, ins, attrs):
     """Stacked (optionally bidirectional) LSTM over seq-major padded input
     (reference cudnn_lstm_op.cu.cc). W is a flat blob in layer-major,
@@ -245,8 +245,15 @@ def _cudnn_lstm(ctx, ins, attrs):
     for layer in range(num_layers):
         if layer > 0 and dropout_prob and not is_test:
             # inter-layer dropout (reference cudnn_lstm applies it between
-            # stacked layers, never after the last)
-            keep = jax.random.bernoulli(ctx.next_rng(), 1.0 - dropout_prob, cur.shape)
+            # stacked layers, never after the last). The mask key derives
+            # from a FIXED seed attr + layer index, NOT ctx.next_rng(): the
+            # generic vjp-replay grad re-runs this lowering and must sample
+            # the identical mask (same hazard dropout solves with its Mask
+            # output, core_ops.py)
+            key = jax.random.fold_in(
+                jax.random.key(int(attrs.get("seed", 0) or 0)), layer
+            )
+            keep = jax.random.bernoulli(key, 1.0 - dropout_prob, cur.shape)
             cur = cur * keep.astype(cur.dtype) / (1.0 - dropout_prob)
         d_in = cur.shape[-1]
         sx, sh, sb = seg_sizes(d_in)

@@ -457,8 +457,6 @@ class TestParallelDo(unittest.TestCase):
         np.testing.assert_allclose(out, x * 3.0, rtol=1e-6)
 
 
-if __name__ == "__main__":
-    unittest.main()
 
 
 class TestCudnnLstmStackedBidirec(unittest.TestCase):
@@ -519,3 +517,7 @@ class TestCudnnLstmStackedBidirec(unittest.TestCase):
         self.assertEqual(out.shape, (t, n, 2 * h))
         self.assertEqual(lh.shape, (4, n, h))  # 2 layers x 2 directions
         np.testing.assert_allclose(out, cur, rtol=1e-4, atol=1e-5)
+
+
+if __name__ == "__main__":
+    unittest.main()
