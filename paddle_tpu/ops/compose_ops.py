@@ -245,11 +245,22 @@ def _cudnn_lstm(ctx, ins, attrs):
     for layer in range(num_layers):
         if layer > 0 and dropout_prob and not is_test:
             # inter-layer dropout (reference cudnn_lstm applies it between
-            # stacked layers, never after the last). The mask key derives
-            # from a FIXED seed attr + layer index, NOT ctx.next_rng(): the
-            # generic vjp-replay grad re-runs this lowering and must sample
-            # the identical mask (same hazard dropout solves with its Mask
-            # output, core_ops.py)
+            # stacked layers, never after the last). LIMITATION: the mask
+            # key derives from the seed attr + layer, NOT ctx.next_rng() —
+            # the vjp-replay grad must resample the identical mask — so the
+            # mask is FIXED across steps (static thinning, not stochastic
+            # regularization). For real dropout regularization compose
+            # `lstm` ops with dropout layers (models/stacked_lstm.py),
+            # whose Mask-reusing grad supports per-step masks.
+            import warnings
+
+            if not attrs.get("__dropout_warned__"):
+                warnings.warn(
+                    "cudnn_lstm dropout_prob uses a step-constant mask "
+                    "(seed attr); compose lstm + dropout layers for "
+                    "per-step stochastic dropout"
+                )
+                attrs["__dropout_warned__"] = True
             key = jax.random.fold_in(
                 jax.random.key(int(attrs.get("seed", 0) or 0)), layer
             )

@@ -350,6 +350,16 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
     )
 
 
+def _resolve_defaults(q, sm_scale, interpret):
+    """Single source of the defaulting rule: forward, _fwd and _bwd must
+    agree or a custom_vjp would silently produce wrong gradients."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return sm_scale, interpret
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q,
@@ -362,18 +372,12 @@ def flash_attention(
     interpret=None,
 ):
     """softmax(QKᵀ·scale [causal-masked]) V over (b, h, t, d) tensors."""
-    if sm_scale is None:
-        sm_scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    sm_scale, interpret = _resolve_defaults(q, sm_scale, interpret)
     return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret)
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    if sm_scale is None:
-        sm_scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    sm_scale, interpret = _resolve_defaults(q, sm_scale, interpret)
     res = _flash_forward(
         q, k, v, causal, sm_scale, block_q, block_k, interpret, with_lse=True
     )
@@ -383,10 +387,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
 def _bwd(causal, sm_scale, block_q, block_k, interpret, res, dout):
     q, k, v, out, lse = res
-    if sm_scale is None:
-        sm_scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    sm_scale, interpret = _resolve_defaults(q, sm_scale, interpret)
     if lse is None:
         # ragged-tail fallback: dense recompute-vjp (same trace-time decision
         # as the forward fallback)

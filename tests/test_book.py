@@ -324,3 +324,34 @@ def test_se_resnext_trains_tiny():
             losses.append(float(np.asarray(lv).reshape(-1)[0]))
     assert np.isfinite(losses).all()
     assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+
+def test_alexnet_and_googlenet_train_tiny():
+    """reference benchmark/README.md speed-table models (AlexNet :33,
+    GoogLeNet :45): both build and train a few steps on tiny images."""
+    from paddle_tpu.models import alexnet, googlenet
+
+    rng = np.random.RandomState(3)
+    for name, build in [("alexnet", alexnet.alexnet), ("googlenet", googlenet.googlenet)]:
+        main, startup = _fresh()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[3, 96, 96], dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+            loss, acc, _ = build(img, label, class_dim=10)
+            fluid.optimizer.Adam(learning_rate=0.001).minimize(loss)
+        imgs = rng.rand(4, 3, 96, 96).astype("float32")
+        labels = rng.randint(0, 10, (4, 1)).astype("int64")
+        for i in range(4):
+            imgs[i, labels[i, 0] % 3] += labels[i, 0] / 10.0
+        scope = Scope(seed=0)
+        losses = []
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            for _ in range(6):
+                (lv,) = exe.run(
+                    main, feed={"img": imgs, "label": labels}, fetch_list=[loss.name]
+                )
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        assert np.isfinite(losses).all(), (name, losses)
+        assert np.mean(losses[-2:]) < np.mean(losses[:2]), (name, losses)
