@@ -34,6 +34,12 @@ from .ops.registry import EMPTY_VAR_NAME
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
 
 
+def _flags_profile_ops():
+    from . import flags as _flags
+
+    return _flags.get_flags("profile_ops")["profile_ops"]
+
+
 class Scope:
     """name -> device array store (reference scope.h:134, flat not hierarchical
     — per-iteration locals are SSA temporaries inside the jitted function, so
@@ -440,6 +446,18 @@ class Executor:
         )
         from . import profiler as _prof
 
+        if _prof.is_profiling() and _flags_profile_ops():
+            # per-op attribution mode: never cached (diagnosis path); falls
+            # through to the shared nan-check/return tail below
+            compiled = _PerOpProfiledBlock(
+                program, block, list(feed_arrays.keys()), fetch_names
+            )
+            with _prof.RecordEvent("run/block0"):
+                fetches = compiled(scope, feed_arrays)
+            return self._finish_run(
+                compiled, scope, fetch_names, fetches, return_numpy
+            )
+
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             has_host = any(
@@ -466,6 +484,13 @@ class Executor:
                 # reference FLAGS_benchmark: wait so host timing is real step
                 # time (operator.cc:769 dev_ctx->Wait)
                 fetches = [jax.block_until_ready(f) for f in fetches]
+        return self._finish_run(compiled, scope, fetch_names, fetches, return_numpy)
+
+    @staticmethod
+    def _finish_run(compiled, scope, fetch_names, fetches, return_numpy):
+        """Shared run tail: FLAGS_check_nan_inf scan + numpy conversion."""
+        from . import flags as _flags
+
         if _flags.get_flags("check_nan_inf")["check_nan_inf"]:
             # reference FLAGS_check_nan_inf (operator.cc:778): finiteness
             # reduces ON DEVICE into one stacked scalar (a single host sync
@@ -494,3 +519,69 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
+
+
+class _PerOpProfiledBlock:
+    """Op-by-op EAGER execution with a RecordEvent + device sync per op —
+    the reference's per-op profiler tables (platform/profiler wraps every
+    op->Run, operator.cc:157). Fusion is deliberately lost: this exists to
+    attribute time per op type under FLAGS_profile_ops, not to train fast."""
+
+    def __init__(self, program, block, feed_names, fetch_names):
+        self.block = block
+        self.fetch_names = list(fetch_names)
+        unknown = sorted(
+            {op.type for op in block.ops if not registry.is_registered(op.type)}
+        )
+        if unknown:
+            # same diagnosis-quality error as the jitted path
+            raise NotImplementedError("ops without lowering: %s" % unknown)
+        self.ops = [
+            op for op in block.ops if not registry.get(op.type).skip_exec
+        ]
+        # nan-check contract shared with _CompiledBlock/_SegmentedBlock
+        self.mut_names = sorted(
+            {
+                n
+                for op in self.ops
+                for n in op.output_arg_names
+                if n != registry.EMPTY_VAR_NAME
+                and block.has_var_recursive(n)
+                and block._var_recursive(n).persistable
+            }
+        )
+
+    def __call__(self, scope, feed_arrays):
+        from . import profiler as _prof
+
+        env = dict(scope.vars)
+        for name, value in feed_arrays.items():
+            env[name] = value if isinstance(value, jax.Array) else jnp.asarray(value)
+        ctx = registry.LowerCtx(scope.rng_key)
+        for op in self.ops:
+            opdef = registry.get(op.type)
+            with _prof.RecordEvent("op/%s" % op.type):
+                if opdef.is_host:
+                    # host ops see a scratch scope view so env temporaries
+                    # never leak into the real scope
+                    before = set(scope.vars)
+                    scope.vars.update(env)
+                    opdef.host_fn(op, scope)
+                    env.update(scope.vars)
+                    for name in set(scope.vars) - before:
+                        if name not in self.mut_names:
+                            scope.vars.pop(name, None)
+                    continue
+                # the shared interpreter body (one op at a time), then sync
+                # this op's outputs so the event brackets its device time
+                registry.lower_ops(ctx, [op], env)
+                for name in op.output_arg_names:
+                    val = env.get(name)
+                    if isinstance(val, jax.Array):
+                        env[name] = jax.block_until_ready(val)
+        scope.rng_key = ctx.key
+        # persist block-written persistables like the jitted path does
+        for name in self.mut_names:
+            if name in env:
+                scope.vars[name] = env[name]
+        return [env[n] for n in self.fetch_names]

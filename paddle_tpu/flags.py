@@ -15,6 +15,10 @@ Honored flags:
   timing brackets real step time (reference operator.cc:769 FLAGS_benchmark).
 - rpc_max_retry / rpc_deadline: socket RPC reconnect-retry count and call
   timeout (reference grpc_client.cc FLAGS_max_retry / FLAGS_rpc_deadline).
+- profile_ops: while the profiler is on, run blocks op-by-op EAGERLY with a
+  device sync per op, so the profiler table attributes time per op type —
+  the reference's per-op RecordEvent tables (operator.cc:157). Slower and
+  unfused by construction; a diagnosis mode, never a training mode.
 - eager_delete_tensor_gb / fraction_of_gpu_memory_to_use /
   paddle_num_threads: accepted for API compatibility; storage lifetime and
   threading are XLA/PJRT-owned here (documented no-ops).
@@ -33,6 +37,7 @@ _DEFAULTS = {
     "cpu_deterministic": False,
     "rpc_max_retry": 3,
     "rpc_deadline": 120.0,
+    "profile_ops": False,
 }
 
 _flags = {}

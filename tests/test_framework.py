@@ -207,3 +207,36 @@ def test_flags_check_nan_inf():
     finally:
         fluid.set_flags({"check_nan_inf": False})
     assert fluid.get_flags("check_nan_inf") == {"check_nan_inf": False}
+
+
+def test_profile_ops_mode():
+    """FLAGS_profile_ops: per-op eager execution under the profiler produces
+    op-type-attributed events (reference per-op RecordEvent tables) and the
+    same numerics as the jitted path."""
+    from paddle_tpu.executor import Scope, scope_guard
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="pox", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3, act="relu")
+        loss = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"pox": np.ones((2, 4), "float32")}
+    with scope_guard(Scope(seed=1)):
+        exe.run(startup)
+        (jitted,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+    fluid.set_flags({"profile_ops": True})
+    try:
+        with scope_guard(Scope(seed=1)):
+            exe.run(startup)
+            with fluid.profiler.profiler("All", "total", None):
+                (per_op,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+            import paddle_tpu.profiler as prof
+
+            table, _ = prof._aggregate()
+    finally:
+        fluid.set_flags({"profile_ops": False})
+        fluid.profiler.reset_profiler()
+    np.testing.assert_allclose(per_op, jitted, rtol=1e-5)
+    assert any(name.endswith("op/mul") for name in table), table.keys()
+    assert any(name.endswith("op/relu") for name in table), table.keys()
