@@ -15,6 +15,7 @@ trainer's barrier count is MONOTONIC and round r waits for count > r — no
 reset, no race.
 """
 
+import logging
 import threading
 
 import numpy as np
@@ -112,9 +113,24 @@ def run_pserver(op, scope):
             if ids is None or table is None:
                 return None
             tbl = np.asarray(table)
-            idx = np.clip(ids.astype(np.int64), 0, tbl.shape[0] - 1)
+            ids64 = ids.astype(np.int64)
+            # ids here are GLOBAL row ids served against a full table; the
+            # split_byref row-sharded layout is not served via prefetch (the
+            # distribute transpiler keeps lookup tables whole on one pserver
+            # — see distribute_transpiler lookup-table rewrite). Reject out-
+            # of-range ids loudly instead of clamping to the last row.
+            if np.any(ids64 >= tbl.shape[0]):
+                # empty reply → the client raises (same contract as an
+                # unknown var) instead of silently serving the last row
+                logging.error(
+                    "prefetch id %d out of range for table %r with %d rows",
+                    int(ids64.max()), table_name, tbl.shape[0],
+                )
+                return None
+            # masked slots (id<0) index row 0 then zero out below
+            idx = np.maximum(ids64, 0)
             rows = tbl[idx]
-            rows[ids < 0] = 0
+            rows[ids64 < 0] = 0
             return rows
         if name.startswith("__checkpoint__:"):
             # RequestCheckpointHandler (request_handler_impl.h:103): persist

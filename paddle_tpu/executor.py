@@ -19,6 +19,7 @@ Scope (reference framework/scope.h) holds name -> jax.Array plus the PRNG key
 that stochastic ops consume.
 """
 
+import itertools
 import threading
 
 import numpy as np
@@ -45,10 +46,14 @@ class Scope:
     — per-iteration locals are SSA temporaries inside the jitted function, so
     child scopes are unnecessary)."""
 
+    _uid_counter = itertools.count()
+
     def __init__(self, seed=0):
         self.vars = {}
         self._seed = seed
         self._rng_key = None  # lazy: creating a key initializes the backend
+        # monotonic uid for executable-cache keys (id() can be reused after GC)
+        self._uid = next(Scope._uid_counter)
 
     @property
     def rng_key(self):
@@ -62,6 +67,10 @@ class Scope:
 
     def find_var(self, name):
         return self.vars.get(name)
+
+    def var_names(self):
+        """reference scope.h LocalVarNames()"""
+        return list(self.vars)
 
     def var(self, name):
         return self.vars.setdefault(name, None)
@@ -438,11 +447,11 @@ class Executor:
             feed_arrays[name] = _as_feed_array(value, var)
 
         key = (
-            id(program),
+            program._uid,
             program._version,
             tuple(sorted((n, a.shape, str(a.dtype)) for n, a in feed_arrays.items())),
             tuple(fetch_names),
-            id(scope),
+            scope._uid,
         )
         from . import profiler as _prof
 

@@ -18,6 +18,7 @@ TPU-first notes:
 
 import contextlib
 import copy
+import itertools
 
 import numpy as np
 
@@ -469,11 +470,16 @@ class Program:
     framework.py:1466). `clone()` deep-copies the graph; `_version` increments
     on any mutation and keys the executor's executable cache."""
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
         self.random_seed = 0
         self._version = 0
+        # monotonic uid: executor caches key on this instead of id(self) so a
+        # new Program can never alias a GC'd one's cache entries
+        self._uid = next(Program._uid_counter)
         self._op_role = OpRole.Forward
         self._op_role_var = []
         self._is_test = False

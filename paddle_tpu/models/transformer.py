@@ -8,6 +8,8 @@ Everything is built from registered ops (mul/matmul/softmax/layer_norm/
 dropout/...) so the whole training step compiles into one XLA module; the
 batched QK^T / PV matmuls land on the MXU."""
 
+import warnings
+
 import numpy as np
 
 from .. import layers
@@ -109,16 +111,34 @@ def encoder_layer(x, attn_bias, cfg):
 
 
 def decoder_layer(x, enc_out, slf_bias, cross_bias, cfg):
-    # under use_flash the decoder self-attention drops its triangular bias
-    # tensor and uses the kernel's causal mask instead (valid for unpadded
-    # batches — the training-throughput configuration)
-    if cfg.get("use_flash", False):
-        slf_bias = None
+    # Under use_flash the decoder self-attention uses the kernel's causal
+    # mask instead of the triangular bias tensor. The kernel carries no
+    # key-padding mask, so this is only valid when every sequence in the
+    # batch is full-length. cfg["padded"] is tri-state: True keeps the dense
+    # bias-masked path for decoder self-attention; False asserts batches are
+    # unpadded (flash, no warning); None (unspecified) uses flash but warns
+    # so callers who never considered padding find out.
+    use_flash_slf = cfg.get("use_flash", False)
+    if use_flash_slf:
+        padded = cfg.get("padded")
+        if padded:
+            use_flash_slf = False
+        else:
+            if padded is None:
+                warnings.warn(
+                    "transformer decoder self-attention with use_flash drops "
+                    "the attention-bias tensor and applies only a causal "
+                    "mask; pad positions would be attended. Pass padded=True "
+                    "for the dense masked path, or padded=False to assert "
+                    "batches are unpadded and silence this warning.",
+                    stacklevel=2,
+                )
+            slf_bias = None
     slf = multi_head_attention(
         x, x, x, slf_bias, cfg["d_key"], cfg["d_value"], cfg["d_model"],
         cfg["n_head"], cfg["dropout"],
-        use_flash=cfg.get("use_flash", False),
-        causal=cfg.get("use_flash", False),
+        use_flash=use_flash_slf,
+        causal=use_flash_slf,
     )
     slf = pre_post_process(x, slf, "dan", cfg["dropout"])
     cross = multi_head_attention(
@@ -178,11 +198,17 @@ def transformer(
     max_length=64,
     label_smooth_eps=0.1,
     use_flash=False,
+    padded=None,
 ):
+    # padded (tri-state, only meaningful under use_flash): True = batches may
+    # contain pad positions, decoder self-attention keeps the dense
+    # bias-masked path (the flash kernel carries no key-padding mask);
+    # False = caller asserts batches are unpadded, flash runs silently;
+    # None = flash runs but decoder_layer warns once
     cfg = dict(
         d_model=d_model, d_inner=d_inner, d_key=d_key, d_value=d_value,
         n_head=n_head, dropout=dropout, max_length=max_length,
-        use_flash=use_flash,
+        use_flash=use_flash, padded=padded,
     )
     enc = embed(src_word, src_pos, src_vocab_size, cfg, "src")
     for _ in range(n_layer):

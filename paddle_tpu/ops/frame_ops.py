@@ -190,10 +190,15 @@ def _tensor_array_to_tensor(ctx, ins, attrs):
     axis = int(attrs.get("axis", 0))
     if attrs.get("use_stack", False):
         out = jnp.moveaxis(buf, 0, axis)
+        # reference OutIndex under stack: one slot contributed per input
+        per_slot = 1
     else:
         pieces = [buf[i] for i in range(buf.shape[0])]
         out = jnp.concatenate(pieces, axis=axis)
-    idx = jnp.full((buf.shape[0],), buf.shape[1] if buf.ndim > 1 else 1, jnp.int32)
+        # reference OutIndex holds each input's extent along the concat axis;
+        # buf slots are uniform, so that's slot-shape[axis]
+        per_slot = pieces[0].shape[axis] if pieces[0].ndim else 1
+    idx = jnp.full((buf.shape[0],), per_slot, jnp.int32)
     return {"Out": [out], "OutIndex": [idx]}
 
 

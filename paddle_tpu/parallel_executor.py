@@ -149,11 +149,11 @@ class ParallelExecutor:
             feed_arrays[name] = arr
 
         key = (
-            id(program),
+            program._uid,
             program._version,
             tuple(sorted((n, a.shape, str(a.dtype)) for n, a in feed_arrays.items())),
             tuple(fetch_names),
-            id(self._scope),
+            self._scope._uid,
         )
         compiled = self._cache.get(key)
         if compiled is None:

@@ -114,9 +114,20 @@ def gradient_merge_transpile(main_program, startup_program, k_steps, avg=True):
         )
     )
 
-    # per-gradient accumulation buffers + accumulate ops; optimizer ops are
-    # retargeted at the accumulator and moved into the conditional sub-block
-    opt_ops = [block.ops[i] for i in opt_idx]
+    # Every Optimize-role op from the first optimizer op onward moves into
+    # the conditional sub-block — not just OPTIMIZER_OP_TYPES. Adam/Adamax
+    # _finish_update emits `scale` ops advancing Beta{1,2}Pow after the
+    # optimizer tier; leaving those outside would advance bias-correction
+    # state every micro-step (k× too fast).
+    moved_idx = [
+        i
+        for i, op in enumerate(block.ops)
+        if i >= first_opt
+        and int(op.attrs.get(OpRole.OP_ROLE_KEY, 0)) & int(OpRole.Optimize)
+    ]
+    moved_set = set(moved_idx)
+    moved_ops = [block.ops[i] for i in moved_idx]
+    opt_ops = [op for op in moved_ops if op.type in OPTIMIZER_OP_TYPES]
     grads = []
     accum_of = {}
     for op in opt_ops:
@@ -141,10 +152,10 @@ def gradient_merge_transpile(main_program, startup_program, k_steps, avg=True):
     sub = main_program._create_block()
     scale = 1.0 / k_steps if avg else 1.0
     written = []
-    for op in opt_ops:
+    for op in moved_ops:
         new_inputs = {}
         for slot, names in op.inputs.items():
-            if slot == "Grad":
+            if slot == "Grad" and op.type in OPTIMIZER_OP_TYPES:
                 scaled = []
                 for gname in names:
                     aname = accum_of[gname]
@@ -215,12 +226,18 @@ def gradient_merge_transpile(main_program, startup_program, k_steps, avg=True):
 
     # splice: [fwd+bwd ops] + new_head + [conditional apply] (+ any trailing
     # non-optimizer ops that followed the optimizer tier)
-    tail = [
-        op
-        for i, op in enumerate(block.ops)
-        if i >= first_opt and i not in set(opt_idx)
-    ]
+    # LRSched-role ops (per-param LR scale from _create_param_lr) sit
+    # interleaved with the optimizer tier and produce the LearningRate vars
+    # the moved optimizer ops read — they must run BEFORE the conditional.
+    # Everything else non-Optimize stays after it.
+    lr_ops, tail = [], []
+    for i, op in enumerate(block.ops):
+        if i < first_opt or i in moved_set:
+            continue
+        role = int(op.attrs.get(OpRole.OP_ROLE_KEY, 0))
+        (lr_ops if role & OpRole.LRSched else tail).append(op)
     del block.ops[first_opt:]
+    block.ops.extend(lr_ops)
     for spec in new_head:
         block.append_op(**spec)
     block.append_op(**cond_spec)

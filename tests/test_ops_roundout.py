@@ -137,6 +137,33 @@ class TestTensorArrayToTensor(unittest.TestCase):
         # array is time-major [T, B, ...]; stack on axis 0 re-produces it
         np.testing.assert_allclose(got, np.swapaxes(data, 0, 1), rtol=1e-6)
 
+    def test_out_index_tracks_axis(self):
+        """OutIndex = per-slot extent along the concat axis (1s for stack) —
+        reference tensor_array_to_tensor_op.cc."""
+        # T=3 slots of shape [B=2, D=4]: concat extent is 2 on axis 0,
+        # 4 on axis 1; stack contributes 1 per slot
+        for axis, use_stack, want in [(0, False, 2), (1, False, 4), (1, True, 1)]:
+            main = framework.Program()
+            with fluid.program_guard(main, framework.Program()):
+                x = fluid.layers.data(name="tai_x", shape=[3, 4], dtype="float32")
+                arr = fluid.layers.control_flow.lod_tensor_to_array(x, None)
+                blk = main.global_block()
+                blk.create_var(name="tai_out", shape=None, dtype=None)
+                blk.create_var(name="tai_idx", shape=None, dtype=None)
+                blk.append_op(
+                    type="tensor_array_to_tensor",
+                    inputs={"X": [arr.name]},
+                    outputs={"Out": ["tai_out"], "OutIndex": ["tai_idx"]},
+                    attrs={"axis": axis, "use_stack": use_stack},
+                )
+            data = np.random.rand(2, 3, 4).astype("float32")
+            exe = Executor(fluid.CPUPlace())
+            with scope_guard(Scope()):
+                (idx,) = exe.run(
+                    main, feed={"tai_x": data}, fetch_list=["tai_idx"]
+                )
+            np.testing.assert_array_equal(idx, np.full(idx.shape, want))
+
 
 class TestRnnMemoryHelper(OpTest):
     def setUp(self):
@@ -258,9 +285,6 @@ class TestPrefetchAgainstPserver(unittest.TestCase):
             self.assertFalse(th.is_alive(), "pserver did not exit")
 
 
-if __name__ == "__main__":
-    unittest.main()
-
 
 class TestRpcRetryAndCollectiveGather(unittest.TestCase):
     def test_gather_from_two_servers(self):
@@ -320,3 +344,7 @@ class TestRpcRetryAndCollectiveGather(unittest.TestCase):
         srv2.start()
         got2 = client.async_get_var(ep, "t").result(timeout=30)
         np.testing.assert_allclose(got2, 2 * table)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -16,7 +16,7 @@ import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu import framework
-from paddle_tpu.executor import Executor, Scope, scope_guard
+from paddle_tpu.executor import Executor, Scope, global_scope, scope_guard
 from paddle_tpu.transpiler import (
     Bf16Transpiler,
     DistributeTranspiler,
@@ -421,7 +421,7 @@ class TestGradientMerge(unittest.TestCase):
     test_dist_mnist_batch_merge.py): k merged micro-batches of size b must
     update params like one step on the concatenated batch of size k*b."""
 
-    def _build(self, merge_k=None):
+    def _build(self, merge_k=None, optimizer="sgd"):
         main, startup = framework.Program(), framework.Program()
         with fluid.unique_name.guard():
             with fluid.program_guard(main, startup):
@@ -431,7 +431,10 @@ class TestGradientMerge(unittest.TestCase):
                 loss = fluid.layers.mean(
                     fluid.layers.square_error_cost(input=pred, label=y)
                 )
-                fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+                if optimizer == "adam":
+                    fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+                else:
+                    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
         if merge_k:
             from paddle_tpu.transpiler import gradient_merge_transpile
 
@@ -469,4 +472,87 @@ class TestGradientMerge(unittest.TestCase):
             exe.run(main_b, feed={"gm_x": xs, "gm_y": ys}, fetch_list=[])
             w_big = np.asarray(scope_b.find_var("fc_0.w_0"))
 
+        np.testing.assert_allclose(w_merged, w_big, rtol=1e-4, atol=1e-6)
+
+    def test_per_param_lr_scale_runs_before_apply(self):
+        """LRSched-role scale ops from _create_param_lr are interleaved with
+        the optimizer tier; they must be spliced BEFORE the conditional apply
+        block or the moved optimizer ops read an uncomputed LR var."""
+        rng = np.random.RandomState(3)
+        xs = rng.rand(4, 4).astype("float32")
+        ys = rng.rand(4, 1).astype("float32")
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="gm_x", shape=[4], dtype="float32")
+                y = fluid.layers.data(name="gm_y", shape=[1], dtype="float32")
+                pred = fluid.layers.fc(
+                    input=x,
+                    size=1,
+                    param_attr=fluid.ParamAttr(learning_rate=2.0),
+                )
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(input=pred, label=y)
+                )
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        from paddle_tpu.transpiler import gradient_merge_transpile
+
+        # k_steps=1 applies on the very first step — the failure mode is an
+        # optimizer op reading the per-param LR var before its scale op ran
+        gradient_merge_transpile(main, startup, 1)
+        exe = Executor(fluid.CPUPlace())
+        with scope_guard(Scope(seed=1)):
+            exe.run(startup)
+            w0 = np.asarray(global_scope().find_var("fc_0.w_0")).copy()
+            exe.run(main, feed={"gm_x": xs, "gm_y": ys}, fetch_list=[])
+            w1 = np.asarray(global_scope().find_var("fc_0.w_0"))
+        self.assertFalse(np.allclose(w1, w0))
+
+    def test_adam_beta_pow_advances_only_on_apply(self):
+        """_finish_update's Beta{1,2}Pow scale ops must live inside the
+        conditional apply block — advancing them every micro-step corrupts
+        Adam bias correction (advisor finding, round 1)."""
+        rng = np.random.RandomState(7)
+        xs = rng.rand(8, 4).astype("float32")
+        ys = rng.rand(8, 1).astype("float32")
+        beta1, beta2 = 0.9, 0.999
+
+        main_m, startup_m, _ = self._build(merge_k=2, optimizer="adam")
+        exe = Executor(fluid.CPUPlace())
+        scope_m = Scope(seed=1)
+        with scope_guard(scope_m):
+            exe.run(startup_m)
+            w0 = np.asarray(scope_m.find_var("fc_0.w_0")).copy()
+            b1p_name = next(
+                n
+                for n in scope_m.var_names()
+                if "beta1_pow_acc" in n and "fc_0.w_0" in n
+            )
+            b2p_name = b1p_name.replace("beta1", "beta2")
+            exe.run(main_m, feed={"gm_x": xs[:4], "gm_y": ys[:4]}, fetch_list=[])
+            # micro-step 1: no apply — param AND beta-pows untouched
+            np.testing.assert_allclose(
+                np.asarray(scope_m.find_var("fc_0.w_0")), w0
+            )
+            np.testing.assert_allclose(
+                np.asarray(scope_m.find_var(b1p_name)), [beta1], rtol=1e-6
+            )
+            exe.run(main_m, feed={"gm_x": xs[4:], "gm_y": ys[4:]}, fetch_list=[])
+            # micro-step 2: one apply — beta pows advanced exactly once
+            np.testing.assert_allclose(
+                np.asarray(scope_m.find_var(b1p_name)), [beta1**2], rtol=1e-6
+            )
+            np.testing.assert_allclose(
+                np.asarray(scope_m.find_var(b2p_name)), [beta2**2], rtol=1e-6
+            )
+            w_merged = np.asarray(scope_m.find_var("fc_0.w_0")).copy()
+        self.assertFalse(np.allclose(w_merged, w0))
+
+        # equivalence with one Adam step on the concatenated batch
+        main_b, startup_b, _ = self._build(optimizer="adam")
+        scope_b = Scope(seed=1)
+        with scope_guard(scope_b):
+            exe.run(startup_b)
+            exe.run(main_b, feed={"gm_x": xs, "gm_y": ys}, fetch_list=[])
+            w_big = np.asarray(scope_b.find_var("fc_0.w_0"))
         np.testing.assert_allclose(w_merged, w_big, rtol=1e-4, atol=1e-6)
