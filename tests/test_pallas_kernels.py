@@ -122,44 +122,20 @@ def test_transformer_use_flash_end_to_end():
     import paddle_tpu.fluid as fluid
     from paddle_tpu import framework
     from paddle_tpu.executor import Scope, scope_guard
-    from paddle_tpu.models.transformer import transformer
+    from paddle_tpu.models.transformer import (
+        build_tiny_flash_transformer,
+        tiny_flash_transformer_feed,
+    )
 
-    b, t, vocab = 2, 16, 50
     main, startup = framework.Program(), framework.Program()
     with fluid.unique_name.guard(), fluid.program_guard(main, startup):
-        feeds = {}
-        for name, shape, dtype in [
-            ("src_word", [t], "int64"),
-            ("src_pos", [t], "int64"),
-            ("trg_word", [t], "int64"),
-            ("trg_pos", [t], "int64"),
-            ("label", [t], "int64"),
-            ("label_weight", [t, 1], "float32"),
-        ]:
-            feeds[name] = fluid.layers.data(name=name, shape=shape, dtype=dtype)
-        loss = transformer(
-            feeds["src_word"], feeds["src_pos"], feeds["trg_word"],
-            feeds["trg_pos"], None, None, None,
-            feeds["label"], feeds["label_weight"],
-            src_vocab_size=vocab, trg_vocab_size=vocab,
-            n_layer=1, n_head=2, d_model=16, d_inner=32, d_key=8, d_value=8,
-            dropout=0.0, max_length=t + 1, use_flash=True,
-        )
-        loss = loss if not isinstance(loss, (list, tuple)) else loss[0]
+        feeds, loss = build_tiny_flash_transformer()
         fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
     assert any(
         op.type == "flash_attention" for op in main.global_block().ops
     ), "flash op not emitted"
 
-    rng = np.random.RandomState(5)
-    feed = {
-        "src_word": rng.randint(0, vocab, (b, t)).astype("int64"),
-        "src_pos": np.tile(np.arange(t), (b, 1)).astype("int64"),
-        "trg_word": rng.randint(0, vocab, (b, t)).astype("int64"),
-        "trg_pos": np.tile(np.arange(t), (b, 1)).astype("int64"),
-        "label": rng.randint(0, vocab, (b, t)).astype("int64"),
-        "label_weight": np.ones((b, t, 1), "float32"),
-    }
+    feed = tiny_flash_transformer_feed(b=2)
     exe = fluid.Executor(fluid.CPUPlace())
     losses = []
     with scope_guard(Scope(seed=0)):
