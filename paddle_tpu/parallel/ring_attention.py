@@ -13,6 +13,23 @@ K/V transfer overlaps compute around the ring.
 
 Causal masking uses global positions derived from each chunk's rank of
 origin (after i rotations a rank holds the chunk of rank (me - i) mod n).
+
+Two per-chunk compute tiers:
+
+- **flash** (default when tile shapes allow): each ring step runs the Pallas
+  flash-attention kernels (ops/pallas_kernels.py). The whole ring is one
+  custom_vjp: the forward merges per-chunk (out_i, lse_i) with the online
+  rescale and saves only (q, k, v, out, lse) per rank — O(t_local) memory;
+  the backward re-runs the ring with the flash dQ/dKV kernels against the
+  GLOBAL logsumexp (the flash-2 decomposition is exact per KV block, so
+  per-chunk backward with global lse sums to the full gradient) while dK/dV
+  accumulators rotate with their chunks and arrive home after n hops.
+  Causal chunk scheduling is static-per-step: step 0 is the diagonal
+  (causal kernel); later steps are fully-visible or fully-masked, selected
+  by one lax.cond (the masked branch does no FLOPs) — the causal ring does
+  ~half the work of the full ring.
+- **dense** fallback (ragged tiles): the original einsum online-softmax
+  steps differentiated by plain autodiff.
 """
 
 import functools
@@ -21,6 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops import pallas_kernels as pk
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
@@ -65,22 +84,199 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
     return o / jnp.maximum(l, 1e-20)[..., None]
 
 
-def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
+# ---------------------------------------------------------------------------
+# flash ring: whole-ring custom_vjp over the Pallas kernels
+# ---------------------------------------------------------------------------
+
+
+def _rot(x, axis_name, n):
+    return lax.ppermute(x, axis_name, [(j, (j + 1) % n) for j in range(n)])
+
+
+def _chunk_fwd(q, k_cur, v_cur, causal_diag, scale, interpret):
+    """One ring step's flash forward on (b, h, t_loc, d): (out, lse)."""
+    out, lse = pk._flash_forward(
+        q, k_cur, v_cur, causal_diag, scale,
+        pk._DEF_BLOCK_Q, pk._DEF_BLOCK_K, interpret, with_lse=True,
+    )
+    return out, lse
+
+
+def _chunk_bwd(q, k_cur, v_cur, out, lse, do, causal_diag, scale, interpret):
+    """One ring step's flash backward against the GLOBAL lse."""
+    return pk._flash_backward(
+        q, k_cur, v_cur, out, lse, do, causal_diag, scale,
+        pk._DEF_BLOCK_Q, pk._DEF_BLOCK_K, interpret,
+    )
+
+
+def _merge(acc, m, l, o_i, lse_i):
+    """Online merge of a normalized chunk (o_i, lse_i) into (acc, m, l)."""
+    m_new = jnp.maximum(m, lse_i)
+    alpha = jnp.exp(jnp.where(m == -jnp.inf, -jnp.inf, m - m_new))
+    w = jnp.exp(lse_i - m_new)
+    acc = acc * alpha[..., None] + o_i.astype(jnp.float32) * w[..., None]
+    l = l * alpha + w
+    return acc, m_new, l
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash_local(q, k, v, axis_name, causal, scale, interpret):
+    out, _lse = _ring_flash_fwd_pass(q, k, v, axis_name, causal, scale, interpret)
+    return out
+
+
+def _ring_flash_fwd_pass(q, k, v, axis_name, causal, scale, interpret):
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, h, t_loc, d = q.shape
+
+    acc = jnp.zeros((b, h, t_loc, d), jnp.float32)
+    m = jnp.full((b, h, t_loc), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, t_loc), jnp.float32)
+    k_cur, v_cur = k, v
+    for i in range(n):
+        if causal and i == 0:
+            # diagonal chunk: the only step needing an intra-chunk mask
+            o_i, lse_i = _chunk_fwd(q, k_cur, v_cur, True, scale, interpret)
+            acc, m, l = _merge(acc, m, l, o_i, lse_i)
+        elif causal:
+            # src = (me - i) % n: fully visible iff src < me, else fully
+            # masked — one cond, and the masked branch does no attention work
+            src = (me - i) % n
+
+            def _vis(args):
+                acc, m, l = args
+                o_i, lse_i = _chunk_fwd(q, k_cur, v_cur, False, scale, interpret)
+                return _merge(acc, m, l, o_i, lse_i)
+
+            acc, m, l = lax.cond(src < me, _vis, lambda args: args, (acc, m, l))
+        else:
+            o_i, lse_i = _chunk_fwd(q, k_cur, v_cur, False, scale, interpret)
+            acc, m, l = _merge(acc, m, l, o_i, lse_i)
+        if i + 1 < n:
+            k_cur = _rot(k_cur, axis_name, n)
+            v_cur = _rot(v_cur, axis_name, n)
+    out = (acc / jnp.maximum(l, 1e-20)[..., None]).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-20))
+    return out, lse
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, interpret):
+    out, lse = _ring_flash_fwd_pass(q, k, v, axis_name, causal, scale, interpret)
+    # O(t_local) residuals — no per-step K/V chunks, no (t, t) scores
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, res, do):
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    # dK/dV accumulators travel around the ring WITH their chunk and are
+    # home again after the n-th hop
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    k_cur, v_cur = k, v
+    for i in range(n):
+        if causal and i == 0:
+            dq_i, dk_i, dv_i = _chunk_bwd(
+                q, k_cur, v_cur, out, lse, do, True, scale, interpret
+            )
+            dq += dq_i
+            dk_acc += dk_i
+            dv_acc += dv_i
+        elif causal:
+            src = (me - i) % n
+
+            def _vis(args):
+                dq, dk_acc, dv_acc = args
+                dq_i, dk_i, dv_i = _chunk_bwd(
+                    q, k_cur, v_cur, out, lse, do, False, scale, interpret
+                )
+                return dq + dq_i, dk_acc + dk_i, dv_acc + dv_i
+
+            dq, dk_acc, dv_acc = lax.cond(
+                src < me, _vis, lambda args: args, (dq, dk_acc, dv_acc)
+            )
+        else:
+            dq_i, dk_i, dv_i = _chunk_bwd(
+                q, k_cur, v_cur, out, lse, do, False, scale, interpret
+            )
+            dq += dq_i
+            dk_acc += dk_i
+            dv_acc += dv_i
+        # accumulators rotate every step (incl. the last) to complete the
+        # full ring and land back on the owning rank; k/v are not needed
+        # after the last compute
+        if i + 1 < n:
+            k_cur = _rot(k_cur, axis_name, n)
+            v_cur = _rot(v_cur, axis_name, n)
+        dk_acc = _rot(dk_acc, axis_name, n)
+        dv_acc = _rot(dv_acc, axis_name, n)
+    return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+
+
+_ring_flash_local.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def _flash_tiles_ok(t_loc):
+    """Static predicate: the Pallas path needs whole q/k tiles — otherwise
+    _flash_forward would silently fall back to dense WITHOUT lse, which the
+    ring merge needs. (Head dim needs no gate: Mosaic pads sub-lane dims,
+    verified on-chip down to d=8.)"""
+    bq = min(pk._DEF_BLOCK_Q, t_loc)
+    bk = min(pk._DEF_BLOCK_K, t_loc)
+    return t_loc % bq == 0 and t_loc % bk == 0
+
+
+def ring_attention_sharded(
+    q, k, v, mesh, axis_name="sp", causal=False, scale=None, use_flash=None
+):
     """q,k,v: (b, h, t, d) GLOBAL arrays (sharded or shardable on t over
-    `axis_name`). Returns attention output with the same sharding."""
+    `axis_name`). Returns attention output with the same sharding.
+
+    use_flash: None = auto (Pallas ring when tile shapes allow), True/False
+    to force. The dense tier remains for ragged shards."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    n_sp = mesh.shape[axis_name]
+    t_loc = q.shape[2] // n_sp
+    if use_flash is None:
+        use_flash = _flash_tiles_ok(t_loc)
+    elif use_flash and not _flash_tiles_ok(t_loc):
+        raise ValueError(
+            "flash ring needs t_local %% block == 0 (t_local=%d); "
+            "pass use_flash=False for the dense ring" % t_loc
+        )
     # batch rides the dp axis when the mesh has one (degrade gracefully on
     # sp-only meshes, matching sharded_embedding_lookup's guard)
     batch_axes = ("dp",) if "dp" in mesh.shape else None
     spec = P(batch_axes, None, (axis_name,), None)
-    fn = jax.shard_map(
-        functools.partial(
+    if use_flash:
+        # shared defaulting rule with the flash kernels (fwd/bwd must agree)
+        scale, interpret = pk._resolve_defaults(q, scale, None)
+
+        # positional call: custom_vjp nondiff_argnums are position-based
+        def local(q, k, v):
+            return _ring_flash_local(
+                q, k, v, axis_name, causal, scale, interpret
+            )
+    else:
+        local = functools.partial(
             _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
-        ),
+        )
+    fn = jax.shard_map(
+        local,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # flash tier only: pallas_call out_shapes carry no varying-mesh-axes
+        # annotation, which the vma checker requires; collective correctness
+        # there is covered by the ring-vs-dense forward/grad tests. The dense
+        # tier keeps the checker.
+        check_vma=not use_flash,
     )
     return fn(q, k, v)
 

