@@ -24,7 +24,7 @@ from jax.experimental import pallas as pl
 
 from .registry import register
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_tiles_ok"]
 
 _DEF_BLOCK_Q = 128
 _DEF_BLOCK_K = 128
@@ -107,17 +107,29 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k, causal,
         ).astype(lse_ref.dtype)
 
 
+def flash_tiles_ok(t, block=None):
+    """Public predicate for _flash_forward's whole-tile condition: callers
+    that REQUIRE the Pallas path (e.g. the flash ring, whose merge needs the
+    lse the dense fallback doesn't produce) gate on this so the rule lives in
+    one place with the fallback check below."""
+    if t <= 0:
+        return False
+    bq = min(block or _DEF_BLOCK_Q, t)
+    bk = min(block or _DEF_BLOCK_K, t)
+    return t % bq == 0 and t % bk == 0
+
+
 def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                    with_lse=False):
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
-    if tq % block_q or tk % block_k:
+    if not (flash_tiles_ok(tq, block_q) and flash_tiles_ok(tk, block_k)):
         # ragged tails: fall back to the dense form (shapes are static, so
         # this is a trace-time decision, not a runtime branch)
         out = _attention_reference(q, k, v, causal, sm_scale)
         return (out, None) if with_lse else out
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
     q3 = q.reshape(b * h, tq, d)
     k3 = k.reshape(b * h, tk, d)
     v3 = v.reshape(b * h, tk, d)

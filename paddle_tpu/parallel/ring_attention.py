@@ -221,14 +221,11 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, res, do):
 _ring_flash_local.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
-def _flash_tiles_ok(t_loc):
-    """Static predicate: the Pallas path needs whole q/k tiles — otherwise
-    _flash_forward would silently fall back to dense WITHOUT lse, which the
-    ring merge needs. (Head dim needs no gate: Mosaic pads sub-lane dims,
-    verified on-chip down to d=8.)"""
-    bq = min(pk._DEF_BLOCK_Q, t_loc)
-    bk = min(pk._DEF_BLOCK_K, t_loc)
-    return t_loc % bq == 0 and t_loc % bk == 0
+# the Pallas path needs whole q/k tiles — otherwise _flash_forward would
+# silently fall back to dense WITHOUT lse, which the ring merge needs; the
+# rule lives in pallas_kernels.flash_tiles_ok. (Head dim needs no gate:
+# Mosaic pads sub-lane dims, verified on-chip down to d=8.)
+_flash_tiles_ok = pk.flash_tiles_ok
 
 
 def ring_attention_sharded(
@@ -242,6 +239,11 @@ def ring_attention_sharded(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n_sp = mesh.shape[axis_name]
+    if q.shape[2] % n_sp:
+        raise ValueError(
+            "sequence length %d not divisible by the %r axis size %d"
+            % (q.shape[2], axis_name, n_sp)
+        )
     t_loc = q.shape[2] // n_sp
     if use_flash is None:
         use_flash = _flash_tiles_ok(t_loc)

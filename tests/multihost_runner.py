@@ -1,0 +1,83 @@
+"""Multi-host collective-DP runner (one process = one "host").
+
+Launched by test_multihost.py as N subprocesses, each given
+XLA_FLAGS=--xla_force_host_platform_device_count=K so the global mesh spans
+N*K devices over the jax.distributed DCN analog (gloo on CPU). Mirrors the
+reference's NCCL2 multi-node trainer (test_dist_base.py:423
+_run_cluster_nccl2): same model on every process, collective gradient
+exchange, losses printed for the parent to compare.
+
+Role of env vars: PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINER_ID drive
+paddle_tpu.parallel.multihost.init_distributed's fluid-style defaulting —
+the same contract the reference transpiler mode used (SURVEY.md §3.4).
+"""
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def build_model():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--single_process", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if not args.single_process:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        from paddle_tpu.parallel.multihost import init_distributed
+
+        # endpoints/id come from PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINER_ID
+        init_distributed()
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+
+    main_prog, startup, loss = build_model()
+    devices = jax.devices()
+    print("DEVICES %d local %d" % (len(devices), jax.local_device_count()),
+          flush=True)
+
+    rng = np.random.RandomState(7)
+    W = rng.rand(8, 1).astype("float32")
+    batches = []
+    for _ in range(args.steps):
+        xb = rng.rand(16, 8).astype("float32")
+        batches.append((xb, xb @ W))
+
+    losses = []
+    with scope_guard(Scope(seed=11)):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main_prog, devices=devices
+        )
+        for xb, yb in batches:
+            (lv,) = pe.run(fetch_list=[loss.name], feed={"x": xb, "y": yb})
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,12 +1,59 @@
 """ParallelExecutor correctness = convergence equivalence with the plain
 Executor (reference unittests/parallel_executor_test_base.py
-check_network_convergence), run on the 8-device virtual CPU mesh."""
+check_network_convergence), run on the 8-device virtual CPU mesh.
+
+Instantiated across the reference's model family (SURVEY.md §4.3):
+MLP (test_parallel_executor_mnist analog), SE-ResNeXt
+(_seresnext), Transformer (_transformer), CRF (_crf), and a
+bounded-While training case (test_parallel_executor_test_while_train)."""
 
 import numpy as np
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu import framework
 from paddle_tpu.executor import Scope, scope_guard
+
+
+def _check_convergence(build_fn, batches, optimizer_fn, rtol=2e-3, atol=2e-4,
+                       seed=3, require_decrease=True):
+    """Train the same model+data twice — plain Executor vs ParallelExecutor
+    over the 8-device mesh — and require identical loss trajectories
+    (reference check_network_convergence contract)."""
+
+    def train(use_pe):
+        main = framework.Program()
+        startup = framework.Program()
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                loss, feed_names = build_fn()
+                optimizer_fn().minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        losses = []
+        with scope_guard(Scope(seed=seed)):
+            exe.run(startup)
+            pe = (
+                fluid.ParallelExecutor(
+                    use_cuda=False, loss_name=loss.name, main_program=main
+                )
+                if use_pe
+                else None
+            )
+            for batch in batches:
+                feed = dict(zip(feed_names, batch))
+                if use_pe:
+                    (l,) = pe.run(fetch_list=[loss.name], feed=feed)
+                else:
+                    (l,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+                losses.append(float(np.asarray(l).reshape(-1)[0]))
+        return losses
+
+    single = train(False)
+    multi = train(True)
+    np.testing.assert_allclose(single, multi, rtol=rtol, atol=atol)
+    assert np.isfinite(multi).all()
+    if require_decrease:
+        assert multi[-1] < multi[0], multi
+    return multi
 
 
 def build_model():
@@ -82,3 +129,159 @@ def test_pe_rejects_indivisible_batch():
                 raise AssertionError("expected ValueError for indivisible batch")
             except ValueError:
                 pass
+
+
+def test_pe_se_resnext_convergence():
+    """reference test_parallel_executor_seresnext.py: tiny structurally-exact
+    SE-ResNeXt instance (conv/bn/group-conv/SE blocks) under PE."""
+    from paddle_tpu.models.se_resnext import SE_ResNeXt
+
+    def build():
+        img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        net = SE_ResNeXt(
+            depth_override=[1, 1, 1, 1], filters_override=[32, 32, 32, 32]
+        )
+        logits = net.net(img, class_dim=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label)
+        )
+        return loss, ["img", "label"]
+
+    rng = np.random.RandomState(1)
+    batches = [
+        (
+            rng.randn(8, 3, 32, 32).astype("float32"),
+            rng.randint(0, 4, (8, 1)).astype("int64"),
+        )
+        for _ in range(3)
+    ]
+    _check_convergence(
+        build,
+        batches,
+        lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9),
+        rtol=5e-3,
+        atol=5e-4,
+        require_decrease=False,  # 3 steps: equivalence is the contract here
+    )
+
+
+def test_pe_transformer_convergence():
+    """reference test_parallel_executor_transformer.py: the dense transformer
+    (encoder+decoder stacks) trains identically under PE."""
+    from paddle_tpu.models.transformer import transformer
+
+    t, vocab = 8, 32
+
+    def build():
+        feeds = {}
+        for name, shape, dtype in [
+            ("src_word", [t], "int64"),
+            ("src_pos", [t], "int64"),
+            ("trg_word", [t], "int64"),
+            ("trg_pos", [t], "int64"),
+            ("lbl", [t], "int64"),
+            ("lbl_w", [t, 1], "float32"),
+        ]:
+            feeds[name] = fluid.layers.data(name=name, shape=shape, dtype=dtype)
+        loss, _logits = transformer(
+            feeds["src_word"], feeds["src_pos"], feeds["trg_word"],
+            feeds["trg_pos"], None, None, None, feeds["lbl"], feeds["lbl_w"],
+            src_vocab_size=vocab, trg_vocab_size=vocab,
+            n_layer=1, n_head=2, d_model=16, d_inner=32, d_key=8, d_value=8,
+            dropout=0.0, max_length=t + 1,
+        )
+        return loss, ["src_word", "src_pos", "trg_word", "trg_pos", "lbl", "lbl_w"]
+
+    rng = np.random.RandomState(2)
+    pos = np.tile(np.arange(t), (8, 1)).astype("int64")
+    batches = [
+        (
+            rng.randint(0, vocab, (8, t)).astype("int64"), pos,
+            rng.randint(0, vocab, (8, t)).astype("int64"), pos,
+            rng.randint(0, vocab, (8, t)).astype("int64"),
+            np.ones((8, t, 1), "float32"),
+        )
+        for _ in range(5)
+    ]
+    _check_convergence(
+        build, batches, lambda: fluid.optimizer.Adam(learning_rate=0.01),
+        rtol=5e-3, atol=5e-4,
+    )
+
+
+def test_pe_crf_convergence():
+    """reference test_parallel_executor_crf.py: embedding + GRU + linear-chain
+    CRF (the label-semantic-roles shape) trains identically under PE."""
+    V, TAGS, T = 24, 4, 6
+
+    def build():
+        words = fluid.layers.data(
+            name="words", shape=[-1, T, 1], dtype="int64", append_batch_size=False
+        )
+        tags = fluid.layers.data(
+            name="tags", shape=[-1, T, 1], dtype="int64", append_batch_size=False
+        )
+        wlen = fluid.layers.data(
+            name="wlen", shape=[-1], dtype="int64", append_batch_size=False
+        )
+        emb = fluid.layers.embedding(words, size=[V, 8])
+        emb._len_name = "wlen"
+        proj = fluid.layers.fc(emb, size=12 * 3, num_flatten_dims=2)
+        proj._len_name = "wlen"
+        gru = fluid.layers.dynamic_gru(proj, size=12)
+        emission = fluid.layers.fc(gru, size=TAGS, num_flatten_dims=2)
+        emission._len_name = "wlen"
+        crf_cost = fluid.layers.linear_chain_crf(
+            emission, tags, param_attr=fluid.ParamAttr(name="crfw")
+        )
+        loss = fluid.layers.mean(crf_cost)
+        return loss, ["words", "tags", "wlen"]
+
+    rng = np.random.RandomState(3)
+    batches = []
+    for _ in range(5):
+        ws = rng.randint(0, V, (8, T, 1)).astype("int64")
+        batches.append(
+            (ws, (ws % TAGS).astype("int64"),
+             rng.randint(2, T + 1, (8,)).astype("int64"))
+        )
+    _check_convergence(
+        build, batches, lambda: fluid.optimizer.Adam(learning_rate=0.02),
+        rtol=5e-3, atol=5e-4,
+    )
+
+
+def test_pe_while_train_convergence():
+    """reference test_parallel_executor_test_while_train: the forward pass
+    contains a bounded While (lowered to the differentiable masked scan), and
+    training through it matches single-device under PE."""
+    T = 3
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        n = fluid.layers.fill_constant([1], "int64", T)
+        acc = fluid.layers.fc(h, size=8)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond, maximum_iterations=T)
+        with w.block():
+            nxt = fluid.layers.scale(acc, scale=0.5)
+            fluid.layers.assign(nxt, acc)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+        pred = fluid.layers.fc(acc, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        return loss, ["x", "y"]
+
+    rng = np.random.RandomState(4)
+    W = rng.rand(8, 1).astype("float32")
+    batches = []
+    for _ in range(8):
+        xb = rng.rand(16, 8).astype("float32")
+        batches.append((xb, xb @ W))
+    _check_convergence(
+        build, batches, lambda: fluid.optimizer.SGD(learning_rate=0.1)
+    )
