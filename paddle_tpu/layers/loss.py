@@ -6,6 +6,8 @@ rank_loss, edit_distance, sampling_id, huber_loss).
 Sequence arguments follow the padded-dense + `<name>@LEN` companion
 convention (layers/sequence.py); the reference used LoD tensors."""
 
+import numpy as np
+
 from ..framework import Variable
 from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
@@ -142,11 +144,11 @@ def nce(
     seed=0,
     is_sparse=False,
 ):
-    """Noise-contrastive estimation (reference layers/nn.py nce → nce_op.cc)."""
-    if custom_dist is not None:
-        raise NotImplementedError("nce custom_dist sampler is not supported")
-    if sample_weight is not None:
-        raise NotImplementedError("nce sample_weight is not supported")
+    """Noise-contrastive estimation (reference layers/nn.py nce → nce_op.cc).
+
+    custom_dist: list/array of num_total_classes sampling probabilities
+    (reference sampler=2 CustomSampler); sample_weight: (batch, 1) Variable
+    scaling each row's cost (reference nce_op.h:159)."""
     helper = LayerHelper("nce", **locals())
     dim = input.shape[-1]
     num_neg_samples = int(num_neg_samples or 10)
@@ -156,6 +158,29 @@ def nce(
         dtype=input.dtype,
     )
     inputs = {"Input": [input.name], "Label": [label.name], "Weight": [w.name]}
+    # reference nn.py nce contract: custom_dist and sampler="custom_dist"
+    # come together; custom_dist does not silently override another sampler
+    if (custom_dist is not None) and sampler not in ("uniform", "custom_dist"):
+        raise ValueError(
+            "custom_dist conflicts with sampler=%r; pass "
+            "sampler='custom_dist' (or leave the default)" % sampler
+        )
+    if sampler == "custom_dist" and custom_dist is None:
+        raise ValueError("sampler='custom_dist' requires custom_dist")
+    if custom_dist is not None:
+        from .tensor import assign
+
+        dist = np.asarray(custom_dist, dtype="float32").reshape(-1)
+        if dist.shape[0] != num_total_classes:
+            raise ValueError(
+                "custom_dist must have num_total_classes=%d entries, got %d"
+                % (num_total_classes, dist.shape[0])
+            )
+        probs = assign(dist)
+        inputs["CustomDistProbs"] = [probs.name]
+        sampler = "custom_dist"
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight.name]
     if not (bias_attr is False):
         b = helper.create_parameter(
             attr=helper.bias_attr,

@@ -68,11 +68,13 @@ def dynamic_lstm(
     name=None,
 ):
     """reference layers/nn.py dynamic_lstm → lstm op. `input` is the fc
-    projection (b, t, 4*hidden); returns (hidden, cell) sequences."""
-    if h_0 is not None or c_0 is not None:
-        raise NotImplementedError(
-            "dynamic_lstm h_0/c_0 initial state lands with the seq2seq tier; "
-            "zeros are used today"
+    projection (b, t, 4*hidden); returns (hidden, cell) sequences. h_0/c_0
+    are optional (batch, hidden) warm-start states (reference nn.py:362: both
+    must be given together)."""
+    if (h_0 is None) != (c_0 is None):
+        raise ValueError(
+            "dynamic_lstm needs h_0 and c_0 together (reference layers/nn.py "
+            "dynamic_lstm contract)"
         )
     helper = LayerHelper("lstm", **locals())
     hidden_size = size // 4
@@ -85,14 +87,18 @@ def dynamic_lstm(
     )
     hidden = helper.create_variable_for_type_inference(dtype)
     cell = helper.create_variable_for_type_inference(dtype)
+    inputs = {
+        "Input": [input.name],
+        "Weight": [weight.name],
+        "Bias": [bias.name],
+        "SeqLen": [seq_len_of(input)],
+    }
+    if h_0 is not None:
+        inputs["H0"] = [h_0.name]
+        inputs["C0"] = [c_0.name]
     helper.append_op(
         type="dynamic_lstm",
-        inputs={
-            "Input": [input.name],
-            "Weight": [weight.name],
-            "Bias": [bias.name],
-            "SeqLen": [seq_len_of(input)],
-        },
+        inputs=inputs,
         outputs={"Hidden": [hidden.name], "Cell": [cell.name]},
         attrs={
             "use_peepholes": use_peepholes,
@@ -118,11 +124,6 @@ def dynamic_gru(
     h_0=None,
     name=None,
 ):
-    if h_0 is not None:
-        raise NotImplementedError(
-            "dynamic_gru h_0 initial state lands with the seq2seq tier; "
-            "zeros are used today"
-        )
     helper = LayerHelper("gru", **locals())
     dtype = input.dtype
     weight = helper.create_parameter(
@@ -132,14 +133,18 @@ def dynamic_gru(
         attr=helper.bias_attr, shape=[1, 3 * size], dtype=dtype, is_bias=True
     )
     hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {
+        "Input": [input.name],
+        "Weight": [weight.name],
+        "Bias": [bias.name],
+        "SeqLen": [seq_len_of(input)],
+    }
+    if h_0 is not None:
+        # (batch, hidden) warm-start state (reference layers/nn.py:453)
+        inputs["H0"] = [h_0.name]
     helper.append_op(
         type="dynamic_gru",
-        inputs={
-            "Input": [input.name],
-            "Weight": [weight.name],
-            "Bias": [bias.name],
-            "SeqLen": [seq_len_of(input)],
-        },
+        inputs=inputs,
         outputs={"Hidden": [hidden.name]},
         attrs={
             "is_reverse": is_reverse,
@@ -454,27 +459,34 @@ def im2sequence(
     name=None,
 ):
     """Image → patch sequence (reference layers/nn.py im2sequence →
-    im2sequence_op.cc). Output rows all share length out_h*out_w, emitted as
-    a fill_constant_batch_size_like companion."""
+    im2sequence_op.cc). Without input_image_size, output rows all share
+    length out_h*out_w (emitted as a fill_constant_batch_size_like
+    companion). With input_image_size — a (batch, 2) tensor of per-image
+    (real_h, real_w) — each row's valid length follows the reference's
+    real-size formula (im2sequence_op.h:52-110) via ceil(real/out_stride),
+    and the op emits the ragged lengths itself."""
     from .nn import _pair
     from .tensor import fill_constant_batch_size_like
 
-    if input_image_size is not None or out_stride != 1:
-        raise NotImplementedError(
-            "im2sequence per-image real sizes (input_image_size/out_stride) "
-            "are not supported; patch geometry is static under XLA"
-        )
     helper = LayerHelper("im2sequence", **locals())
     kernels = _pair(filter_size)
     strides = _pair(stride)
     pads = padding if isinstance(padding, (list, tuple)) and len(padding) == 4 else _pair(padding) * 2
     out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input.name]}
+    outputs = {"Out": [out.name]}
+    attrs = {"kernels": kernels, "strides": strides, "paddings": list(pads)}
+    if input_image_size is not None:
+        inputs["Y"] = [input_image_size.name]
+        attrs["out_stride"] = _pair(out_stride)
+        out_len = helper.create_variable_for_type_inference("int32")
+        outputs["OutLen"] = [out_len.name]
     helper.append_op(
-        type="im2sequence",
-        inputs={"X": [input.name]},
-        outputs={"Out": [out.name]},
-        attrs={"kernels": kernels, "strides": strides, "paddings": list(pads)},
+        type="im2sequence", inputs=inputs, outputs=outputs, attrs=attrs
     )
+    if input_image_size is not None:
+        out._len_name = out_len.name
+        return out
     h, w = input.shape[2], input.shape[3]
     oh = (h + pads[0] + pads[2] - kernels[0]) // strides[0] + 1
     ow = (w + pads[1] + pads[3] - kernels[1]) // strides[1] + 1

@@ -252,23 +252,31 @@ def _log_uniform_probs(C):
     return (jnp.log(k + 2.0) - jnp.log(k + 1.0)) / jnp.log(C + 1.0)
 
 
-def _draw_samples(key, sampler, C, S):
+def _draw_samples(key, sampler, C, S, probs=None):
     if sampler == "log_uniform":
         u = jax.random.uniform(key, (S,))
         # inverse CDF of P(k) ∝ log((k+2)/(k+1)): k = floor((C+1)^u) - 1
         s = jnp.floor(jnp.exp(u * jnp.log(C + 1.0))).astype(jnp.int32) - 1
         return jnp.clip(s, 0, C - 1)
+    if sampler == "custom_dist":
+        # categorical over the user distribution (the reference's alias-table
+        # CustomSampler is a CPU sampling trick; the distribution is probs)
+        return jax.random.categorical(key, jnp.log(probs + 1e-20), shape=(S,)).astype(
+            jnp.int32
+        )
     return jax.random.randint(key, (S,), 0, C)
 
 
 @register("nce", stochastic=True)
 def _nce(ctx, ins, attrs):
     """NCE logistic loss with shared negative samples (reference nce_op.h:
-    uniform or log-uniform ("custom_dist" unsupported) sampler)."""
+    uniform, log-uniform, or custom_dist sampler; optional per-row
+    SampleWeight scaling the cost, nce_op.h:159)."""
     (x,) = ins["Input"]  # [B, D]
     (label,) = ins["Label"]  # [B, num_true]
     (w,) = ins["Weight"]  # [C, D]
     bias = ins.get("Bias", [None])[0]  # [C]
+    sample_weight = ins.get("SampleWeight", [None])[0]  # [B]
     C = int(attrs["num_total_classes"])
     S = int(attrs.get("num_neg_samples", 10))
     sampler = attrs.get("sampler", "uniform")
@@ -278,10 +286,14 @@ def _nce(ctx, ins, attrs):
 
     if sampler == "log_uniform":
         probs = _log_uniform_probs(C)
+    elif sampler == "custom_dist":
+        (probs,) = ins["CustomDistProbs"]
+        probs = probs.reshape(-1).astype(jnp.float32)
+        probs = probs / jnp.sum(probs)
     else:
         probs = jnp.full((C,), 1.0 / C)
 
-    neg = _draw_samples(ctx.next_rng(), sampler, C, S)  # [S]
+    neg = _draw_samples(ctx.next_rng(), sampler, C, S, probs)  # [S]
 
     # gather only the sampled rows of W — never the full [B, C] logits
     pos_logit = jnp.einsum("bd,btd->bt", x, w[label])  # [B, num_true]
@@ -296,6 +308,8 @@ def _nce(ctx, ins, attrs):
     cost = jnp.sum(_softplus(-pos_adj), axis=1) / num_true + jnp.sum(
         _softplus(neg_adj), axis=1
     )
+    if sample_weight is not None:
+        cost = cost * sample_weight.reshape(B).astype(cost.dtype)
     return {
         "Cost": [cost.reshape(B, 1)],
         "SampleLogits": [jnp.concatenate([pos_adj, neg_adj], axis=1)],

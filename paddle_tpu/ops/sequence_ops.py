@@ -506,7 +506,15 @@ def _sequence_enumerate(ctx, ins, attrs):
 @register("im2sequence")
 def _im2sequence(ctx, ins, attrs):
     """Image → patch sequence (reference im2sequence_op.cc): each output row
-    is the flattened kernel window, row-major over (out_h, out_w)."""
+    is the flattened kernel window, row-major over (out_h, out_w).
+
+    Real-size mode (reference im2sequence_op.h:52-110): with Y holding per-
+    image (real_h, real_w) and the out_stride attr, each image keeps only its
+    top-left oh_i×ow_i patch sub-grid where oh_i/ow_i derive from
+    ceil(real/out_stride) through the output-size formula. Padded-dense
+    analog: the static full grid is computed, each row's valid sub-grid is
+    compacted to a row-major prefix by gather, the tail is zeroed, and the
+    per-row lengths are emitted as OutLen (the LoD companion)."""
     (x,) = ins["X"]  # [B, C, H, W]
     kh, kw = [int(k) for k in attrs["kernels"]]
     sh, sw = [int(s) for s in attrs.get("strides", [1, 1])]
@@ -519,7 +527,27 @@ def _im2sequence(ctx, ins, attrs):
     )  # [B, C*kh*kw, OH, OW]
     b, ckk, oh, ow = patches.shape
     out = jnp.moveaxis(patches.reshape(b, ckk, oh * ow), 1, 2)
-    return {"Out": [out]}
+    y = ins.get("Y", [None])[0]
+    if y is None:
+        return {"Out": [out]}
+
+    osh, osw = [int(s) for s in attrs.get("out_stride", [1, 1])]
+    real = y.reshape(b, 2).astype(jnp.int32)
+    # reference: ceil-divide real sizes by out_stride, then the standard
+    # output-size formula per image, clamped to the static grid
+    rh = -(-real[:, 0] // osh)
+    rw = -(-real[:, 1] // osw)
+    oh_i = jnp.clip((rh + pads[0] + pads[2] - kh) // sh + 1, 0, oh)
+    ow_i = jnp.clip((rw + pads[1] + pads[3] - kw) // sw + 1, 0, ow)
+    lens = (oh_i * ow_i).astype(jnp.int32)
+    p = jnp.arange(oh * ow, dtype=jnp.int32)[None, :]  # (1, OH*OW)
+    ow_safe = jnp.maximum(ow_i, 1)[:, None]
+    src = jnp.where(
+        p < lens[:, None], (p // ow_safe) * ow + p % ow_safe, p
+    )
+    out = jnp.take_along_axis(out, src[..., None], axis=1)
+    out = out * (p < lens[:, None])[..., None].astype(out.dtype)
+    return {"Out": [out], "OutLen": [lens]}
 
 
 @register("row_conv")

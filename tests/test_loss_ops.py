@@ -269,6 +269,53 @@ def test_nce_trains():
     assert np.isfinite(losses).all()
 
 
+def test_nce_custom_dist_and_sample_weight():
+    """custom_dist (reference sampler=2 CustomSampler) draws negatives from
+    the user distribution; sample_weight scales each row's cost
+    (nce_op.h:159 — zero-weight rows contribute exactly zero)."""
+    rng = np.random.RandomState(3)
+    B, D, C = 6, 8, 20
+    dist = rng.rand(C) + 0.1
+    dist /= dist.sum()
+
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        sw = fluid.layers.data(name="sw", shape=[1], dtype="float32")
+        cost = fluid.layers.nce(
+            input=x, label=y, num_total_classes=C, num_neg_samples=4,
+            custom_dist=list(dist), sample_weight=sw,
+        )
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    assert any(op.type == "nce" and op.attrs.get("sampler") == "custom_dist"
+               for op in main.global_block().ops)
+
+    xs = rng.randn(B, D).astype("float32")
+    ys = rng.randint(0, C, (B, 1)).astype("int64")
+    sws = np.ones((B, 1), "float32")
+    sws[0] = 0.0  # first row masked out of the loss
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (cv,) = exe.run(
+            main, feed={"x": xs, "y": ys, "sw": sws}, fetch_list=[cost.name]
+        )
+        cv = np.asarray(cv).reshape(-1)
+        assert cv[0] == 0.0, cv
+        assert (cv[1:] > 0).all(), cv
+        losses = [
+            float(np.asarray(exe.run(
+                main, feed={"x": xs, "y": ys, "sw": np.ones((B, 1), "float32")},
+                fetch_list=[loss.name])[0]).reshape(()))
+            for _ in range(60)
+        ]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-15:]) < np.mean(losses[:15])
+
+
 def test_hsigmoid_matches_manual():
     """C=4 complete tree: path of label l is the bits of l+4."""
     rng = np.random.RandomState(2)

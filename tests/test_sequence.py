@@ -91,6 +91,132 @@ def test_dynamic_lstm_masks_and_shapes():
     assert np.abs(out[1]).sum() > 0
 
 
+def test_dynamic_lstm_gru_initial_states():
+    """h_0/c_0 warm start (reference layers/nn.py:362,453): the first step
+    must read the supplied states, and a zero initial state must reproduce
+    the default path exactly."""
+    rng = np.random.RandomState(5)
+    b, t, h = 2, 4, 3
+
+    def build(kind, with_init):
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(
+                name="x",
+                shape=[-1, t, (4 if kind == "lstm" else 3) * h],
+                dtype="float32",
+                append_batch_size=False,
+            )
+            x._len_name = "len"
+            fluid.layers.data(
+                name="len", shape=[-1], dtype="int32", append_batch_size=False
+            )
+            kw = {}
+            if with_init:
+                h0 = fluid.layers.data(
+                    name="h0", shape=[-1, h], dtype="float32",
+                    append_batch_size=False,
+                )
+                if kind == "lstm":
+                    c0 = fluid.layers.data(
+                        name="c0", shape=[-1, h], dtype="float32",
+                        append_batch_size=False,
+                    )
+                    kw = {"h_0": h0, "c_0": c0}
+                else:
+                    kw = {"h_0": h0}
+            if kind == "lstm":
+                out, _cell = fluid.layers.dynamic_lstm(
+                    x, size=4 * h, use_peepholes=False, **kw
+                )
+            else:
+                out = fluid.layers.dynamic_gru(x, size=h, **kw)
+        return main, startup, out
+
+    for kind in ("lstm", "gru"):
+        gmul = 4 if kind == "lstm" else 3
+        x = rng.randn(b, t, gmul * h).astype("float32")
+        lens = np.asarray([t, t - 1], "int32")
+        h0 = rng.randn(b, h).astype("float32")
+        c0 = rng.randn(b, h).astype("float32")
+
+        def run(with_init, h0v, c0v):
+            main, startup, out = build(kind, with_init)
+            exe = fluid.Executor()
+            with scope_guard(Scope(seed=1)):
+                exe.run(startup)
+                feed = {"x": x, "len": lens}
+                if with_init:
+                    feed["h0"] = h0v
+                    if kind == "lstm":
+                        feed["c0"] = c0v
+                (o,) = exe.run(main, feed=feed, fetch_list=[out.name])
+            return np.asarray(o)
+
+        default = run(False, None, None)
+        zeros = run(True, np.zeros((b, h), "f4"), np.zeros((b, h), "f4"))
+        np.testing.assert_allclose(zeros, default, rtol=1e-6, atol=1e-7)
+        warm = run(True, h0, c0)
+        assert not np.allclose(warm, default), kind
+
+    # lstm contract: h_0 and c_0 must come together
+    import pytest
+
+    with pytest.raises(ValueError):
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(
+                name="x", shape=[-1, t, 4 * h], dtype="float32",
+                append_batch_size=False,
+            )
+            x._len_name = "len"
+            fluid.layers.data(
+                name="len", shape=[-1], dtype="int32", append_batch_size=False
+            )
+            h0 = fluid.layers.data(
+                name="h0", shape=[-1, h], dtype="float32", append_batch_size=False
+            )
+            fluid.layers.dynamic_lstm(x, size=4 * h, h_0=h0)
+
+
+def test_im2sequence_real_size_mode():
+    """input_image_size/out_stride (reference im2sequence_op.h:52-110): each
+    image keeps its top-left sub-grid of patches, compacted to a prefix with
+    the ragged lengths emitted by the op."""
+    rng = np.random.RandomState(6)
+    b, c, H, W = 2, 1, 6, 6
+    imgs = rng.randn(b, c, H, W).astype("float32")
+    # full grid with 2x2 kernel stride 2: 3x3 = 9 patches
+    real = np.asarray([[6, 6], [4, 2]], "float32")  # img1 full, img2 2x1 grid
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(
+            name="x", shape=[-1, c, H, W], dtype="float32",
+            append_batch_size=False,
+        )
+        y = fluid.layers.data(
+            name="y", shape=[-1, 2], dtype="float32", append_batch_size=False
+        )
+        out = fluid.layers.im2sequence(
+            x, filter_size=2, stride=2, input_image_size=y
+        )
+        len_name = out._len_name
+    exe = fluid.Executor()
+    with scope_guard(Scope()):
+        got, lens = exe.run(
+            main, feed={"x": imgs, "y": real}, fetch_list=[out.name, len_name]
+        )
+    got, lens = np.asarray(got), np.asarray(lens)
+    assert got.shape == (b, 9, c * 4)
+    np.testing.assert_array_equal(lens, [9, 2])
+    # image 2's valid prefix = its top-left 2x1 patch sub-grid
+    patches = imgs[1].reshape(c, 3, 2, 3, 2).transpose(1, 3, 0, 2, 4).reshape(9, -1)
+    np.testing.assert_allclose(got[1, 0], patches[0], rtol=1e-6)
+    np.testing.assert_allclose(got[1, 1], patches[3], rtol=1e-6)  # row 1, col 0
+    np.testing.assert_allclose(got[1, 2:], 0.0, atol=1e-7)
+
+
 def test_stacked_lstm_text_classification_converges():
     from paddle_tpu.models.stacked_lstm import stacked_lstm_net
 
