@@ -35,15 +35,22 @@ def build(batch_size):
     return main, startup, loss
 
 
-def run(batch_size=256, steps=20, warmup=3, n_staged=4, bf16=True):
+def run(batch_size=256, steps=20, warmup=3, n_staged=4, bf16=True,
+        measure_pipeline=True):
     """Synthetic-data throughput, like the reference harness's fake-data mode
     (benchmark/fluid/fluid_benchmark.py): batches are staged on device once and
-    cycled, so the number measures the training step, not this environment's
+    cycled, so the headline measures the training step, not this environment's
     host->device tunnel (which is not representative of TPU host bandwidth —
-    the real input path is the data layer's async prefetch)."""
+    the real input path is the data layer's async prefetch).
+
+    With measure_pipeline, a second pass feeds through PyReader — host batches
+    staged to device by the feeder thread overlapping compute (the real train-
+    loop input path, reference operators/reader/buffered_reader.h:48) — and
+    the pyreader/staged throughput ratio is reported as pipeline evidence."""
     import jax
     import paddle_tpu.fluid as fluid
     from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.py_reader import PyReader
 
     main, startup, loss = build(batch_size)
     exe = fluid.Executor(fluid.TPUPlace())
@@ -84,31 +91,83 @@ def run(batch_size=256, steps=20, warmup=3, n_staged=4, bf16=True):
             )
         np.asarray(l)  # sync
         dt = time.perf_counter() - t0
+        staged_ips = batch_size * steps / dt
+        if not measure_pipeline:
+            return staged_ips, None
+        try:
+            pyreader_ips = _run_pyreader_pass(
+                exe, main, loss, batch_size, steps, warmup, n_staged, rng
+            )
+        except Exception as e:
+            # evidence pass must never invalidate the measured headline
+            print("pyreader pass failed: %r" % e, file=sys.stderr)
+            pyreader_ips = None
+    return staged_ips, pyreader_ips
+
+
+def _run_pyreader_pass(exe, main, loss, batch_size, steps, warmup, n_staged, rng):
+    """PyReader-fed pass: fresh host batches each step, async staging."""
+    from paddle_tpu.py_reader import PyReader
+
+    host_batches = [
+        {
+            "img": rng.randn(batch_size, 3, 224, 224).astype("float32"),
+            "label": rng.randint(0, 1000, (batch_size, 1)).astype("int32"),
+        }
+        for _ in range(n_staged)
+    ]
+
+    def gen():
+        for i in range(steps + warmup):
+            yield host_batches[i % n_staged]
+
+    reader = PyReader(["img", "label"], capacity=2)
+    reader.decorate_tensor_provider(gen)
+    reader.start()
+    try:
+        it = reader()
+        for _ in range(warmup):
+            (l,) = exe.run(
+                main, feed=next(it), fetch_list=[loss.name], return_numpy=False
+            )
+        np.asarray(l)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (l,) = exe.run(
+                main, feed=next(it), fetch_list=[loss.name], return_numpy=False
+            )
+        np.asarray(l)
+        dt = time.perf_counter() - t0
+    finally:
+        reader.reset()
     return batch_size * steps / dt
 
 
 def main():
     batch_size = int(sys.argv[1]) if len(sys.argv) > 1 else 256
-    ips = None
+    ips = pyreader_ips = None
     ladder = [batch_size] + [b for b in (128, 64, 32) if b < batch_size]
     for bs in ladder:  # memory-headroom fallback: strictly smaller sizes only
         try:
-            ips = run(batch_size=bs)
+            ips, pyreader_ips = run(batch_size=bs)
             break
         except Exception as e:
             print("bench fallback from bs=%d: %r" % (bs, e), file=sys.stderr)
     if ips is None:
         raise SystemExit("all batch sizes failed")
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_images_per_sec_per_chip",
-                "value": round(ips, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(ips / BASELINE_IMAGES_PER_SEC, 2),
-            }
-        )
-    )
+    record = {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / BASELINE_IMAGES_PER_SEC, 2),
+    }
+    if pyreader_ips:
+        # input-pipeline evidence: PyReader-fed throughput as a fraction of
+        # the staged-batch ceiling (target >=0.95 — async staging overlaps
+        # the host->device transfer with compute)
+        record["pyreader_images_per_sec"] = round(pyreader_ips, 2)
+        record["pyreader_frac"] = round(pyreader_ips / ips, 3)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -76,13 +76,17 @@ class AsyncExecutor:
         period_vals = []
         results = []
         step = 0
-        batch = []
-        it = iter(feed)
-        eof = False
+
         def flush(step):
+            # fetches stay device-resident until here — converting per step
+            # would sync the pipeline every iteration (ROADMAP 9)
             if not period_vals:
                 return
-            means = np.mean(np.asarray(period_vals), axis=0)
+            host = [
+                [float(np.asarray(v).reshape(-1)[0]) for v in vals]
+                for vals in period_vals
+            ]
+            means = np.mean(np.asarray(host), axis=0)
             results.append(float(means[0]))
             if debug:
                 print(
@@ -97,23 +101,42 @@ class AsyncExecutor:
                 )
             period_vals.clear()
 
-        while not eof:
-            batch.clear()
-            try:
-                while len(batch) < bs:
-                    batch.append(next(it))
-            except StopIteration:
-                eof = True
-            if not batch:
-                break
-            feeds = self._assemble(batch, used, feed_vars)
-            vals = self.executor.run(
-                program, feed=feeds, fetch_list=fetch_names, scope=global_scope()
-            )
-            step += 1
-            period_vals.append([float(np.asarray(v).reshape(-1)[0]) for v in vals])
-            if step % print_period == 0:
-                flush(step)
+        def batches():
+            it = iter(feed)
+            while True:
+                batch = []
+                try:
+                    while len(batch) < bs:
+                        batch.append(next(it))
+                except StopIteration:
+                    if batch:
+                        yield self._assemble(batch, used, feed_vars)
+                    return
+                yield self._assemble(batch, used, feed_vars)
+
+        # double buffering (reference operators/reader/buffered_reader.h:48):
+        # a PyReader staging thread assembles the NEXT batch and device_puts
+        # it while the current step runs on the chip
+        from .py_reader import PyReader
+
+        staging = PyReader([v.name for v in feed_vars], capacity=2)
+        staging.decorate_tensor_provider(batches)
+        staging.start()
+        try:
+            for feeds in staging():
+                vals = self.executor.run(
+                    program,
+                    feed=feeds,
+                    fetch_list=fetch_names,
+                    scope=global_scope(),
+                    return_numpy=False,
+                )
+                step += 1
+                period_vals.append(list(vals))
+                if step % print_period == 0:
+                    flush(step)
+        finally:
+            staging.reset()
         flush(step)
         errors = feed.join()
         missing = feed.file_errors()

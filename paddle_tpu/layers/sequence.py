@@ -468,6 +468,11 @@ def im2sequence(
     from .nn import _pair
     from .tensor import fill_constant_batch_size_like
 
+    if input_image_size is None and out_stride != 1:
+        raise ValueError(
+            "im2sequence out_stride is only meaningful with input_image_size "
+            "(reference im2sequence_op.h real-size mode)"
+        )
     helper = LayerHelper("im2sequence", **locals())
     kernels = _pair(filter_size)
     strides = _pair(stride)
@@ -479,13 +484,11 @@ def im2sequence(
     if input_image_size is not None:
         inputs["Y"] = [input_image_size.name]
         attrs["out_stride"] = _pair(out_stride)
-        out_len = helper.create_variable_for_type_inference("int32")
-        outputs["OutLen"] = [out_len.name]
+        outputs["OutLen"] = [_new_len_var(helper, out)]
     helper.append_op(
         type="im2sequence", inputs=inputs, outputs=outputs, attrs=attrs
     )
     if input_image_size is not None:
-        out._len_name = out_len.name
         return out
     h, w = input.shape[2], input.shape[3]
     oh = (h + pads[0] + pads[2] - kernels[0]) // strides[0] + 1

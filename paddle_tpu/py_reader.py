@@ -26,6 +26,15 @@ class _EndOfEpoch:
     pass
 
 
+class _FeederError:
+    """Feeder-thread exception carrier: re-raised in the consumer so a
+    crashing reader/assembly/device_put surfaces instead of reading as a
+    clean EOF (which would silently truncate an epoch)."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 class PyReader:
     def __init__(self, feed_names, capacity=4, return_device_arrays=True):
         self.feed_names = list(feed_names)
@@ -109,6 +118,9 @@ class PyReader:
                         feed = {k: jax.device_put(v) for k, v in feed.items()}
                     if not _put(feed):
                         return
+            except BaseException as e:  # noqa: B036 — carried to the consumer
+                _put(_FeederError(e))
+                return
             finally:
                 _put(_EndOfEpoch)
 
@@ -131,6 +143,9 @@ class PyReader:
         if not self._started:
             raise RuntimeError("PyReader not started")
         item = self._queue.get()
+        if isinstance(item, _FeederError):
+            self._started = False
+            raise item.exc
         if item is _EndOfEpoch:
             self._started = False
             raise EOFException("reader exhausted")

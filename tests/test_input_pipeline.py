@@ -1,0 +1,79 @@
+"""Input-pipeline overlap evidence (VERDICT round 1 item 5).
+
+The PyReader feeder thread must stage batch N+1 (host assembly +
+device_put) WHILE step N computes — the reference's double-buffer reader
+contract (operators/reader/buffered_reader.h:48). On the bench chip the
+host->device tunnel caps at ~22 MB/s (PROFILE.md), so absolute pyreader
+throughput there measures the tunnel, not the design; the overlap property
+itself is asserted here on the CPU backend where transfers are memcpy-fast
+and the compute/feed times are controlled.
+"""
+
+import time
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.py_reader import PyReader
+
+FEED_DELAY = 0.08  # synthetic host-side cost per batch (parse/augment)
+STEPS = 6
+
+
+def _build(n=512):
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[n], dtype="float32")
+        h = x
+        for _ in range(4):  # enough matmul work to overlap against
+            h = fluid.layers.fc(h, size=n)
+        loss = fluid.layers.mean(h)
+    return main, startup, loss
+
+
+def test_pyreader_overlaps_feed_with_compute():
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    batch = {"x": rng.rand(64, 512).astype("float32")}
+
+    def slow_reader():
+        for _ in range(STEPS):
+            time.sleep(FEED_DELAY)  # host-side work the pipeline must hide
+            yield dict(batch)
+
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        # warm the compile cache and time one compute step
+        (l,) = exe.run(main, feed=batch, fetch_list=[loss.name], return_numpy=False)
+        np.asarray(l)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            (l,) = exe.run(main, feed=batch, fetch_list=[loss.name], return_numpy=False)
+        np.asarray(l)
+        step_time = (time.perf_counter() - t0) / 3
+
+        reader = PyReader(["x"], capacity=2)
+        reader.decorate_tensor_provider(slow_reader)
+        reader.start()
+        t0 = time.perf_counter()
+        n_batches = 0
+        for feed in reader():
+            (l,) = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
+            n_batches += 1
+        np.asarray(l)
+        wall = time.perf_counter() - t0
+
+    assert n_batches == STEPS
+    sequential = STEPS * (FEED_DELAY + step_time)
+    overlapped = STEPS * max(FEED_DELAY, step_time)
+    # the pipeline must land meaningfully below the no-overlap time; the
+    # margin absorbs CI timer noise (sequential/overlapped differ by the
+    # smaller of feed/compute per step)
+    budget = overlapped + 0.6 * (sequential - overlapped) + 0.15
+    assert wall < budget, (
+        "no feed/compute overlap: wall=%.3fs sequential=%.3fs overlapped=%.3fs"
+        % (wall, sequential, overlapped)
+    )

@@ -152,6 +152,27 @@ def test_standalone_pyreader_batched_tuples():
     assert r._thread is None
 
 
+def test_pyreader_feeder_exception_propagates():
+    """A crashing reader must surface in the consumer — NOT read as a clean
+    EOF that silently truncates the epoch (round-2 advisor finding on the
+    AsyncExecutor staging path)."""
+    from paddle_tpu.py_reader import PyReader
+
+    def bad_src():
+        yield {"x": np.asarray([1.0], "float32")}
+        raise RuntimeError("corrupt sample")
+
+    r = PyReader(["x"], capacity=2, return_device_arrays=False)
+    r.decorate_tensor_provider(bad_src)
+    r.start()
+    assert r.next_batch()["x"][0] == 1.0
+    try:
+        r.next_batch()
+        raise AssertionError("expected the feeder RuntimeError")
+    except RuntimeError as e:
+        assert "corrupt sample" in str(e)
+
+
 def test_pyreader_reset_mid_epoch_stops_thread():
     from paddle_tpu.py_reader import PyReader
 
