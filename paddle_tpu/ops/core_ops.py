@@ -924,24 +924,9 @@ def _depthwise_conv2d(ctx, ins, attrs):
     return _conv2d(ctx, ins, attrs)
 
 
-@register("conv2d_transpose")
-def _conv2d_transpose(ctx, ins, attrs):
-    (x,) = ins["Input"]
-    (w,) = ins["Filter"]  # paddle layout: (in_c, out_c/groups, kh, kw)
-    strides = [int(s) for s in attrs.get("strides", [1, 1])]
-    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
-    dilations = [int(d) for d in attrs.get("dilations", [1, 1])]
-    groups = int(attrs.get("groups", 1) or 1)
-    out = lax.conv_transpose(
-        x,
-        w,
-        strides=strides,
-        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
-        rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=False,
-    )
-    return {"Output": [out]}
+# conv2d_transpose is registered in nn_extra_ops.py beside the other
+# _conv_nd(transpose=True) family members (conv3d_transpose,
+# depthwise_conv2d_transpose)
 
 
 @register("pool2d")

@@ -205,6 +205,17 @@ def _conv3d_transpose(ctx, ins, attrs):
     return {"Output": [out]}
 
 
+@register("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    """Reference conv2d_transpose_op.cc semantics — the gradient of conv2d
+    w.r.t. its input: out[oc, i*s+ki-p, j*s+kj-p] += x[ic,i,j]*w[ic,oc,ki,kj].
+    (lax.conv_transpose's transpose_kernel=False form is NOT this op: it
+    neither flips the kernel nor produces the (in-1)*stride+k-2p output
+    extent for stride>1 — caught by the round-2 OpTest sweep.)"""
+    out = _conv_nd(ins["Input"][0], ins["Filter"][0], attrs, 2, transpose=True)
+    return {"Output": [out]}
+
+
 @register("depthwise_conv2d_transpose")
 def _depthwise_conv2d_transpose(ctx, ins, attrs):
     x = ins["Input"][0]

@@ -1,0 +1,733 @@
+"""OpTest harness sweep: elementwise binary, compare/logical, reductions,
+and tensor-manipulation ops.
+
+Reference pattern: unittests/test_elementwise_*_op.py,
+test_reduce_op.py, test_reshape_op.py etc. — numpy reference + grad check
+where the op is differentiable.
+"""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _b(rng, shape, lo=-2.0, hi=2.0):
+    return rng.uniform(lo, hi, shape).astype("float32")
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary (paddle axis-broadcast: Y broadcast into X from `axis`)
+# ---------------------------------------------------------------------------
+
+_ELTWISE = [
+    ("elementwise_sub", np.subtract, (-2, 2), (-2, 2), True),
+    ("elementwise_mul", np.multiply, (-2, 2), (-2, 2), True),
+    ("elementwise_div", np.divide, (-2, 2), (0.5, 2), True),
+    ("elementwise_max", np.maximum, (-2, 2), (-2, 2), False),
+    ("elementwise_min", np.minimum, (-2, 2), (-2, 2), False),
+    ("elementwise_pow", np.power, (0.5, 2), (0.5, 2), True),
+    ("elementwise_mod", np.mod, (0.5, 5), (1.0, 3), False),
+    ("elementwise_floordiv", np.floor_divide, (0.5, 5), (1.0, 3), False),
+]
+
+
+def _make_eltwise(op, ref, xr, yr, grad):
+    class _Case(OpTest):
+        def setUp(self):
+            rng = np.random.RandomState(hash(op) % (2**31))
+            x = _b(rng, (3, 4), *xr)
+            y = _b(rng, (3, 4), *yr)
+            self.op_type = op
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": ref(x.astype("f8"), y.astype("f8"))}
+
+        def test_check_output(self):
+            self.check_output(atol=1e-5)
+
+        if grad:
+
+            def test_check_grad(self):
+                self.check_grad(["X", "Y"])
+
+    _Case.__name__ = "Test%sOp" % "".join(p.title() for p in op.split("_"))
+    return _Case
+
+
+for _c in _ELTWISE:
+    _cls = _make_eltwise(*_c)
+    globals()[_cls.__name__] = _cls
+
+
+class TestElementwiseSubAxisBroadcast(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(11)
+        x = _b(rng, (2, 3, 4))
+        y = _b(rng, (3,))
+        self.op_type = "elementwise_sub"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x - y.reshape(1, 3, 1)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Y"])
+
+
+# ---------------------------------------------------------------------------
+# compare / logical (no grads — bool outputs)
+# ---------------------------------------------------------------------------
+
+_COMPARE = [
+    ("less_than", np.less),
+    ("less_equal", np.less_equal),
+    ("greater_than", np.greater),
+    ("greater_equal", np.greater_equal),
+    ("equal", np.equal),
+    ("not_equal", np.not_equal),
+]
+
+
+def _make_compare(op, ref):
+    class _Case(OpTest):
+        def setUp(self):
+            rng = np.random.RandomState(hash(op) % (2**31))
+            x = rng.randint(0, 4, (3, 5)).astype("float32")
+            y = rng.randint(0, 4, (3, 5)).astype("float32")
+            self.op_type = op
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": ref(x, y)}
+
+        def test_check_output(self):
+            self.check_output()
+
+    _Case.__name__ = "Test%sOp" % "".join(p.title() for p in op.split("_"))
+    return _Case
+
+
+for _c in _COMPARE:
+    _cls = _make_compare(*_c)
+    globals()[_cls.__name__] = _cls
+
+_LOGICAL = [
+    ("logical_and", np.logical_and),
+    ("logical_or", np.logical_or),
+    ("logical_xor", np.logical_xor),
+]
+
+
+def _make_logical(op, ref):
+    class _Case(OpTest):
+        def setUp(self):
+            rng = np.random.RandomState(hash(op) % (2**31))
+            x = rng.rand(3, 5) > 0.5
+            y = rng.rand(3, 5) > 0.5
+            self.op_type = op
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": ref(x, y)}
+
+        def test_check_output(self):
+            self.check_output()
+
+    _Case.__name__ = "Test%sOp" % "".join(p.title() for p in op.split("_"))
+    return _Case
+
+
+for _c in _LOGICAL:
+    _cls = _make_logical(*_c)
+    globals()[_cls.__name__] = _cls
+
+
+class TestLogicalNotOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(13)
+        x = rng.rand(3, 5) > 0.5
+        self.op_type = "logical_not"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.logical_not(x)}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+
+def _make_reduce(op, ref, grad, gen=None):
+    class _Case(OpTest):
+        def setUp(self):
+            rng = np.random.RandomState(hash(op) % (2**31))
+            x = gen(rng) if gen else _b(rng, (3, 4, 5))
+            self.op_type = op
+            self.inputs = {"X": x}
+            self.attrs = {"dim": [1], "keep_dim": False}
+            self.outputs = {"Out": ref(x.astype("f8"), axis=1)}
+
+        def test_check_output(self):
+            self.check_output(atol=1e-5)
+
+        if grad:
+
+            def test_check_grad(self):
+                # f32 forward + central differences on selection ops: allow
+                # a little more slack than smooth ops
+                self.check_grad(["X"], max_relative_error=0.02)
+
+    _Case.__name__ = "Test%sOp" % "".join(p.title() for p in op.split("_"))
+    return _Case
+
+
+def _distinct(rng):
+    # unique values along the reduced axis: max/min subgradient is then exact
+    x = np.arange(3 * 4 * 5, dtype="float32").reshape(3, 4, 5)
+    return x + _b(rng, x.shape, -0.2, 0.2)
+
+
+for _c in [
+    ("reduce_max", np.max, True, _distinct),
+    ("reduce_min", np.min, True, _distinct),
+    ("reduce_prod", np.prod, True, lambda r: _b(r, (3, 4, 5), 0.5, 1.5)),
+]:
+    _cls = _make_reduce(*_c)
+    globals()[_cls.__name__] = _cls
+
+
+class TestReduceMaxAllOp(OpTest):
+    def setUp(self):
+        x = np.arange(24, dtype="float32").reshape(4, 6)
+        self.op_type = "reduce_max"
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": np.asarray([x.max()])}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# tensor manipulation
+# ---------------------------------------------------------------------------
+
+
+class TestReshapeOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(21)
+        x = _b(rng, (2, 3, 4))
+        self.op_type = "reshape"
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [2, -1]}
+        self.outputs = {"Out": x.reshape(2, 12)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestTransposeOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(22)
+        x = _b(rng, (2, 3, 4))
+        self.op_type = "transpose"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [1, 0, 2]}
+        self.outputs = {"Out": x.transpose(1, 0, 2)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestFlattenOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(23)
+        x = _b(rng, (2, 3, 4))
+        self.op_type = "flatten"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 2}
+        self.outputs = {"Out": x.reshape(6, 4)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestFlatten2Op(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(24)
+        x = _b(rng, (2, 3, 4))
+        self.op_type = "flatten2"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1}
+        self.outputs = {
+            "Out": x.reshape(2, 12),
+            "XShape": np.zeros((0, 2, 3, 4), "float32"),
+        }
+
+    def test_check_output(self):
+        self.check_output(no_check_set=["XShape"])
+
+
+class TestSqueezeOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(25)
+        x = _b(rng, (2, 1, 3, 1))
+        self.op_type = "squeeze"
+        self.inputs = {"X": x}
+        self.attrs = {"axes": [1]}
+        self.outputs = {"Out": x.reshape(2, 3, 1)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestSqueeze2Op(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(26)
+        x = _b(rng, (2, 1, 3))
+        self.op_type = "squeeze2"
+        self.inputs = {"X": x}
+        self.attrs = {"axes": [1]}
+        self.outputs = {
+            "Out": x.reshape(2, 3),
+            "XShape": np.zeros((0, 2, 1, 3), "float32"),
+        }
+
+    def test_check_output(self):
+        self.check_output(no_check_set=["XShape"])
+
+
+class TestUnsqueezeOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(27)
+        x = _b(rng, (2, 3))
+        self.op_type = "unsqueeze"
+        self.inputs = {"X": x}
+        self.attrs = {"axes": [1]}
+        self.outputs = {"Out": x.reshape(2, 1, 3)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestUnsqueeze2Op(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(28)
+        x = _b(rng, (2, 3))
+        self.op_type = "unsqueeze2"
+        self.inputs = {"X": x}
+        self.attrs = {"axes": [0]}
+        self.outputs = {
+            "Out": x.reshape(1, 2, 3),
+            "XShape": np.zeros((0, 2, 3), "float32"),
+        }
+
+    def test_check_output(self):
+        self.check_output(no_check_set=["XShape"])
+
+
+class TestStackOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(29)
+        xs = [_b(rng, (3, 4)) for _ in range(3)]
+        self.op_type = "stack"
+        self.inputs = {"X": [("sx%d" % i, x) for i, x in enumerate(xs)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Y": np.stack(xs, axis=1)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["sx0", "sx1", "sx2"])
+
+
+class TestUnstackOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(30)
+        x = _b(rng, (3, 4))
+        self.op_type = "unstack"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 0, "num": 3}
+        self.outputs = {"Y": [("uy%d" % i, x[i]) for i in range(3)]}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestSliceOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(31)
+        x = _b(rng, (4, 5, 6))
+        self.op_type = "slice"
+        self.inputs = {"Input": x}
+        self.attrs = {"axes": [0, 2], "starts": [1, -4], "ends": [3, 6]}
+        self.outputs = {"Out": x[1:3, :, 2:6]}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["Input"])
+
+
+class TestPadOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(32)
+        x = _b(rng, (2, 3))
+        self.op_type = "pad"
+        self.inputs = {"X": x}
+        self.attrs = {"paddings": [0, 1, 2, 0], "pad_value": 0.5}
+        self.outputs = {
+            "Out": np.pad(x, [(0, 1), (2, 0)], constant_values=0.5)
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestPad2dOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(33)
+        x = _b(rng, (2, 3, 4, 5))
+        self.op_type = "pad2d"
+        self.inputs = {"X": x}
+        self.attrs = {"paddings": [1, 0, 0, 2], "mode": "reflect"}
+        self.outputs = {
+            "Out": np.pad(x, [(0, 0), (0, 0), (1, 0), (0, 2)], mode="reflect")
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestExpandOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(34)
+        x = _b(rng, (2, 1, 3))
+        self.op_type = "expand"
+        self.inputs = {"X": x}
+        self.attrs = {"expand_times": [1, 4, 2]}
+        self.outputs = {"Out": np.tile(x, (1, 4, 2))}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestReverseOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(35)
+        x = _b(rng, (3, 4))
+        self.op_type = "reverse"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [0]}
+        self.outputs = {"Out": x[::-1]}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestScatterOverwriteOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(36)
+        x = _b(rng, (5, 3))
+        ids = np.asarray([1, 3], "int32")
+        upd = _b(rng, (2, 3))
+        out = x.copy()
+        out[ids] = upd
+        self.op_type = "scatter"
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd}
+        self.attrs = {"overwrite": True}
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestScatterAddOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(37)
+        x = _b(rng, (5, 3))
+        ids = np.asarray([1, 1], "int32")
+        upd = _b(rng, (2, 3))
+        out = x.copy()
+        np.add.at(out, ids, upd)
+        self.op_type = "scatter"
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd}
+        self.attrs = {"overwrite": False}
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Updates"])
+
+
+class TestWhereOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(38)
+        cond = rng.rand(3, 4) > 0.5
+        x = _b(rng, (3, 4))
+        y = _b(rng, (3, 4))
+        self.op_type = "where"
+        self.inputs = {"Condition": cond, "X": x, "Y": y}
+        self.outputs = {"Out": np.where(cond, x, y)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Y"])
+
+
+class TestCumsumOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(39)
+        x = _b(rng, (3, 5))
+        self.op_type = "cumsum"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.cumsum(x, axis=1)}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestCumsumReverseOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(40)
+        x = _b(rng, (3, 5))
+        self.op_type = "cumsum"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "reverse": True}
+        self.outputs = {"Out": np.cumsum(x[:, ::-1], axis=1)[:, ::-1]}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestSumOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(41)
+        xs = [_b(rng, (3, 4)) for _ in range(3)]
+        self.op_type = "sum"
+        self.inputs = {"X": [("sm%d" % i, x) for i, x in enumerate(xs)]}
+        self.outputs = {"Out": xs[0] + xs[1] + xs[2]}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["sm0", "sm2"])
+
+
+class TestMeanOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(42)
+        x = _b(rng, (3, 4))
+        self.op_type = "mean"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray([x.mean()])}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestCastOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(43)
+        x = rng.uniform(-3, 3, (3, 4)).astype("float32")
+        self.op_type = "cast"
+        self.inputs = {"X": x}
+        self.attrs = {"in_dtype": "float32", "out_dtype": "int32"}
+        self.outputs = {"Out": x.astype("int32")}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestAssignOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(44)
+        x = _b(rng, (3, 4))
+        self.op_type = "assign"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestShapeOp(OpTest):
+    def setUp(self):
+        self.op_type = "shape"
+        self.inputs = {"Input": np.zeros((3, 4, 5), "float32")}
+        self.outputs = {"Out": np.asarray([3, 4, 5], "int32")}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestIncrementOp(OpTest):
+    def setUp(self):
+        self.op_type = "increment"
+        self.inputs = {"X": np.asarray([5.0], "float32")}
+        self.attrs = {"step": 2.0}
+        self.outputs = {"Out": np.asarray([7.0], "float32")}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestFillConstantOp(OpTest):
+    def setUp(self):
+        self.op_type = "fill_constant"
+        self.inputs = {}
+        self.attrs = {"shape": [2, 3], "dtype": "float32", "value": 3.5}
+        self.outputs = {"Out": np.full((2, 3), 3.5, "float32")}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestFillZerosLikeOp(OpTest):
+    def setUp(self):
+        self.op_type = "fill_zeros_like"
+        self.inputs = {"X": np.ones((2, 3), "float32")}
+        self.outputs = {"Out": np.zeros((2, 3), "float32")}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestArgMaxOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(45)
+        x = rng.permutation(24).reshape(4, 6).astype("float32")
+        self.op_type = "arg_max"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x.argmax(1).astype("int32")}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestArgMinOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(46)
+        x = rng.permutation(24).reshape(4, 6).astype("float32")
+        self.op_type = "arg_min"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 0}
+        self.outputs = {"Out": x.argmin(0).astype("int32")}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestArgsortOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(47)
+        x = rng.permutation(20).reshape(4, 5).astype("float32")
+        self.op_type = "argsort"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1}
+        self.outputs = {
+            "Out": np.sort(x, axis=1),
+            "Indices": np.argsort(x, axis=1).astype("int32"),
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestLabelSmoothOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(48)
+        onehot = np.eye(5, dtype="float32")[rng.randint(0, 5, 4)]
+        self.op_type = "label_smooth"
+        self.inputs = {"X": onehot}
+        self.attrs = {"epsilon": 0.1}
+        self.outputs = {"Out": 0.9 * onehot + 0.1 / 5}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestNormOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(49)
+        x = _b(rng, (3, 4, 5))
+        eps = 1e-10
+        norm = np.sqrt((x.astype("f8") ** 2).sum(axis=1, keepdims=True) + eps)
+        self.op_type = "norm"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "epsilon": eps}
+        self.outputs = {"Out": x / norm, "Norm": norm}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X"], max_relative_error=0.01)
+
+
+class TestLodResetOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(50)
+        x = _b(rng, (4, 3))
+        self.op_type = "lod_reset"
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

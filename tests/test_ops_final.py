@@ -1,0 +1,232 @@
+"""OpTest harness sweep: remaining directly-testable ops — conv variants,
+metrics, pooling-with-index, embedding alias, shape-like fills.
+
+Reference pattern: unittests/test_conv2d_transpose_op.py,
+test_accuracy_op.py, test_pool_max_op.py, test_lookup_table_op.py.
+"""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestAssignValueOp(OpTest):
+    def setUp(self):
+        vals = [1.5, -2.0, 3.25, 0.0, 7.0, -1.0]
+        self.op_type = "assign_value"
+        self.inputs = {}
+        self.attrs = {"shape": [2, 3], "dtype": "float32", "values": vals}
+        self.outputs = {"Out": np.asarray(vals, "float32").reshape(2, 3)}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestFillConstantBatchSizeLikeOp(OpTest):
+    def setUp(self):
+        self.op_type = "fill_constant_batch_size_like"
+        self.inputs = {"Input": np.zeros((5, 2), "float32")}
+        self.attrs = {"shape": [-1, 3], "dtype": "float32", "value": 2.5}
+        self.outputs = {"Out": np.full((5, 3), 2.5, "float32")}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestEmbeddingOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(1)
+        w = rng.uniform(-1, 1, (10, 4)).astype("float32")
+        ids = np.asarray([[1], [7], [3]], "int64")
+        self.op_type = "embedding"
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids.reshape(-1)]}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["W"], no_grad_set={"Ids"})
+
+
+class TestAccuracyOp(OpTest):
+    def setUp(self):
+        indices = np.asarray([[0, 2], [1, 3], [4, 0], [2, 2]], "int64")
+        label = np.asarray([[2], [0], [4], [1]], "int64")
+        # rows 0 and 2 contain their label in top-k
+        self.op_type = "accuracy"
+        self.inputs = {"Indices": indices, "Label": label}
+        self.outputs = {
+            "Accuracy": np.asarray([0.5], "float32"),
+            "Correct": np.asarray([2], "int32"),
+            "Total": np.asarray([4], "int32"),
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestPrecisionRecallOp(OpTest):
+    def setUp(self):
+        C = 3
+        idx = np.asarray([[0], [1], [1], [2]], "int64")
+        lbl = np.asarray([[0], [1], [2], [2]], "int64")
+        tp = np.zeros(C)
+        fp = np.zeros(C)
+        fn = np.zeros(C)
+        tn = np.zeros(C)
+        for p, t in zip(idx.reshape(-1), lbl.reshape(-1)):
+            for c in range(C):
+                if p == c and t == c:
+                    tp[c] += 1
+                elif p == c:
+                    fp[c] += 1
+                elif t == c:
+                    fn[c] += 1
+                else:
+                    tn[c] += 1
+
+        def safe(a, b):
+            return a / b if b > 0 else 0.0
+
+        prec = [safe(tp[c], tp[c] + fp[c]) for c in range(C)]
+        rec = [safe(tp[c], tp[c] + fn[c]) for c in range(C)]
+        f1 = [
+            safe(2 * p * r, p + r) for p, r in zip(prec, rec)
+        ]
+        macro = [np.mean(prec), np.mean(rec), np.mean(f1)]
+        mtp, mfp, mfn = tp.sum(), fp.sum(), fn.sum()
+        micro_p = safe(mtp, mtp + mfp)
+        micro_r = safe(mtp, mtp + mfn)
+        micro = [micro_p, micro_r, safe(2 * micro_p * micro_r, micro_p + micro_r)]
+        batch = np.stack([tp, fp, tn, fn], axis=1)
+        self.op_type = "precision_recall"
+        self.inputs = {"Indices": idx, "Labels": lbl}
+        self.attrs = {"class_number": C}
+        self.outputs = {
+            "BatchMetrics": np.asarray(macro + micro, "float32"),
+            "AccumMetrics": np.asarray(macro + micro, "float32"),
+            "AccumStatesInfo": batch.astype("float32"),
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestMaxPool3dWithIndexOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(2)
+        x = rng.permutation(2 * 1 * 4 * 4 * 4).astype("float32").reshape(
+            2, 1, 4, 4, 4
+        )
+        k = s = 2
+        b, c, d, h, w = x.shape
+        od, oh, ow = d // k, h // k, w // k
+        out = np.zeros((b, c, od, oh, ow), "float32")
+        mask = np.zeros((b, c, od, oh, ow), "int32")
+        for bi in range(b):
+            for ci in range(c):
+                for i in range(od):
+                    for j in range(oh):
+                        for l in range(ow):
+                            blk = x[bi, ci, 2*i:2*i+2, 2*j:2*j+2, 2*l:2*l+2]
+                            out[bi, ci, i, j, l] = blk.max()
+                            di, hi, wi = np.unravel_index(blk.argmax(), blk.shape)
+                            mask[bi, ci, i, j, l] = (
+                                (2*i+di) * h * w + (2*j+hi) * w + (2*l+wi)
+                            )
+        self.op_type = "max_pool3d_with_index"
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [k]*3, "strides": [s]*3, "paddings": [0]*3}
+        self.outputs = {"Out": out, "Mask": mask}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestConv2dTransposeOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(3)
+        x = rng.uniform(-1, 1, (1, 2, 3, 3)).astype("float32")
+        w = rng.uniform(-1, 1, (2, 3, 2, 2)).astype("float32")  # (in, out, kh, kw)
+        stride = 2
+        # direct summation reference: out[oc, i*s+ki, j*s+kj] += x[ic,i,j]*w[ic,oc,ki,kj]
+        oh = (3 - 1) * stride + 2
+        out = np.zeros((1, 3, oh, oh), "float64")
+        for ic in range(2):
+            for oc in range(3):
+                for i in range(3):
+                    for j in range(3):
+                        for ki in range(2):
+                            for kj in range(2):
+                                out[0, oc, i*stride+ki, j*stride+kj] += (
+                                    x[0, ic, i, j] * w[ic, oc, ki, kj]
+                                )
+        self.op_type = "conv2d_transpose"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [stride, stride], "paddings": [0, 0]}
+        self.outputs = {"Output": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_check_grad(self):
+        self.check_grad(["Input", "Filter"], max_relative_error=0.02)
+
+
+class TestConv2dTransposeGroupsOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(5)
+        groups, icg, ocg = 2, 1, 2  # in_c=2, out_c=4
+        x = rng.uniform(-1, 1, (1, 2, 3, 3)).astype("float32")
+        w = rng.uniform(-1, 1, (2, ocg, 2, 2)).astype("float32")
+        s = 1
+        oh = 3 - 1 + 2
+        out = np.zeros((1, groups * ocg, oh, oh), "float64")
+        for g in range(groups):
+            for oc in range(ocg):
+                for i in range(3):
+                    for j in range(3):
+                        for ki in range(2):
+                            for kj in range(2):
+                                out[0, g * ocg + oc, i + ki, j + kj] += (
+                                    x[0, g, i, j] * w[g, oc, ki, kj]
+                                )
+        self.op_type = "conv2d_transpose"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [s, s], "paddings": [0, 0], "groups": groups}
+        self.outputs = {"Output": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestDepthwiseConv2dOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(4)
+        C = 3
+        x = rng.uniform(-1, 1, (1, C, 5, 5)).astype("float32")
+        w = rng.uniform(-1, 1, (C, 1, 3, 3)).astype("float32")
+        out = np.zeros((1, C, 3, 3), "float64")
+        for c in range(C):
+            for i in range(3):
+                for j in range(3):
+                    out[0, c, i, j] = (
+                        x[0, c, i:i+3, j:j+3].astype("f8") * w[c, 0]
+                    ).sum()
+        self.op_type = "depthwise_conv2d"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0], "groups": C}
+        self.outputs = {"Output": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_check_grad(self):
+        self.check_grad(["Input", "Filter"], max_relative_error=0.02)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -1,0 +1,347 @@
+"""OpTest harness sweep: pointwise losses, normalization, interpolation,
+quantization, and geometry ops with direct numpy references.
+
+Reference pattern: unittests/test_huber_loss_op.py, test_log_loss_op.py,
+test_lrn_op.py, test_fake_quantize_op.py, test_iou_similarity_op.py, ...
+"""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestHingeLossOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(1)
+        logits = rng.uniform(-2, 2, (6, 1)).astype("float32")
+        labels = rng.randint(0, 2, (6, 1)).astype("float32")
+        self.op_type = "hinge_loss"
+        self.inputs = {"Logits": logits, "Labels": labels}
+        self.outputs = {
+            "Loss": np.maximum(0.0, 1.0 - (2 * labels - 1) * logits)
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["Logits"], no_grad_set={"Labels"})
+
+
+class TestHuberLossOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(2)
+        x = rng.uniform(-2, 2, (5, 1)).astype("float32")
+        y = rng.uniform(-2, 2, (5, 1)).astype("float32")
+        delta = 1.0
+        r = y - x
+        loss = np.where(
+            np.abs(r) <= delta, 0.5 * r * r, delta * (np.abs(r) - 0.5 * delta)
+        )
+        self.op_type = "huber_loss"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"delta": delta}
+        self.outputs = {"Out": loss, "Residual": r}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Y"])
+
+
+class TestLogLossOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(3)
+        p = rng.uniform(0.1, 0.9, (6, 1)).astype("float32")
+        l = rng.randint(0, 2, (6, 1)).astype("float32")
+        eps = 1e-4
+        self.op_type = "log_loss"
+        self.inputs = {"Predicted": p, "Labels": l}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {
+            "Loss": -l * np.log(p + eps) - (1 - l) * np.log(1 - p + eps)
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["Predicted"], no_grad_set={"Labels"})
+
+
+class TestSmoothL1LossOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(4)
+        x = rng.uniform(-2, 2, (4, 3)).astype("float32")
+        y = rng.uniform(-2, 2, (4, 3)).astype("float32")
+        sigma = 1.0
+        d = x - y
+        ad = np.abs(d)
+        val = np.where(ad < 1.0, 0.5 * d * d, ad - 0.5)
+        self.op_type = "smooth_l1_loss"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"sigma": sigma}
+        # reference smooth_l1_loss_op sums per row -> (B, 1)
+        self.outputs = {"Out": val.sum(axis=1, keepdims=True)}
+
+    def test_check_output(self):
+        self.check_output(no_check_set=["Diff"])
+
+    def test_check_grad(self):
+        self.check_grad(["X"], no_grad_set={"Y"})
+
+
+class TestSquareErrorCostOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(5)
+        x = rng.uniform(-2, 2, (4, 3)).astype("float32")
+        y = rng.uniform(-2, 2, (4, 3)).astype("float32")
+        self.op_type = "square_error_cost"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (x - y) ** 2}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Y"])
+
+
+class TestSigmoidCrossEntropyWithLogitsOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(6)
+        x = rng.uniform(-3, 3, (5, 4)).astype("float32")
+        label = rng.randint(0, 2, (5, 4)).astype("float32")
+        loss = np.maximum(x, 0) - x * label + np.log1p(np.exp(-np.abs(x)))
+        self.op_type = "sigmoid_cross_entropy_with_logits"
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": loss}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"], no_grad_set={"Label"})
+
+
+class TestLogSoftmaxOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(7)
+        x = rng.uniform(-2, 2, (4, 6)).astype("float32")
+        e = np.exp(x - x.max(1, keepdims=True))
+        self.op_type = "log_softmax"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": np.log(e / e.sum(1, keepdims=True))}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestLrnOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(8)
+        x = rng.uniform(-1, 1, (2, 6, 3, 3)).astype("float32")
+        n, k, alpha, beta = 5, 1.0, 1e-4, 0.75
+        sq = x.astype("f8") ** 2
+        half = n // 2
+        pad = np.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+        acc = sum(pad[:, i : i + x.shape[1]] for i in range(n))
+        mid = k + alpha * acc
+        self.op_type = "lrn"
+        self.inputs = {"X": x}
+        self.attrs = {"n": n, "k": k, "alpha": alpha, "beta": beta}
+        self.outputs = {"Out": x / mid**beta}
+
+    def test_check_output(self):
+        # MidOut is an implementation-detail output in the reference too
+        self.check_output(atol=1e-5, no_check_set=["MidOut"])
+
+    def test_check_grad(self):
+        self.check_grad(["X"], max_relative_error=0.01)
+
+
+class TestBilinearInterpOp(OpTest):
+    def setUp(self):
+        # integer upscale with aligned grid: reference equals jax bilinear
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        import jax
+        import jax.numpy as jnp
+
+        want = np.asarray(
+            jax.image.resize(jnp.asarray(x), (1, 1, 8, 8), method="bilinear")
+        )
+        self.op_type = "bilinear_interp"
+        self.inputs = {"X": x}
+        self.attrs = {"out_h": 8, "out_w": 8}
+        self.outputs = {"Out": want}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X"], max_relative_error=0.01)
+
+
+class TestNearestInterpOp(OpTest):
+    def setUp(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        want = x.repeat(2, axis=2).repeat(2, axis=3)
+        self.op_type = "nearest_interp"
+        self.inputs = {"X": x}
+        self.attrs = {"out_h": 8, "out_w": 8}
+        self.outputs = {"Out": want}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestSequenceMaskOp(OpTest):
+    def setUp(self):
+        lens = np.asarray([2, 0, 4], "int64")
+        maxlen = 5
+        want = (np.arange(maxlen)[None, :] < lens[:, None]).astype("int64")
+        self.op_type = "sequence_mask"
+        self.inputs = {"X": lens}
+        self.attrs = {"maxlen": maxlen, "out_dtype": "int64"}
+        self.outputs = {"Y": want}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestFakeQuantizeAbsMaxOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(9)
+        x = rng.uniform(-4, 4, (4, 5)).astype("float32")
+        s = 127.0
+        scale = np.abs(x).max()
+        self.op_type = "fake_quantize_abs_max"
+        self.inputs = {"X": x}
+        self.attrs = {"bit_length": 8}
+        self.outputs = {
+            "Out": np.round(x / scale * s),
+            "OutScale": np.asarray(scale, "float32"),
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestFakeDequantizeMaxAbsOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(10)
+        x = np.round(rng.uniform(-127, 127, (4, 5))).astype("float32")
+        scale = np.asarray([3.7], "float32")
+        self.op_type = "fake_dequantize_max_abs"
+        self.inputs = {"X": x, "Scale": scale}
+        self.attrs = {"max_range": 127.0}
+        self.outputs = {"Out": x * (scale[0] / 127.0)}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestFakeQuantizeRangeAbsMaxOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(11)
+        x = rng.uniform(-2, 2, (4, 5)).astype("float32")
+        in_scale = np.asarray([5.0], "float32")
+        # training mode: scale = max(|X|, 0.9 * running scale)
+        scale = max(np.abs(x).max(), 0.9 * in_scale[0])
+        self.op_type = "fake_quantize_range_abs_max"
+        self.inputs = {"X": x, "InScale": in_scale}
+        self.attrs = {"bit_length": 8, "is_test": False}
+        self.outputs = {
+            "Out": np.round(x / scale * 127.0),
+            "OutScale": np.asarray(scale, "float32"),
+        }
+
+    def test_check_output(self):
+        self.check_output(no_check_set=["OutScales"])
+
+
+class TestIouSimilarityOp(OpTest):
+    def setUp(self):
+        x = np.asarray([[0, 0, 2, 2], [1, 1, 3, 3]], "float32")
+        y = np.asarray([[0, 0, 2, 2], [2, 2, 4, 4], [0, 0, 4, 4]], "float32")
+
+        def iou(a, b):
+            ix = max(0, min(a[2], b[2]) - max(a[0], b[0]))
+            iy = max(0, min(a[3], b[3]) - max(a[1], b[1]))
+            inter = ix * iy
+            ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+            return inter / ua if ua > 0 else 0.0
+
+        want = np.asarray(
+            [[iou(a, b) for b in y] for a in x], "float32"
+        )
+        self.op_type = "iou_similarity"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"box_normalized": True}
+        self.outputs = {"Out": want}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestBoxCoderDecodeOp(OpTest):
+    def setUp(self):
+        # decode_center_size with explicit variance tensor (reference
+        # box_coder_op.h decode branch), normalized boxes
+        prior = np.asarray([[0.1, 0.1, 0.5, 0.5], [0.2, 0.2, 0.6, 0.8]], "f4")
+        var = np.full((2, 4), 0.1, "f4")
+        target = np.random.RandomState(12).uniform(-1, 1, (3, 2, 4)).astype("f4")
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = (prior[:, 0] + prior[:, 2]) / 2
+        pcy = (prior[:, 1] + prior[:, 3]) / 2
+        t = target.astype("f8")
+        cx = var[:, 0] * t[:, :, 0] * pw + pcx
+        cy = var[:, 1] * t[:, :, 1] * ph + pcy
+        w = np.exp(var[:, 2] * t[:, :, 2]) * pw
+        h = np.exp(var[:, 3] * t[:, :, 3]) * ph
+        want = np.stack(
+            [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1
+        )
+        self.op_type = "box_coder"
+        self.inputs = {
+            "PriorBox": prior, "PriorBoxVar": var, "TargetBox": target,
+        }
+        self.attrs = {"code_type": "decode_center_size", "box_normalized": True}
+        self.outputs = {"OutputBox": want}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestPolygonBoxTransformOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(13)
+        x = rng.uniform(0.5, 1.5, (1, 4, 2, 3)).astype("float32")
+        x[0, :, 0, 0] = 0.0  # inactive cell
+        b, c, h, w = x.shape
+        gx = np.tile(np.arange(w, dtype="f4")[None, :], (h, 1))
+        gy = np.tile(np.arange(h, dtype="f4")[:, None], (1, w))
+        grid = np.tile(np.stack([gx, gy], 0), (c // 2, 1, 1))
+        want = np.where(x != 0, 4.0 * grid[None] + x, 0.0)
+        self.op_type = "polygon_box_transform"
+        self.inputs = {"Input": x}
+        self.outputs = {"Output": want}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -1,0 +1,359 @@
+"""OpTest harness sweep: the optimizer op tier.
+
+Reference pattern: unittests/test_sgd_op.py, test_adam_op.py,
+test_rmsprop_op.py etc. — declare Param/Grad/accumulator inputs as numpy,
+compute the update in float64 numpy (the reference optimizer formulas from
+optimizers/*.h), and compare every output tensor. Optimizer ops have no
+gradients (no_grad) so these are output-only checks.
+"""
+
+import numpy as np
+
+from op_test import OpTest
+
+LR = 0.1
+
+
+def _pg(rng, shape=(3, 4)):
+    p = rng.uniform(-1, 1, shape).astype("float32")
+    g = rng.uniform(-1, 1, shape).astype("float32")
+    return p, g
+
+
+class TestSGDOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(1)
+        p, g = _pg(rng)
+        self.op_type = "sgd"
+        self.inputs = {
+            "Param": p, "Grad": g,
+            "LearningRate": np.asarray([LR], "float32"),
+        }
+        self.outputs = {"ParamOut": p - LR * g}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestMomentumOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(2)
+        p, g = _pg(rng)
+        v = rng.uniform(-1, 1, p.shape).astype("float32")
+        mu = 0.9
+        v_out = mu * v + g
+        self.op_type = "momentum"
+        self.inputs = {
+            "Param": p, "Grad": g, "Velocity": v,
+            "LearningRate": np.asarray([LR], "float32"),
+        }
+        self.attrs = {"mu": mu}
+        self.outputs = {"ParamOut": p - LR * v_out, "VelocityOut": v_out}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestMomentumNesterovOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(3)
+        p, g = _pg(rng)
+        v = rng.uniform(-1, 1, p.shape).astype("float32")
+        mu = 0.9
+        v_out = mu * v + g
+        self.op_type = "momentum"
+        self.inputs = {
+            "Param": p, "Grad": g, "Velocity": v,
+            "LearningRate": np.asarray([LR], "float32"),
+        }
+        self.attrs = {"mu": mu, "use_nesterov": True}
+        self.outputs = {
+            "ParamOut": p - (g + mu * v_out) * LR, "VelocityOut": v_out,
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestLarsMomentumOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(4)
+        p, g = _pg(rng)
+        v = rng.uniform(-1, 1, p.shape).astype("float32")
+        mu, coeff, wd = 0.9, 0.001, 0.0005
+        pn = np.sqrt((p.astype("f8") ** 2).sum())
+        gn = np.sqrt((g.astype("f8") ** 2).sum())
+        local_lr = LR * coeff * pn / (gn + wd * pn)
+        v_out = mu * v + local_lr * (g + wd * p)
+        self.op_type = "lars_momentum"
+        self.inputs = {
+            "Param": p, "Grad": g, "Velocity": v,
+            "LearningRate": np.asarray([LR], "float32"),
+        }
+        self.attrs = {"mu": mu, "lars_coeff": coeff, "lars_weight_decay": wd}
+        self.outputs = {"ParamOut": p - v_out, "VelocityOut": v_out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestAdamOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(5)
+        p, g = _pg(rng)
+        m1 = rng.uniform(-1, 1, p.shape).astype("float32")
+        m2 = rng.uniform(0, 1, p.shape).astype("float32")
+        b1, b2, eps, t = 0.9, 0.999, 1e-8, 3
+        m1o = b1 * m1 + (1 - b1) * g
+        m2o = b2 * m2 + (1 - b2) * g**2
+        lr_t = LR * np.sqrt(1 - b2**t) / (1 - b1**t)
+        self.op_type = "adam"
+        self.inputs = {
+            "Param": p, "Grad": g,
+            "LearningRate": np.asarray([LR], "float32"),
+            "Moment1": m1, "Moment2": m2,
+            "Beta1Pow": np.asarray([b1**t], "float32"),
+            "Beta2Pow": np.asarray([b2**t], "float32"),
+        }
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {
+            "ParamOut": p - lr_t * m1o / (np.sqrt(m2o) + eps),
+            "Moment1Out": m1o,
+            "Moment2Out": m2o,
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestAdamaxOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(6)
+        p, g = _pg(rng)
+        mom = rng.uniform(-1, 1, p.shape).astype("float32")
+        inf = rng.uniform(0.1, 1, p.shape).astype("float32")
+        b1, b2, eps, t = 0.9, 0.999, 1e-8, 2
+        mom_out = b1 * mom + (1 - b1) * g
+        inf_out = np.maximum(b2 * inf, np.abs(g))
+        lr_t = LR / (1 - b1**t)
+        self.op_type = "adamax"
+        self.inputs = {
+            "Param": p, "Grad": g,
+            "LearningRate": np.asarray([LR], "float32"),
+            "Moment": mom, "InfNorm": inf,
+            "Beta1Pow": np.asarray([b1**t], "float32"),
+        }
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {
+            "ParamOut": p - lr_t * mom_out / (inf_out + eps),
+            "MomentOut": mom_out,
+            "InfNormOut": inf_out,
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestAdagradOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(7)
+        p, g = _pg(rng)
+        mom = rng.uniform(0, 1, p.shape).astype("float32")
+        eps = 1e-6
+        mom_out = mom + g**2
+        self.op_type = "adagrad"
+        self.inputs = {
+            "Param": p, "Grad": g, "Moment": mom,
+            "LearningRate": np.asarray([LR], "float32"),
+        }
+        self.attrs = {"epsilon": eps}
+        self.outputs = {
+            "ParamOut": p - LR * g / (np.sqrt(mom_out) + eps),
+            "MomentOut": mom_out,
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestDecayedAdagradOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(8)
+        p, g = _pg(rng)
+        mom = rng.uniform(0, 1, p.shape).astype("float32")
+        decay, eps = 0.95, 1e-6
+        mom_out = decay * mom + (1 - decay) * g**2
+        self.op_type = "decayed_adagrad"
+        self.inputs = {
+            "Param": p, "Grad": g, "Moment": mom,
+            "LearningRate": np.asarray([LR], "float32"),
+        }
+        self.attrs = {"decay": decay, "epsilon": eps}
+        self.outputs = {
+            "ParamOut": p - LR * g / (np.sqrt(mom_out) + eps),
+            "MomentOut": mom_out,
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestRmspropOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(9)
+        p, g = _pg(rng)
+        ms = rng.uniform(0.1, 1, p.shape).astype("float32")
+        mom = rng.uniform(-1, 1, p.shape).astype("float32")
+        eps, decay, momentum = 1e-10, 0.9, 0.5
+        ms_out = decay * ms + (1 - decay) * g**2
+        mom_out = momentum * mom + LR * g / np.sqrt(ms_out + eps)
+        self.op_type = "rmsprop"
+        self.inputs = {
+            "Param": p, "Grad": g, "MeanSquare": ms, "Moment": mom,
+            "LearningRate": np.asarray([LR], "float32"),
+        }
+        self.attrs = {"epsilon": eps, "decay": decay, "momentum": momentum}
+        self.outputs = {
+            "ParamOut": p - mom_out,
+            "MeanSquareOut": ms_out,
+            "MomentOut": mom_out,
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestRmspropCenteredOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(10)
+        p, g = _pg(rng)
+        ms = rng.uniform(0.5, 1, p.shape).astype("float32")
+        mg = rng.uniform(-0.1, 0.1, p.shape).astype("float32")
+        mom = rng.uniform(-1, 1, p.shape).astype("float32")
+        eps, decay, momentum = 1e-10, 0.9, 0.5
+        ms_out = decay * ms + (1 - decay) * g**2
+        mg_out = decay * mg + (1 - decay) * g
+        mom_out = momentum * mom + LR * g / np.sqrt(ms_out - mg_out**2 + eps)
+        self.op_type = "rmsprop"
+        self.inputs = {
+            "Param": p, "Grad": g, "MeanSquare": ms, "MeanGrad": mg,
+            "Moment": mom, "LearningRate": np.asarray([LR], "float32"),
+        }
+        self.attrs = {
+            "epsilon": eps, "decay": decay, "momentum": momentum,
+            "centered": True,
+        }
+        self.outputs = {
+            "ParamOut": p - mom_out,
+            "MeanSquareOut": ms_out,
+            "MomentOut": mom_out,
+            "MeanGradOut": mg_out,
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestAdadeltaOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(11)
+        p, g = _pg(rng)
+        asg = rng.uniform(0, 1, p.shape).astype("float32")
+        asu = rng.uniform(0, 1, p.shape).astype("float32")
+        rho, eps = 0.95, 1e-6
+        asg_out = rho * asg + (1 - rho) * g**2
+        update = -np.sqrt((asu + eps) / (asg_out + eps)) * g
+        asu_out = rho * asu + (1 - rho) * update**2
+        self.op_type = "adadelta"
+        self.inputs = {
+            "Param": p, "Grad": g,
+            "AvgSquaredGrad": asg, "AvgSquaredUpdate": asu,
+        }
+        self.attrs = {"rho": rho, "epsilon": eps}
+        self.outputs = {
+            "ParamOut": p + update,
+            "AvgSquaredGradOut": asg_out,
+            "AvgSquaredUpdateOut": asu_out,
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestFtrlOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(12)
+        p, g = _pg(rng)
+        sq = rng.uniform(0.1, 1, p.shape).astype("float32")
+        lin = rng.uniform(-1, 1, p.shape).astype("float32")
+        l1, l2, lr_power = 0.1, 0.2, -0.5
+        new_acc = sq + g**2
+        sigma = (np.sqrt(new_acc) - np.sqrt(sq)) / LR
+        lin_out = lin + g - sigma * p
+        x_den = l2 + np.sqrt(new_acc) / LR
+        pre = np.clip(lin_out, -l1, l1) - lin_out
+        self.op_type = "ftrl"
+        self.inputs = {
+            "Param": p, "Grad": g,
+            "SquaredAccumulator": sq, "LinearAccumulator": lin,
+            "LearningRate": np.asarray([LR], "float32"),
+        }
+        self.attrs = {"l1": l1, "l2": l2, "lr_power": lr_power}
+        self.outputs = {
+            "ParamOut": pre / x_den,
+            "SquaredAccumOut": new_acc,
+            "LinearAccumOut": lin_out,
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+def _prox_np(p, lr, l1, l2):
+    return np.sign(p) * np.maximum(np.abs(p) - lr * l1, 0.0) / (1.0 + lr * l2)
+
+
+class TestProximalGDOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(13)
+        p, g = _pg(rng)
+        l1, l2 = 0.1, 0.2
+        self.op_type = "proximal_gd"
+        self.inputs = {
+            "Param": p, "Grad": g,
+            "LearningRate": np.asarray([LR], "float32"),
+        }
+        self.attrs = {"l1": l1, "l2": l2}
+        self.outputs = {"ParamOut": _prox_np(p - LR * g, LR, l1, l2)}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestProximalAdagradOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(14)
+        p, g = _pg(rng)
+        mom = rng.uniform(0.1, 1, p.shape).astype("float32")
+        l1, l2 = 0.1, 0.2
+        mom_out = mom + g**2
+        prox_param = p - LR * g / np.sqrt(mom_out + 1e-10)
+        self.op_type = "proximal_adagrad"
+        self.inputs = {
+            "Param": p, "Grad": g, "Moment": mom,
+            "LearningRate": np.asarray([LR], "float32"),
+        }
+        self.attrs = {"l1": l1, "l2": l2}
+        self.outputs = {
+            "ParamOut": _prox_np(prox_param, LR, l1, l2),
+            "MomentOut": mom_out,
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -1,0 +1,502 @@
+"""OpTest harness sweep: sequence (LoD) tier + RNN building blocks.
+
+Reference pattern: unittests/test_sequence_*_op.py, test_lstm_unit_op.py,
+test_gru_unit_op.py, test_lstm_op.py, test_gru_op.py. Ragged semantics ride
+the SeqLen companion input (the padded-dense LoD convention); every numpy
+reference masks past the row length exactly as the reference computes on
+compacted LoD rows.
+"""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _mask(x, lens):
+    t = x.shape[1]
+    m = np.arange(t)[None, :] < np.asarray(lens)[:, None]
+    return x * m.reshape(m.shape + (1,) * (x.ndim - 2))
+
+
+B, T, D = 2, 4, 3
+LENS = np.asarray([3, 4], "int32")
+
+
+class TestSequencePoolSumOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(1)
+        x = rng.uniform(-1, 1, (B, T, D)).astype("float32")
+        self.op_type = "sequence_pool"
+        self.inputs = {"X": x, "SeqLen": LENS}
+        self.attrs = {"pooltype": "SUM"}
+        self.outputs = {"Out": _mask(x, LENS).sum(axis=1)}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestSequencePoolSqrtOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(2)
+        x = rng.uniform(-1, 1, (B, T, D)).astype("float32")
+        self.op_type = "sequence_pool"
+        self.inputs = {"X": x, "SeqLen": LENS}
+        self.attrs = {"pooltype": "SQRT"}
+        self.outputs = {
+            "Out": _mask(x, LENS).sum(axis=1) / np.sqrt(LENS)[:, None]
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestSequenceSoftmaxOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(3)
+        x = rng.uniform(-1, 1, (B, T)).astype("float32")
+        out = np.zeros_like(x)
+        for i, l in enumerate(LENS):
+            e = np.exp(x[i, :l] - x[i, :l].max())
+            out[i, :l] = e / e.sum()
+        self.op_type = "sequence_softmax"
+        self.inputs = {"X": x, "SeqLen": LENS}
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X"], max_relative_error=0.01)
+
+
+class TestSequenceConvOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(4)
+        x = rng.uniform(-1, 1, (B, T, D)).astype("float32")
+        ctx_len, ctx_start, d_out = 3, -1, 6
+        w = rng.uniform(-0.5, 0.5, (ctx_len * D, d_out)).astype("float32")
+        xm = _mask(x, LENS)
+        cols = []
+        for k in range(ctx_len):
+            off = ctx_start + k
+            sh = np.zeros_like(xm)
+            for t in range(T):
+                src = t + off
+                if 0 <= src < T:
+                    sh[:, t] = xm[:, src]
+            cols.append(sh)
+        ctx_mat = np.concatenate(cols, axis=-1)
+        out = _mask(ctx_mat.reshape(B * T, -1).dot(w).reshape(B, T, d_out), LENS)
+        self.op_type = "sequence_conv"
+        self.inputs = {"X": x, "Filter": w, "SeqLen": LENS}
+        self.attrs = {"contextLength": ctx_len, "contextStart": ctx_start}
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Filter"], max_relative_error=0.01)
+
+
+class TestRowConvOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(5)
+        x = rng.uniform(-1, 1, (B, T, D)).astype("float32")
+        fc = 2
+        w = rng.uniform(-0.5, 0.5, (fc, D)).astype("float32")
+        xm = _mask(x, LENS)
+        out = np.zeros_like(xm)
+        for t in range(T):
+            for k in range(fc):
+                if t + k < T:
+                    out[:, t] += xm[:, t + k] * w[k][None, :]
+        out = _mask(out, LENS)
+        self.op_type = "row_conv"
+        self.inputs = {"X": x, "Filter": w, "SeqLen": LENS}
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X", "Filter"], max_relative_error=0.01)
+
+
+class TestSequencePadOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(6)
+        x = rng.uniform(-1, 1, (B, T, D)).astype("float32")
+        padded_len = 7
+        out = np.zeros((B, padded_len, D), "float32")
+        out[:, :T] = _mask(x, LENS)
+        self.op_type = "sequence_pad"
+        self.inputs = {
+            "X": x,
+            "PadValue": np.asarray([0.0], "float32"),
+            "SeqLen": LENS,
+        }
+        self.attrs = {"padded_length": padded_len}
+        self.outputs = {
+            "Out": out,
+            "Length": LENS.astype("int64"),
+        }
+
+    def test_check_output(self):
+        self.check_output(no_check_set=["Length"])
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+class TestSequenceUnpadOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(7)
+        x = rng.uniform(-1, 1, (B, T, D)).astype("float32")
+        self.op_type = "sequence_unpad"
+        self.inputs = {"X": x, "Length": LENS.astype("int64")}
+        self.outputs = {"Out": _mask(x, LENS)}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"], no_grad_set={"Length"})
+
+
+class TestSequenceReshapeOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(8)
+        lens = np.asarray([2, 4], "int32")
+        x = rng.uniform(-1, 1, (2, 4, 6)).astype("float32")
+        new_dim = 3
+        xm = _mask(x, lens)
+        self.op_type = "sequence_reshape"
+        self.inputs = {"X": x, "SeqLen": lens}
+        self.attrs = {"new_dim": new_dim}
+        self.outputs = {
+            "Out": xm.reshape(2, 8, 3),
+            "OutLen": lens * 2,
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestSequenceEraseOp(OpTest):
+    def setUp(self):
+        x = np.asarray(
+            [[3, 5, 3, 7, 0], [1, 2, 3, 4, 5]], "int64"
+        )
+        lens = np.asarray([4, 5], "int32")
+        # erase tokens {3}: row0 [5,7], row1 [1,2,4,5]
+        out = np.zeros_like(x)
+        out[0, :2] = [5, 7]
+        out[1, :4] = [1, 2, 4, 5]
+        self.op_type = "sequence_erase"
+        self.inputs = {"X": x, "SeqLen": lens}
+        self.attrs = {"tokens": [3]}
+        self.outputs = {"Out": out, "OutLen": np.asarray([2, 4], "int32")}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestSequenceEnumerateOp(OpTest):
+    def setUp(self):
+        x = np.asarray([[1, 2, 3, 4], [5, 6, 0, 0]], "int64")
+        lens = np.asarray([4, 2], "int32")
+        win, pad = 2, 9
+        out = np.full((2, 4, win), pad, "int64")
+        out[0] = [[1, 2], [2, 3], [3, 4], [4, pad]]
+        out[1, :2] = [[5, 6], [6, pad]]
+        out[1, 2:] = pad
+        self.op_type = "sequence_enumerate"
+        self.inputs = {"X": x, "SeqLen": lens}
+        self.attrs = {"win_size": win, "pad_value": pad}
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestSequenceSliceOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(9)
+        x = rng.uniform(-1, 1, (2, 5, 3)).astype("float32")
+        offset = np.asarray([[1], [2]], "int64")
+        length = np.asarray([[2], [3]], "int64")
+        out = np.zeros_like(x)
+        out[0, :2] = x[0, 1:3]
+        out[1, :3] = x[1, 2:5]
+        self.op_type = "sequence_slice"
+        self.inputs = {"X": x, "Offset": offset, "Length": length}
+        self.outputs = {"Out": out, "OutLen": np.asarray([2, 3], "int32")}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestSequenceScatterOp(OpTest):
+    def setUp(self):
+        x = np.ones((2, 6), "float32")
+        ids = np.asarray([[1, 3, 1], [0, 5, 2]], "int64")
+        upd = np.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], "float32")
+        lens = np.asarray([3, 2], "int32")  # row1's third update is padding
+        out = x.copy()
+        out[0, 1] += 1.0 + 3.0
+        out[0, 3] += 2.0
+        out[1, 0] += 4.0
+        out[1, 5] += 5.0
+        self.op_type = "sequence_scatter"
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd, "SeqLen": lens}
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestSequenceExpandOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(10)
+        x = rng.uniform(-1, 1, (3, D)).astype("float32")
+        y = np.zeros((3, 4, D), "float32")
+        self.op_type = "sequence_expand"
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {
+            "Out": np.broadcast_to(x[:, None], (3, 4, D)).copy()
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestSequenceExpandAsOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(11)
+        x = rng.uniform(-1, 1, (2, D)).astype("float32")
+        y = np.zeros((2, 4, D), "float32")
+        lens = np.asarray([2, 4], "int32")
+        out = np.broadcast_to(x[:, None], (2, 4, D)).copy()
+        out = _mask(out, lens)
+        self.op_type = "sequence_expand_as"
+        self.inputs = {"X": x, "Y": y, "SeqLen": lens}
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestSequenceConcatOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(12)
+        x1 = rng.uniform(-1, 1, (2, 3, D)).astype("float32")
+        x2 = rng.uniform(-1, 1, (2, 2, D)).astype("float32")
+        l1 = np.asarray([2, 3], "int32")
+        l2 = np.asarray([1, 2], "int32")
+        out = np.zeros((2, 5, D), "float32")
+        for b in range(2):
+            row = np.concatenate([x1[b, : l1[b]], x2[b, : l2[b]]])
+            out[b, : len(row)] = row
+        self.op_type = "sequence_concat"
+        self.inputs = {
+            "X": [("scx1", x1), ("scx2", x2)],
+            "SeqLen": [("scl1", l1), ("scl2", l2)],
+        }
+        self.outputs = {"Out": out, "OutLen": l1 + l2}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestSequenceReverseGradOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(13)
+        x = rng.uniform(-1, 1, (B, T, D)).astype("float32")
+        out = x.copy()
+        for i, l in enumerate(LENS):
+            out[i, :l] = x[i, :l][::-1]
+        self.op_type = "sequence_reverse"
+        self.inputs = {"X": x, "SeqLen": LENS}
+        self.outputs = {"Y": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+    def test_check_grad(self):
+        self.check_grad(["X"])
+
+
+# ---------------------------------------------------------------------------
+# RNN building blocks
+# ---------------------------------------------------------------------------
+
+
+class TestLstmUnitOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(14)
+        b, h = 3, 4
+        x = rng.uniform(-1, 1, (b, 4 * h)).astype("float32")
+        c_prev = rng.uniform(-1, 1, (b, h)).astype("float32")
+        fb = 0.5
+        gi, gf, go, gg = np.split(x.astype("f8"), 4, axis=1)
+        c = _sigmoid(gf + fb) * c_prev + _sigmoid(gi) * np.tanh(gg)
+        hid = _sigmoid(go) * np.tanh(c)
+        self.op_type = "lstm_unit"
+        self.inputs = {"X": x, "C_prev": c_prev}
+        self.attrs = {"forget_bias": fb}
+        self.outputs = {"C": c, "H": hid}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(["X", "C_prev"], max_relative_error=0.01)
+
+
+class TestGruUnitOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(15)
+        b, h = 3, 4
+        x = rng.uniform(-1, 1, (b, 3 * h)).astype("float32")
+        h_prev = rng.uniform(-1, 1, (b, h)).astype("float32")
+        w = rng.uniform(-0.5, 0.5, (h, 3 * h)).astype("float32")
+        xf = x.astype("f8")
+        g_ur = xf[:, : 2 * h] + h_prev @ w[:, : 2 * h]
+        u = _sigmoid(g_ur[:, :h])
+        r = _sigmoid(g_ur[:, h:])
+        c = np.tanh(xf[:, 2 * h :] + (r * h_prev) @ w[:, 2 * h :])
+        h_new = (1 - u) * h_prev + u * c
+        self.op_type = "gru_unit"
+        self.inputs = {"Input": x, "HiddenPrev": h_prev, "Weight": w}
+        self.outputs = {
+            "Hidden": h_new,
+            "ResetHiddenPrev": r * h_prev,
+            "Gate": np.concatenate([u, r, c], axis=-1),
+        }
+
+    def test_check_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_check_grad(self):
+        self.check_grad(
+            ["Input", "HiddenPrev", "Weight"],
+            output_names=["Hidden"],
+            max_relative_error=0.02,
+        )
+
+
+def _np_dynamic_gru(x, w, bias, lens, h0=None):
+    b, t, h3 = x.shape
+    h = h3 // 3
+    hp = np.zeros((b, h)) if h0 is None else h0.astype("f8")
+    out = np.zeros((b, t, h))
+    for step in range(t):
+        xt = x[:, step].astype("f8") + (bias.reshape(-1) if bias is not None else 0)
+        g_ur = xt[:, : 2 * h] + hp @ w[:, : 2 * h]
+        u = _sigmoid(g_ur[:, :h])
+        r = _sigmoid(g_ur[:, h:])
+        c = np.tanh(xt[:, 2 * h :] + (r * hp) @ w[:, 2 * h :])
+        h_new = (1 - u) * hp + u * c
+        m = (step < lens).reshape(-1, 1)
+        hp = np.where(m, h_new, hp)
+        out[:, step] = np.where(m, h_new, 0.0)
+    return out
+
+
+class TestDynamicGruOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(16)
+        h = 3
+        x = rng.uniform(-1, 1, (B, T, 3 * h)).astype("float32")
+        w = rng.uniform(-0.5, 0.5, (h, 3 * h)).astype("float32")
+        bias = rng.uniform(-0.2, 0.2, (1, 3 * h)).astype("float32")
+        self.op_type = "dynamic_gru"
+        self.inputs = {"Input": x, "Weight": w, "Bias": bias, "SeqLen": LENS}
+        self.outputs = {"Hidden": _np_dynamic_gru(x, w, bias, LENS)}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_check_grad(self):
+        self.check_grad(
+            ["Input", "Weight"], output_names=["Hidden"],
+            max_relative_error=0.02,
+        )
+
+
+class TestGruOpAlias(OpTest):
+    """`gru` is the batched-op name the reference registers for the same
+    computation (gru_op.cc); it shares the dynamic_gru lowering."""
+
+    def setUp(self):
+        rng = np.random.RandomState(17)
+        h = 3
+        x = rng.uniform(-1, 1, (B, T, 3 * h)).astype("float32")
+        w = rng.uniform(-0.5, 0.5, (h, 3 * h)).astype("float32")
+        self.op_type = "gru"
+        self.inputs = {"Input": x, "Weight": w, "SeqLen": LENS}
+        self.outputs = {"Hidden": _np_dynamic_gru(x, w, None, LENS)}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestDynamicLstmPeepholesOp(OpTest):
+    def setUp(self):
+        rng = np.random.RandomState(18)
+        h = 3
+        x = rng.uniform(-1, 1, (B, T, 4 * h)).astype("float32")
+        w = rng.uniform(-0.5, 0.5, (h, 4 * h)).astype("float32")
+        bias = rng.uniform(-0.2, 0.2, (1, 7 * h)).astype("float32")
+        flat = bias.reshape(-1).astype("f8")
+        gb, w_ic, w_fc, w_oc = (
+            flat[: 4 * h], flat[4 * h : 5 * h],
+            flat[5 * h : 6 * h], flat[6 * h :],
+        )
+        hp = np.zeros((B, h))
+        cp = np.zeros((B, h))
+        hidden = np.zeros((B, T, h))
+        cell = np.zeros((B, T, h))
+        for step in range(T):
+            gates = x[:, step].astype("f8") + hp @ w + gb
+            # reference layout: candidate, input, forget, output
+            gc, gi, gf, go = np.split(gates, 4, axis=1)
+            gi = gi + cp * w_ic
+            gf = gf + cp * w_fc
+            i = _sigmoid(gi)
+            f = _sigmoid(gf)
+            c_new = f * cp + i * np.tanh(gc)
+            go = go + c_new * w_oc
+            h_new = _sigmoid(go) * np.tanh(c_new)
+            m = (step < LENS).reshape(-1, 1)
+            hp = np.where(m, h_new, hp)
+            cp = np.where(m, c_new, cp)
+            hidden[:, step] = np.where(m, h_new, 0.0)
+            cell[:, step] = np.where(m, c_new, 0.0)
+        self.op_type = "dynamic_lstm"
+        self.inputs = {"Input": x, "Weight": w, "Bias": bias, "SeqLen": LENS}
+        self.attrs = {"use_peepholes": True}
+        self.outputs = {"Hidden": hidden, "Cell": cell}
+
+    def test_check_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_check_grad(self):
+        self.check_grad(
+            ["Input", "Weight"], output_names=["Hidden"],
+            max_relative_error=0.02,
+        )
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -1,0 +1,157 @@
+"""Direct single-op checks for stochastic ops (statistical assertions — a
+fixed numpy reference cannot apply) and the last structural stragglers.
+
+Reference pattern: unittests/test_gaussian_random_op.py /
+test_uniform_random_op.py check moments, test_sampling_id_op.py checks the
+support, test_random_crop_op.py checks crop membership.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Executor, Scope, scope_guard
+
+
+def run_single_op(op_type, inputs, attrs, out_slots, seed=0):
+    """Build a one-op program and run it; inputs is name->array feeding the
+    declared slots ({slot: [names]} built 1:1)."""
+    main = framework.Program()
+    with fluid.program_guard(main, framework.Program()):
+        blk = main.global_block()
+        op_inputs = {}
+        feed = {}
+        for slot, (name, arr) in inputs.items():
+            blk.create_var(
+                name=name, shape=arr.shape,
+                dtype=framework.convert_np_dtype(arr.dtype),
+            )
+            feed[name] = arr
+            op_inputs[slot] = [name]
+        out_names = []
+        op_outputs = {}
+        for slot in out_slots:
+            nm = "out_%s" % slot.lower()
+            blk.create_var(name=nm, shape=None, dtype=None)
+            op_outputs[slot] = [nm]
+            out_names.append(nm)
+        blk.append_op(type=op_type, inputs=op_inputs, outputs=op_outputs, attrs=attrs)
+    exe = Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=seed)):
+        results = exe.run(main, feed=feed, fetch_list=out_names)
+    return results
+
+
+def test_gaussian_random_moments():
+    (out,) = run_single_op(
+        op_type="gaussian_random", inputs={},
+        attrs={"shape": [2000, 10], "mean": 1.5, "std": 2.0, "dtype": "float32"},
+        out_slots=["Out"],
+    )
+    assert out.shape == (2000, 10)
+    assert abs(out.mean() - 1.5) < 0.05
+    assert abs(out.std() - 2.0) < 0.05
+
+
+def test_truncated_gaussian_random_moments_and_bounds():
+    (out,) = run_single_op(
+        op_type="truncated_gaussian_random", inputs={},
+        attrs={"shape": [2000, 10], "mean": 0.0, "std": 1.0, "dtype": "float32"},
+        out_slots=["Out"],
+    )
+    # truncation at +-2 std (reference truncated_gaussian_random_op.cc)
+    assert np.abs(out).max() <= 2.0 + 1e-5
+    assert abs(out.mean()) < 0.05
+    assert 0.8 < out.std() < 0.95  # std of N(0,1) truncated at 2 is ~0.88
+
+
+def test_uniform_random_range():
+    (out,) = run_single_op(
+        op_type="uniform_random", inputs={},
+        attrs={"shape": [1000, 8], "min": -3.0, "max": 5.0, "dtype": "float32"},
+        out_slots=["Out"],
+    )
+    assert out.min() >= -3.0 and out.max() <= 5.0
+    assert abs(out.mean() - 1.0) < 0.2
+
+
+def test_sampling_id_distribution():
+    probs = np.tile(np.asarray([[0.7, 0.2, 0.1, 0.0]], "float32"), (4000, 1))
+    (ids,) = run_single_op(
+        op_type="sampling_id", inputs={"X": ("probs", probs)},
+        attrs={}, out_slots=["Out"],
+    )
+    assert ids.shape == (4000,)
+    assert set(np.unique(ids)).issubset({0, 1, 2})
+    frac0 = (ids == 0).mean()
+    assert 0.65 < frac0 < 0.75
+
+
+def test_random_crop_is_a_window():
+    x = np.arange(9 * 9, dtype="float32").reshape(1, 9, 9)
+    (out,) = run_single_op(
+        op_type="random_crop", inputs={"X": ("rc_x", x)},
+        attrs={"shape": [4, 4]}, out_slots=["Out"],
+    )
+    assert out.shape == (1, 4, 4)
+    # a contiguous window preserves row/col strides of the source grid
+    r0 = out[0]
+    assert np.all(np.diff(r0[0]) == 1)
+    assert np.all(np.diff(r0[:, 0]) == 9)
+    assert r0[0, 0] in x[0]
+
+
+def test_shrink_rnn_memory_identity():
+    x = np.random.RandomState(0).rand(4, 3).astype("float32")
+    (out,) = run_single_op(
+        op_type="shrink_rnn_memory",
+        inputs={"X": ("srm_x", x)},
+        attrs={}, out_slots=["Out"],
+    )
+    # padded-dense design: rows are masked by the recurrent op, not dropped
+    np.testing.assert_allclose(out, x)
+
+
+def test_density_prior_box_geometry():
+    feat = np.zeros((1, 1, 2, 2), "float32")
+    image = np.zeros((1, 3, 8, 8), "float32")
+    boxes, variances = run_single_op(
+        op_type="density_prior_box",
+        inputs={"Input": ("dpb_f", feat), "Image": ("dpb_i", image)},
+        attrs={
+            "fixed_sizes": [4.0], "fixed_ratios": [1.0], "densities": [1],
+            "variances": [0.1, 0.1, 0.2, 0.2], "clip": False,
+        },
+        out_slots=["Boxes", "Variances"],
+    )
+    # one prior per cell: centered square of size 4 on an 8x8 image, step 4
+    assert boxes.shape[-1] == 4
+    b = boxes.reshape(-1, 4)
+    # cell (0,0): center (2,2), half-size 2 -> [0,0,4,4]/8
+    np.testing.assert_allclose(b[0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    v = variances.reshape(-1, 4)
+    np.testing.assert_allclose(v[0], [0.1, 0.1, 0.2, 0.2], atol=1e-6)
+
+
+def test_mine_hard_examples_max_negative():
+    # 1 positive (prior 1), neg_pos_ratio 2 -> pick 2 hardest negatives
+    cls_loss = np.asarray([[0.1, 0.9, 0.8, 0.3, 0.7]], "float32")
+    match = np.asarray([[-1, 0, -1, -1, -1]], "int32")
+    (neg,) = run_single_op(
+        op_type="mine_hard_examples",
+        inputs={
+            "ClsLoss": ("mhe_l", cls_loss),
+            "MatchIndices": ("mhe_m", match),
+        },
+        attrs={"neg_pos_ratio": 2.0},
+        out_slots=["NegIndices"],
+    )
+    picked = set(int(i) for i in neg.reshape(-1) if i >= 0)
+    # hardest unmatched priors by loss: 2 (0.8) and 4 (0.7)
+    assert picked == {2, 4}, neg
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
