@@ -1201,15 +1201,28 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, name=None):
     """Fused blockwise attention over (b, h, t, d) tensors — emits the
     Pallas flash-attention op (ops/pallas_kernels.py), the hand-tuned-kernel
     tier analog of the reference's math/jit_kernel fused primitives."""
+    from ..ops.pallas_kernels import flash_path_taken
+
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     attrs = {"causal": bool(causal)}
     if sm_scale is not None:
         attrs["sm_scale"] = float(sm_scale)
+    outputs = {"Out": [out.name]}
+    # declare the logsumexp residual exactly when the static shapes make the
+    # lowering take the Pallas path (flash_path_taken is that decision's
+    # mirror), so flash_attention_grad consumes the saved residual instead
+    # of re-running the forward inside jax.vjp (see _flash_attention_op)
+    tq = q.shape[2] if q.shape is not None and len(q.shape) == 4 else -1
+    tk = k.shape[2] if k.shape is not None and len(k.shape) == 4 else -1
+    if flash_path_taken(tq, tk, causal=bool(causal)):
+        lse = helper.create_variable_for_type_inference("float32")
+        lse.stop_gradient = True
+        outputs["Lse"] = [lse.name]
     helper.append_op(
         type="flash_attention",
         inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
-        outputs={"Out": [out.name]},
+        outputs=outputs,
         attrs=attrs,
     )
     return out

@@ -123,6 +123,18 @@ def decoder_layer(x, enc_out, slf_bias, cross_bias, cfg):
         padded = cfg.get("padded")
         if padded:
             use_flash_slf = False
+            if slf_bias is None:
+                # The dense fallback has no implicit causal mask — causality
+                # comes entirely from the caller's bias tensor. Flash callers
+                # conventionally pass slf_bias=None, which here would silently
+                # train with future-token leakage.
+                raise ValueError(
+                    "transformer decoder with use_flash and padded=True takes "
+                    "the dense masked path, which relies on the caller-supplied "
+                    "trg_slf_attn_bias for causality — got None. Pass a causal "
+                    "(+pad) bias tensor, or padded=False for the flash causal "
+                    "kernel on unpadded batches."
+                )
         else:
             if padded is None:
                 warnings.warn(
@@ -141,9 +153,14 @@ def decoder_layer(x, enc_out, slf_bias, cross_bias, cfg):
         causal=use_flash_slf,
     )
     slf = pre_post_process(x, slf, "dan", cfg["dropout"])
+    # cross-attention is never causal; flash applies whenever no additive
+    # bias is supplied (multi_head_attention falls back to the dense masked
+    # chain when cross_bias is present — same padding contract as encoder
+    # self-attention)
     cross = multi_head_attention(
         slf, enc_out, enc_out, cross_bias, cfg["d_key"], cfg["d_value"],
         cfg["d_model"], cfg["n_head"], cfg["dropout"],
+        use_flash=cfg.get("use_flash", False),
     )
     cross = pre_post_process(slf, cross, "dan", cfg["dropout"])
     ffn = positionwise_ffn(cross, cfg["d_inner"], cfg["d_model"], cfg["dropout"])

@@ -97,7 +97,7 @@ def _chunk_fwd(q, k_cur, v_cur, causal_diag, scale, interpret):
     """One ring step's flash forward on (b, h, t_loc, d): (out, lse)."""
     out, lse = pk._flash_forward(
         q, k_cur, v_cur, causal_diag, scale,
-        pk._DEF_BLOCK_Q, pk._DEF_BLOCK_K, interpret, with_lse=True,
+        None, None, interpret, with_lse=True,
     )
     return out, lse
 
@@ -106,7 +106,7 @@ def _chunk_bwd(q, k_cur, v_cur, out, lse, do, causal_diag, scale, interpret):
     """One ring step's flash backward against the GLOBAL lse."""
     return pk._flash_backward(
         q, k_cur, v_cur, out, lse, do, causal_diag, scale,
-        pk._DEF_BLOCK_Q, pk._DEF_BLOCK_K, interpret,
+        None, None, interpret,
     )
 
 

@@ -145,3 +145,94 @@ def test_transformer_use_flash_end_to_end():
             losses.append(float(np.asarray(lv).reshape(-1)[0]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_flash_streamed_long_context_tier():
+    """The long-context streamed kernels (grid-tiled K/V with VMEM scratch
+    accumulators — used when whole-side residency would overflow VMEM past
+    ~8k tokens, pallas_kernels._resident_ok) match the dense reference for
+    forward and gradients, causal and not. Forced on small shapes by
+    patching the residency predicate; on-chip validation at t=16384-65536 is
+    recorded in PROFILE.md."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    orig = pk._resident_ok
+    pk._resident_ok = lambda *a: False
+    try:
+        rng = np.random.RandomState(3)
+        b, h, t, dh = 2, 2, 256, 32
+        q = jnp.array(rng.randn(b, h, t, dh), jnp.float32)
+        k = jnp.array(rng.randn(b, h, t, dh), jnp.float32)
+        v = jnp.array(rng.randn(b, h, t, dh), jnp.float32)
+        for causal in (False, True):
+            out = pk.flash_attention(q, k, v, causal, None)
+            ref = pk._attention_reference(q, k, v, causal, dh ** -0.5)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2
+            )
+            g = jax.grad(
+                lambda a, bb, c: jnp.sum(
+                    pk.flash_attention(a, bb, c, causal, None) ** 2
+                ),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            gr = jax.grad(
+                lambda a, bb, c: jnp.sum(
+                    pk._attention_reference(a, bb, c, causal, dh ** -0.5) ** 2
+                ),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            for got, want in zip(g, gr):
+                scale = max(1.0, float(jnp.max(jnp.abs(want))))
+                np.testing.assert_allclose(
+                    np.asarray(got) / scale, np.asarray(want) / scale,
+                    rtol=2e-2, atol=2e-2,
+                )
+    finally:
+        pk._resident_ok = orig
+
+
+def test_lse_declaration_mirrors_lowering_decision():
+    """layers.flash_attention must declare Lse exactly when the lowering
+    takes the Pallas path (flash_path_taken), including the asymmetric case
+    tq=512/tk=600 non-causal where the per-direction block targets differ
+    (k target 1024 admits a whole 600-tile; the symmetric q-side predicate
+    would say no) — a mismatch would silently drop the saved residual and
+    fall back to the dense recompute-vjp backward."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    assert pk.flash_path_taken(512, 600, causal=False)
+    assert not pk.flash_tiles_ok(600)
+    assert not pk.flash_path_taken(512, 600, causal=True)  # causal k target 512
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        q = fluid.layers.data(name="fq", shape=[2, 512, 8], dtype="float32")
+        k = fluid.layers.data(name="fk", shape=[2, 600, 8], dtype="float32")
+        v = fluid.layers.data(name="fv", shape=[2, 600, 8], dtype="float32")
+        out = fluid.layers.flash_attention(q, k, v, causal=False)
+    op = next(o for o in main.global_block().ops if o.type == "flash_attention")
+    assert "Lse" in op.outputs, "Lse must be declared for the pallas path"
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "fq": rng.randn(1, 2, 512, 8).astype("float32"),
+        "fk": rng.randn(1, 2, 600, 8).astype("float32"),
+        "fv": rng.randn(1, 2, 600, 8).astype("float32"),
+    }
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=0)):
+        (got,) = exe.run(main, feed=feed, fetch_list=[out.name])
+    want = pk._attention_reference(
+        jnp.asarray(feed["fq"]), jnp.asarray(feed["fk"]), jnp.asarray(feed["fv"]),
+        False, 8 ** -0.5,
+    )
+    np.testing.assert_allclose(got, np.asarray(want), rtol=2e-3, atol=2e-3)

@@ -98,10 +98,12 @@ def test_ring_flash_ragged_falls_back():
     from paddle_tpu.parallel.ring_attention import _flash_tiles_ok
 
     rng = np.random.RandomState(4)
-    # t=20 over sp=4 -> t_loc=5: 5 % min(128,5)==0 is True, so craft a truly
-    # ragged case via block: t_loc=130 -> min(128,130)=128, 130%128 != 0
-    assert not _flash_tiles_ok(130)
-    q, k, v = _qkv(rng, b=2, h=1, t=4 * 130, d=8)
+    # _auto_block admits any t_loc <= the block target as one whole tile, so
+    # ragged now means: above the target AND not a multiple of 128
+    # (t_loc=520 -> no 128*2^k divisor, too big for a single tile)
+    assert _flash_tiles_ok(130)  # small non-multiples ride one whole tile
+    assert not _flash_tiles_ok(520)
+    q, k, v = _qkv(rng, b=2, h=1, t=4 * 520, d=8)
     mesh = make_mesh(MeshConfig(dp=2, sp=4))
     out = ring_attention_sharded(q, k, v, mesh, causal=True)  # auto -> dense
     ref = ring_attention(q, k, v, causal=True)
