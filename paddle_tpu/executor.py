@@ -21,6 +21,7 @@ that stochastic ops consume.
 
 import itertools
 import threading
+import weakref
 
 import numpy as np
 
@@ -116,14 +117,35 @@ def _as_feed_array(value, var):
     if var is not None and var.dtype is not None:
         want = jnp.bfloat16 if var.dtype == "bfloat16" else np.dtype(var.dtype)
     if isinstance(value, jax.Array):
-        # device-resident feed: cast on device if needed, no host round-trip
-        if want is not None and value.dtype != jnp.dtype(want):
-            value = value.astype(want)
+        # device-resident feed: any needed cast happens inside the compiled
+        # block (_CompiledBlock.run), where it fuses into the step instead of
+        # costing an eager per-step device dispatch. Paths that do NOT run
+        # through _CompiledBlock.run (per-op profiling, host-op segmented
+        # programs) eager-cast via _eager_cast_feeds below.
         return value
     arr = np.asarray(value)
     if want is not None:
         arr = arr.astype(want)
     return arr
+
+
+def _eager_cast_feeds(block, feed_arrays):
+    """Cast device-resident feeds to their declared var dtypes NOW — for
+    execution paths that bypass _CompiledBlock.run's fused trace-time cast
+    (_PerOpProfiledBlock, _SegmentedBlock), which consume env values
+    directly."""
+    out = {}
+    for name, value in feed_arrays.items():
+        if isinstance(value, jax.Array):
+            var = block.vars.get(name)
+            if var is not None and var.dtype is not None:
+                want = jnp.dtype(
+                    jnp.bfloat16 if var.dtype == "bfloat16" else np.dtype(var.dtype)
+                )
+                if value.dtype != want:
+                    value = value.astype(want)
+        out[name] = value
+    return out
 
 
 class _CompiledBlock:
@@ -201,7 +223,28 @@ class _CompiledBlock:
 
         ops_ = self.ops
 
+        # declared feed-var dtypes: device-resident feeds arrive uncast (see
+        # _as_feed_array) and are cast here at trace time, so the convert
+        # fuses into the compiled step
+        feed_want = {}
+        for _n in self.feed_names:
+            _v = block.vars.get(_n)
+            if _v is None and block.has_var_recursive(_n):
+                _v = block._var_recursive(_n)
+            if _v is not None and getattr(_v, "dtype", None) is not None:
+                feed_want[_n] = jnp.dtype(
+                    jnp.bfloat16 if _v.dtype == "bfloat16" else np.dtype(_v.dtype)
+                )
+
         def run(feeds, ro_state, mut_state, rng_key):
+            feeds = {
+                n: (
+                    v.astype(feed_want[n])
+                    if n in feed_want and v.dtype != feed_want[n]
+                    else v
+                )
+                for n, v in feeds.items()
+            }
             env = {}
             env.update(ro_state)
             env.update(mut_state)
@@ -347,6 +390,10 @@ class _SegmentedBlock:
         )
 
     def __call__(self, scope, feed_arrays):
+        # feeds enter the scope directly (segments read them as state), so
+        # declared-dtype casts must happen eagerly here — the fused
+        # trace-time cast only covers _CompiledBlock-run feeds
+        feed_arrays = _eager_cast_feeds(self.block, feed_arrays)
         for name, value in feed_arrays.items():
             scope.set_var(
                 name, value if isinstance(value, jax.Array) else jnp.asarray(value)
@@ -462,7 +509,7 @@ class Executor:
                 program, block, list(feed_arrays.keys()), fetch_names
             )
             with _prof.RecordEvent("run/block0"):
-                fetches = compiled(scope, feed_arrays)
+                fetches = compiled(scope, _eager_cast_feeds(block, feed_arrays))
             return self._finish_run(
                 compiled, scope, fetch_names, fetches, return_numpy
             )
@@ -493,7 +540,45 @@ class Executor:
                 # reference FLAGS_benchmark: wait so host timing is real step
                 # time (operator.cc:769 dev_ctx->Wait)
                 fetches = [jax.block_until_ready(f) for f in fetches]
+        # correlation seed for profiler.device_op_profile: the block + feed
+        # AVALS of the latest run (abstract shapes only — storing the
+        # concrete arrays would pin a whole batch of device memory), from
+        # which compiled_hlo() lowers the metadata-carrying HLO text
+        if isinstance(compiled, _CompiledBlock):
+            # weakref: _last_run must not keep a dropped scope's parameters
+            # alive in device memory
+            self._last_run = (
+                compiled,
+                weakref.ref(scope),
+                {
+                    n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for n, a in feed_arrays.items()
+                },
+            )
         return self._finish_run(compiled, scope, fetch_names, fetches, return_numpy)
+
+    def compiled_hlo(self):
+        """Post-optimization HLO text of the most recently run compiled
+        block. Every instruction carries op_name=".../<op type>/..." metadata
+        (registry.lower_ops wraps each lowering in jax.named_scope), so
+        profiler.device_op_profile can fold an xla_trace's per-HLO device
+        timings back onto framework op types — the TPU analog of the
+        reference's CUPTI kernel→op correlation (platform/device_tracer.cc).
+        The compile is served from the backend's compilation cache after a
+        run, so this does not recompile."""
+        last = getattr(self, "_last_run", None)
+        if last is None:
+            raise RuntimeError("compiled_hlo() needs a prior Executor.run")
+        compiled, scope_ref, feed_avals = last
+        scope = scope_ref()
+        if scope is None:
+            raise RuntimeError(
+                "compiled_hlo(): the scope of the last run no longer exists"
+            )
+        ro = {n: scope.vars[n] for n in compiled.ro_names}
+        mut = {n: scope.vars[n] for n in compiled.mut_names}
+        lowered = compiled.jitted.lower(feed_avals, ro, mut, scope.rng_key)
+        return lowered.compile().as_text()
 
     @staticmethod
     def _finish_run(compiled, scope, fetch_names, fetches, return_numpy):

@@ -223,7 +223,13 @@ def lower_ops(ctx, ops, env):
                 ins[slot] = [
                     env[n] if n != EMPTY_VAR_NAME else None for n in names
                 ]
-        outs = opdef.lower(ctx, ins, op.attrs)
+        # named_scope tags every HLO this op emits with op_name="…/<type>/…"
+        # metadata — the correlation key profiler.device_op_profile uses to
+        # fold XLA's per-HLO device timings back onto framework op types
+        # (the reference correlates CUPTI kernels to ops the same way,
+        # platform/device_tracer.cc)
+        with jax.named_scope(op.type):
+            outs = opdef.lower(ctx, ins, op.attrs)
         for slot, names in op.outputs.items():
             vals = outs.get(slot)
             if vals is None:

@@ -17,6 +17,7 @@ kept API-compatible.
 
 import contextlib
 import json
+import os
 import threading
 import time
 
@@ -28,6 +29,7 @@ __all__ = [
     "profiler",
     "is_profiling",
     "xla_trace",
+    "device_op_profile",
 ]
 
 _state = {"on": False, "mode": "All"}
@@ -170,3 +172,91 @@ def cuda_profiler(*args, **kwargs):
     """API-compat shim for reference profiler.cuda_profiler (nvprof control);
     on TPU use xla_trace instead."""
     yield
+
+
+def _hlo_op_map(hlo_text):
+    """instruction name -> framework op type, parsed from the compiled HLO's
+    op_name metadata (registry.lower_ops names every op's scope after its
+    type, so paths look like 'jit(run)/<op type>/<prim>' — sub-block ops
+    attribute to their enclosing control-flow op)."""
+    import re
+
+    mapping = {}
+    for m in re.finditer(r'%([\w.\-]+) = [^\n]*op_name="([^"]+)"', hlo_text):
+        path = m.group(2).split("/")
+        key = None
+        for seg in path:
+            # skip jit/transform wrappers and arg-pytree paths like
+            # "feeds['img']" / "mut_state['w_0']" (donation copies — those
+            # group under their HLO opcode instead)
+            if seg.startswith("jit(") or seg.startswith("transpose(") or "[" in seg:
+                continue
+            key = seg
+            break
+        if key:
+            mapping[m.group(1)] = key
+    return mapping
+
+
+def device_op_profile(log_dir, hlo_text=None, print_table=True):
+    """Fold an xla_trace's per-HLO device timings back onto framework op
+    types (ROADMAP 10; reference analog: device_tracer.cc correlating CUPTI
+    kernels to RecordEvent annotations into the same profiler table).
+
+    `log_dir` is the directory a profiler.xla_trace wrote. With `hlo_text`
+    (from Executor.compiled_hlo()) each HLO instruction is attributed to the
+    framework op whose lowering emitted it; without it, instructions
+    aggregate by HLO opcode. Returns {key: [count, total_ms, min_ms, max_ms]}
+    in stop_profiler's table shape; prints the same report format."""
+    import glob as _glob
+
+    from jax.profiler import ProfileData
+
+    paths = sorted(
+        _glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
+    )
+    if not paths:
+        raise FileNotFoundError("no xplane.pb under %r — run xla_trace first" % log_dir)
+    mapping = _hlo_op_map(hlo_text) if hlo_text else {}
+    table = {}
+    pd = ProfileData.from_file(paths[-1])
+    for plane in pd.planes:
+        if "TPU" not in plane.name and "GPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = ev.name.lstrip("%").split(" ")[0]
+                key = mapping.get(name)
+                if key is None:
+                    # strip SSA suffix then retry, else group by HLO opcode
+                    key = mapping.get(name.split(".")[0])
+                if key is None:
+                    key = "hlo:" + name.split(".")[0]
+                dur_ms = None
+                for k, v in ev.stats or []:
+                    if k == "device_duration_ps":
+                        dur_ms = float(v) / 1e9
+                        break
+                if dur_ms is None:
+                    continue
+                row = table.setdefault(key, [0, 0.0, float("inf"), 0.0])
+                row[0] += 1
+                row[1] += dur_ms
+                row[2] = min(row[2], dur_ms)
+                row[3] = max(row[3], dur_ms)
+    if print_table and table:
+        rows = sorted(table.items(), key=lambda kv: -kv[1][1])
+        lines = [
+            "------------------->    Device Profiling Report (XLA)    <-------------------",
+            "%-50s %8s %12s %12s %12s %12s"
+            % ("Op", "Kernels", "Total(ms)", "Min(ms)", "Max(ms)", "Ave(ms)"),
+        ]
+        for name, (calls, total, mn, mx) in rows:
+            lines.append(
+                "%-50s %8d %12.4f %12.4f %12.4f %12.4f"
+                % (name[:50], calls, total, mn, mx, total / calls)
+            )
+        print("\n".join(lines))
+    return table

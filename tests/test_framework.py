@@ -240,3 +240,34 @@ def test_profile_ops_mode():
     np.testing.assert_allclose(per_op, jitted, rtol=1e-5)
     assert any(name.endswith("op/mul") for name in table), table.keys()
     assert any(name.endswith("op/relu") for name in table), table.keys()
+
+
+def test_device_op_profile_correlation(tmp_path):
+    """ROADMAP 10: Executor.compiled_hlo() carries op_name metadata naming
+    each framework op's scope (registry.lower_ops named_scope), and
+    profiler._hlo_op_map correlates HLO instruction names back to op types —
+    the CUPTI-kernel→op correlation analog (reference device_tracer.cc).
+    The xplane aggregation itself needs a real TPU/GPU plane, so on the CPU
+    test backend device_op_profile degrades to an empty table."""
+    import paddle_tpu.profiler as prof
+    from paddle_tpu.executor import Scope, scope_guard
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="dopx", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3, act="relu")
+        loss = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"dopx": np.ones((2, 4), "float32")}
+    with scope_guard(Scope(seed=1)):
+        exe.run(startup)
+        tdir = str(tmp_path / "xla")
+        with fluid.profiler.xla_trace(tdir):
+            exe.run(main, feed=feed, fetch_list=[loss.name])
+        hlo = exe.compiled_hlo()
+        assert 'op_name="' in hlo
+        mapping = prof._hlo_op_map(hlo)
+        assert mapping, "no op_name metadata parsed"
+        assert {"mul", "relu", "mean"} & set(mapping.values()), set(mapping.values())
+        table = prof.device_op_profile(tdir, hlo, print_table=False)
+        assert isinstance(table, dict)
