@@ -345,3 +345,62 @@ if __name__ == "__main__":
     import unittest
 
     unittest.main()
+
+
+class TestQuantizeAbsMaxOp(OpTest):
+    """Real-int8 serving twin of fake_quantize_abs_max (convert_to_int8)."""
+
+    def setUp(self):
+        rng = np.random.RandomState(12)
+        x = rng.uniform(-3, 3, (4, 6)).astype("float32")
+        scale = np.abs(x).max()
+        self.op_type = "quantize_abs_max"
+        self.inputs = {"X": x}
+        self.attrs = {"bit_length": 8}
+        self.outputs = {
+            "Out": np.clip(np.round(x / scale * 127.0), -127, 127).astype("int8"),
+            "OutScale": np.asarray([scale], "float32"),
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestInt8MulOp(OpTest):
+    """int8 levels x int8 levels -> f32 level-products (MXU int8 path)."""
+
+    def setUp(self):
+        rng = np.random.RandomState(13)
+        x = rng.randint(-127, 128, (3, 8)).astype("int8")
+        y = rng.randint(-127, 128, (8, 4)).astype("int8")
+        self.op_type = "int8_mul"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {
+            "Out": (x.astype(np.int64) @ y.astype(np.int64)).astype("float32")
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestInt8Conv2dOp(OpTest):
+    """int8 conv with int32 accumulate -> f32 levels."""
+
+    def setUp(self):
+        rng = np.random.RandomState(14)
+        x = rng.randint(-5, 6, (2, 3, 6, 6)).astype("int8")
+        w = rng.randint(-5, 6, (4, 3, 3, 3)).astype("int8")
+        import jax
+
+        ref = jax.lax.conv_general_dilated(
+            x.astype("int32"), w.astype("int32"), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        self.op_type = "int8_conv2d"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1]}
+        self.outputs = {"Output": np.asarray(ref).astype("float32")}
+
+    def test_check_output(self):
+        self.check_output()

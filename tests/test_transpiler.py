@@ -362,6 +362,40 @@ class TestQuantizeTranspiler:
         # O(1) logits errors up to ~0.35 are expected
         np.testing.assert_allclose(ref_logits, frozen_logits, rtol=0.25, atol=0.3)
 
+    def test_convert_to_int8_serving(self):
+        """Real-int8 serving (convert_to_int8): weights re-typed to int8 in
+        scope, activation quant emits int8, mul runs as int8_mul (MXU
+        int8x-int32 path) — numerically identical to the frozen float-level
+        program up to f32 accumulation rounding."""
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+                h = fluid.layers.fc(x, size=32, act="relu")
+                logits = fluid.layers.fc(h, size=4)
+
+        qt = QuantizeTranspiler()
+        qt.training_transpile(main, startup)
+        rng = np.random.RandomState(7)
+        scope = Scope(seed=5)
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            xb = rng.randn(6, 16).astype(np.float32)
+            infer = main.clone(for_test=True)
+            qt.freeze_program(infer, scope)
+            (frozen_out,) = exe.run(infer, feed={"x": xb}, fetch_list=[logits])
+            qt.convert_to_int8(infer, scope)
+            types = _op_types(infer)
+            assert "int8_mul" in types, types
+            assert "quantize_abs_max" in types, types
+            assert "fake_quantize_abs_max" not in types, types
+            import jax.numpy as jnp
+            for name in infer._quantized_weights:
+                assert scope.find_var(name).dtype == jnp.int8
+            (int8_out,) = exe.run(infer, feed={"x": xb}, fetch_list=[logits])
+        np.testing.assert_allclose(frozen_out, int8_out, rtol=1e-4, atol=1e-4)
+
 
 class TestBf16Transpiler:
     def test_inference_bf16(self):
