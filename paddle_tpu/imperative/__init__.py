@@ -14,7 +14,11 @@ means XLA can jit the whole layer body (layer.jit()) without changing user
 code — the per-op dispatch the reference's tracer did never exists here.
 """
 
+from . import nn  # noqa: F401
 from .base import Tape, Variable, enabled, guard, to_variable  # noqa: F401
 from .layers import Layer, PyLayer  # noqa: F401
 
-__all__ = ["guard", "enabled", "to_variable", "Variable", "Layer", "PyLayer", "Tape"]
+__all__ = [
+    "guard", "enabled", "to_variable", "Variable", "Layer", "PyLayer", "Tape",
+    "nn",
+]

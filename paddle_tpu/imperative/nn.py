@@ -1,0 +1,253 @@
+"""Eager Layer library (reference trajectory: imperative/nn.py grew Conv2D/
+Pool2D/FC/BatchNorm/Embedding in the releases following 1.2 — this provides
+the same usability tier over our tape, each layer a Layer subclass whose
+forward is jnp math, so tape.backward()/jit() work unchanged).
+
+Shapes/attrs mirror the graph-mode layers (layers/nn.py) where both exist;
+docstrings cite the graph op each eager layer corresponds to.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import Layer
+
+__all__ = [
+    "FC",
+    "Conv2D",
+    "Pool2D",
+    "BatchNorm",
+    "Embedding",
+    "LayerNorm",
+    "SGDOptimizer",
+    "AdamOptimizer",
+]
+
+
+class FC(Layer):
+    """Eager fully-connected (graph analog: layers.fc / mul+elementwise_add).
+    Flattens trailing dims like num_flatten_dims=1."""
+
+    def __init__(self, size, input_dim, act=None, bias_attr=True, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._size = size
+        self._act = act
+        self.weight = self.create_parameter([input_dim, size])
+        self.bias = self.create_parameter([size], initializer=0.0) if bias_attr else None
+
+    def forward(self, x, *params):
+        w = params[0]
+        b = params[1] if self.bias is not None else None
+        x2 = x.reshape(x.shape[0], -1)
+        y = x2 @ w
+        if b is not None:
+            y = y + b
+        return _apply_act(y, self._act)
+
+
+def _apply_act(y, act):
+    if act is None:
+        return y
+    if act == "relu":
+        return jnp.maximum(y, 0)
+    if act == "tanh":
+        return jnp.tanh(y)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(y)
+    if act == "softmax":
+        return jax.nn.softmax(y, axis=-1)
+    raise ValueError("unsupported act %r" % act)
+
+
+class Conv2D(Layer):
+    """Eager NCHW conv (graph analog: layers.conv2d / conv2d op)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, groups=1, act=None, bias_attr=True, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size,) * 2
+        st = stride if isinstance(stride, (list, tuple)) else (stride,) * 2
+        pd = padding if isinstance(padding, (list, tuple)) else (padding,) * 2
+        self._stride, self._padding, self._groups, self._act = st, pd, groups, act
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups, fs[0], fs[1]]
+        )
+        self.bias = (
+            self.create_parameter([num_filters], initializer=0.0) if bias_attr else None
+        )
+
+    def forward(self, x, *params):
+        w = params[0]
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=self._stride,
+            padding=[(self._padding[0],) * 2, (self._padding[1],) * 2],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self._groups,
+        )
+        if self.bias is not None:
+            y = y + params[1][None, :, None, None]
+        return _apply_act(y, self._act)
+
+
+class Pool2D(Layer):
+    """Eager pool (graph analog: layers.pool2d / pool2d op)."""
+
+    def __init__(self, pool_size=2, pool_type="max", pool_stride=None,
+                 pool_padding=0, global_pooling=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._size = (pool_size,) * 2 if np.isscalar(pool_size) else tuple(pool_size)
+        self._stride = (
+            self._size if pool_stride is None
+            else ((pool_stride,) * 2 if np.isscalar(pool_stride) else tuple(pool_stride))
+        )
+        self._pad = (pool_padding,) * 2 if np.isscalar(pool_padding) else tuple(pool_padding)
+        self._type = pool_type
+        self._global = global_pooling
+
+    def forward(self, x):
+        if self._global:
+            return jnp.mean(x, axis=(2, 3), keepdims=True) if self._type == "avg" \
+                else jnp.max(x, axis=(2, 3), keepdims=True)
+        dims = (1, 1) + self._size
+        strides = (1, 1) + self._stride
+        pads = ((0, 0), (0, 0), (self._pad[0],) * 2, (self._pad[1],) * 2)
+        if self._type == "max":
+            return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides, pads)
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+        # exclusive average like the graph pool2d op's default: padded
+        # positions don't count toward the divisor
+        ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pads)
+        return s / cnt
+
+
+class Embedding(Layer):
+    """Eager embedding lookup (graph analog: layers.embedding / lookup_table)."""
+
+    def __init__(self, size, padding_idx=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._padding_idx = padding_idx
+        self.weight = self.create_parameter(list(size))
+
+    def forward(self, ids, *params):
+        w = params[0]
+        ids = ids.reshape(ids.shape[0], -1).astype(jnp.int32)
+        out = jnp.take(w, ids, axis=0)
+        if self._padding_idx is not None:
+            mask = (ids != self._padding_idx)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out
+
+
+class BatchNorm(Layer):
+    """Eager batch norm over NCHW/NC (graph analog: batch_norm op). Train
+    mode normalizes with batch stats and maintains running stats as
+    non-trainable buffers updated OUTSIDE the tape (an eager convenience the
+    graph op does in-graph); eval mode uses the running stats."""
+
+    def __init__(self, num_channels, momentum=0.9, epsilon=1e-5, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._momentum, self._eps = momentum, epsilon
+        self.scale = self.create_parameter([num_channels], initializer=1.0)
+        self.shift = self.create_parameter([num_channels], initializer=0.0)
+        self._mean = np.zeros(num_channels, dtype)
+        self._var = np.ones(num_channels, dtype)
+        self.training = True
+
+    def forward(self, x, *params):
+        scale, shift = params
+        axes = (0,) + tuple(range(2, x.ndim))
+        if self.training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+        else:
+            mean = jnp.asarray(self._mean)
+            var = jnp.asarray(self._var)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        y = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + self._eps)
+        return y * scale.reshape(shape) + shift.reshape(shape)
+
+    def __call__(self, *inputs):
+        out = super().__call__(*inputs)
+        if self.training:
+            # running-stat update: reduce on DEVICE, transfer only the [C]
+            # results (a host-side recompute would sync the full activation)
+            x = inputs[0].value if hasattr(inputs[0], "value") else jnp.asarray(inputs[0])
+            axes = (0,) + tuple(range(2, x.ndim))
+            m = self._momentum
+            self._mean = m * self._mean + (1 - m) * np.asarray(jnp.mean(x, axis=axes))
+            self._var = m * self._var + (1 - m) * np.asarray(jnp.var(x, axis=axes))
+        return out
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        self.training = True
+        return self
+
+
+class LayerNorm(Layer):
+    """Eager layer norm over the last dim (graph analog: layer_norm op)."""
+
+    def __init__(self, normalized_shape, epsilon=1e-5, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._eps = epsilon
+        n = int(np.prod(np.atleast_1d(normalized_shape)))
+        self.scale = self.create_parameter([n], initializer=1.0)
+        self.shift = self.create_parameter([n], initializer=0.0)
+
+    def forward(self, x, *params):
+        scale, shift = params
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + self._eps) * scale + shift
+
+
+class SGDOptimizer:
+    """Eager SGD over Layer.parameters() (graph analog: optimizer.SGD —
+    here a step() consuming each param's tape gradient)."""
+
+    def __init__(self, parameters, learning_rate=0.01):
+        self._params = list(parameters)
+        self._lr = learning_rate
+
+    def step(self):
+        for p in self._params:
+            if p._grad is not None:
+                p.value = p.value - self._lr * p._grad
+
+    def clear_gradients(self):
+        for p in self._params:
+            p.clear_gradient()
+
+
+class AdamOptimizer:
+    """Eager Adam (graph analog: optimizer.Adam; same update math as the
+    adam op lowering, ops/core_ops.py)."""
+
+    def __init__(self, parameters, learning_rate=1e-3, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8):
+        self._params = list(parameters)
+        self._lr, self._b1, self._b2, self._eps = learning_rate, beta1, beta2, epsilon
+        self._m = [jnp.zeros_like(p.value) for p in self._params]
+        self._v = [jnp.zeros_like(p.value) for p in self._params]
+        self._t = 0
+
+    def step(self):
+        self._t += 1
+        b1, b2 = self._b1, self._b2
+        lr_t = self._lr * (1 - b2 ** self._t) ** 0.5 / (1 - b1 ** self._t)
+        for i, p in enumerate(self._params):
+            g = p._grad
+            if g is None:
+                continue
+            self._m[i] = b1 * self._m[i] + (1 - b1) * g
+            self._v[i] = b2 * self._v[i] + (1 - b2) * jnp.square(g)
+            p.value = p.value - lr_t * self._m[i] / (jnp.sqrt(self._v[i]) + self._eps)
+
+    def clear_gradients(self):
+        for p in self._params:
+            p.clear_gradient()

@@ -1,0 +1,169 @@
+"""Eager Layer-library tests (imperative/nn.py — the usability tier the
+reference grew right after 1.2; reference test pattern:
+unittests/test_imperative.py training a small net under guard()).
+
+Numerics are checked against either numpy references or the graph-mode ops
+they mirror; the LeNet test checks end-to-end eager training convergence
+with the eager Adam."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import imperative
+from paddle_tpu.imperative import nn
+
+
+def test_fc_forward_backward():
+    with imperative.guard():
+        fc = nn.FC(size=3, input_dim=4)
+        x = np.random.RandomState(0).randn(2, 4).astype("float32")
+        y = fc(x)
+        w = np.asarray(fc.weight.value)
+        b = np.asarray(fc.bias.value)
+        np.testing.assert_allclose(y.numpy(), x @ w + b, rtol=1e-5, atol=1e-5)
+        loss = imperative.to_variable(y.value.sum())
+        # trace a reduction so backward reaches fc's params
+        s = nn.FC(size=1, input_dim=3, bias_attr=False)
+        z = s(y)
+        z2 = imperative.Layer()
+        # scalar loss via a PyLayer-free path: another traced call
+        class Sum(imperative.Layer):
+            def forward(self, t):
+                import jax.numpy as jnp
+                return jnp.sum(t)
+        out = Sum()(z)
+        out.backward()
+        assert fc.weight.gradient() is not None
+        assert fc.weight.gradient().shape == (4, 3)
+
+
+def test_conv_pool_match_graph_ops():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    with imperative.guard():
+        conv = nn.Conv2D(num_channels=3, num_filters=4, filter_size=3, padding=1)
+        pool = nn.Pool2D(pool_size=2, pool_type="max")
+        y = pool(conv(x))
+        assert y.shape == (2, 4, 4, 4)
+        # numpy reference for the pool of conv output
+        import jax
+        w = np.asarray(conv.weight.value)
+        b = np.asarray(conv.bias.value)
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        ) + b[None, :, None, None]
+        ref = np.asarray(ref).reshape(2, 4, 4, 2, 4, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(y.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_train_eval_and_running_stats():
+    rng = np.random.RandomState(2)
+    x = (rng.randn(8, 5, 3, 3) * 2 + 1).astype("float32")
+    with imperative.guard():
+        bn = nn.BatchNorm(5, momentum=0.5)
+        y = bn(x)
+        # train mode: per-channel batch normalization
+        got = y.numpy()
+        m = x.mean(axis=(0, 2, 3), keepdims=True)
+        v = x.var(axis=(0, 2, 3), keepdims=True)
+        np.testing.assert_allclose(got, (x - m) / np.sqrt(v + 1e-5), rtol=1e-4, atol=1e-4)
+        # running stats moved toward the batch stats
+        assert not np.allclose(bn._mean, 0)
+        bn.eval()
+        y2 = bn(x)
+        ref = (x - bn._mean[None, :, None, None]) / np.sqrt(
+            bn._var[None, :, None, None] + 1e-5
+        )
+        np.testing.assert_allclose(y2.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_embedding_and_layernorm():
+    rng = np.random.RandomState(3)
+    with imperative.guard():
+        emb = nn.Embedding(size=[10, 6], padding_idx=0)
+        ids = np.array([[1, 0], [4, 7]], dtype="int64")
+        out = emb(ids)
+        w = np.asarray(emb.weight.value)
+        assert out.shape == (2, 2, 6)
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(6), atol=0)
+        np.testing.assert_allclose(out.numpy()[1, 0], w[4], rtol=1e-6)
+
+        ln = nn.LayerNorm(6)
+        x = rng.randn(4, 6).astype("float32")
+        y = ln(x)
+        mu = x.mean(-1, keepdims=True)
+        sd = np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(y.numpy(), (x - mu) / sd, rtol=1e-4, atol=1e-4)
+
+
+def test_eager_lenet_converges():
+    """End-to-end: eager LeNet on a separable toy problem with eager Adam —
+    loss decreases (reference test_imperative_mnist pattern)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+
+    class LeNet(imperative.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = self.add_sublayer(
+                nn.Conv2D(num_channels=1, num_filters=4, filter_size=3, padding=1, act="relu")
+            )
+            self.pool = self.add_sublayer(nn.Pool2D(pool_size=2))
+            self.fc = self.add_sublayer(nn.FC(size=2, input_dim=4 * 4 * 4))
+
+        def __call__(self, x, y):
+            h = self.pool(self.conv(x))
+            logits = self.fc(h)
+
+            class Loss(imperative.Layer):
+                def forward(self, lg, yy):
+                    p = jax.nn.log_softmax(lg)
+                    onehot = jax.nn.one_hot(yy, 2)
+                    return -jnp.mean(jnp.sum(onehot * p, axis=-1))
+
+            import jax
+            return Loss()(logits, imperative.Variable(y, stop_gradient=True))
+
+    def make_batch(n=32):
+        y = rng.randint(0, 2, n)
+        x = rng.randn(n, 1, 8, 8).astype("float32") + y[:, None, None, None] * 2.0
+        return x, y.astype("int32")
+
+    np.random.seed(0)  # Layer.create_parameter draws from the global RNG
+    with imperative.guard():
+        net = LeNet()
+        opt = nn.AdamOptimizer(net.parameters(), learning_rate=5e-3)
+        losses = []
+        for _ in range(30):
+            x, y = make_batch()
+            loss = net(x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.6, losses
+
+
+def test_eager_sgd_step():
+    with imperative.guard():
+        fc = nn.FC(size=1, input_dim=2, bias_attr=False)
+        w0 = np.asarray(fc.weight.value).copy()
+        x = np.ones((3, 2), "float32")
+
+        class Sum(imperative.Layer):
+            def forward(self, t):
+                import jax.numpy as jnp
+                return jnp.sum(t)
+
+        loss = Sum()(fc(x))
+        loss.backward()
+        g = fc.weight.gradient()
+        np.testing.assert_allclose(g, np.full((2, 1), 3.0), rtol=1e-6)
+        opt = nn.SGDOptimizer(fc.parameters(), learning_rate=0.1)
+        opt.step()
+        np.testing.assert_allclose(
+            np.asarray(fc.weight.value), w0 - 0.1 * g, rtol=1e-6
+        )
