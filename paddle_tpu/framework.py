@@ -66,11 +66,14 @@ class OpRole:
     """Op role attr used by backward/optimizer/multi-device passes (reference
     op_proto_maker.h OpRole). Stored on every op as attr `op_role`."""
 
+    # Bitmask values match reference op_proto_maker.h (kRPC = 0x0004,
+    # kDist = 0x0008) so role tests like `role & Optimize` never match
+    # RPC/Dist-role ops.
     Forward = 0
     Backward = 1
     Optimize = 2
-    RPC = 3
-    Dist = 4
+    RPC = 4
+    Dist = 8
     LRSched = 16
     Loss = 256
 

@@ -530,6 +530,12 @@ def _im2sequence(ctx, ins, attrs):
     y = ins.get("Y", [None])[0]
     if y is None:
         return {"Out": [out]}
+    # Reference kernel (im2sequence_op.h:51) only enters real-size mode when
+    # batch_size > 1; for a single image it ignores Y and emits the full
+    # static grid. Replicated verbatim for parity (upstream quirk).
+    if b == 1:
+        full = jnp.full((b,), oh * ow, dtype=jnp.int32)
+        return {"Out": [out], "OutLen": [full]}
 
     osh, osw = [int(s) for s in attrs.get("out_stride", [1, 1])]
     real = y.reshape(b, 2).astype(jnp.int32)
