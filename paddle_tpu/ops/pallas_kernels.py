@@ -30,6 +30,10 @@ __all__ = ["flash_attention", "flash_tiles_ok", "flash_path_taken"]
 _DEF_BLOCK_Q = 512
 _DEF_BLOCK_K = 1024
 _DEF_BLOCK_K_CAUSAL = 512  # smaller K stream keeps the causal chunk-skip live
+# streamed (long-context) tier optimum, swept at t=16384 on chip: (1024,1024)
+# runs 100/124 TF/s eff fwd (causal/not) vs 51/63 at (512,512); same ranking
+# for the backward (97/121 vs 67/90); 2048 tiles overflow VMEM
+_DEF_STREAM_BLOCK = 1024
 _LANES = 128  # Mosaic minimum tile width for the residual tensors
 
 
@@ -288,6 +292,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                    with_lse=False):
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    raw_bq, raw_bk = block_q, block_k
     block_q, block_k = _resolve_blocks(block_q, block_k, causal)
     block_q = _auto_block(tq, block_q)
     block_k = _auto_block(tk, block_k)
@@ -301,10 +306,13 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     v3 = v.reshape(b * h, tk, d)
     if not _resident_ok(tk, d, k.dtype.itemsize):
         # long-context tier: stream K/V through the grid instead of holding
-        # them whole in VMEM
+        # them whole in VMEM; the streamed optimum is larger tiles (the gate
+        # above already passed, and the stream targets only widen it)
         res = _flash_forward_streamed(
-            q3, k3, v3, causal, sm_scale, block_q, block_k, interpret,
-            with_lse, q.dtype,
+            q3, k3, v3, causal, sm_scale,
+            _auto_block(tq, raw_bq or _DEF_STREAM_BLOCK),
+            _auto_block(tk, raw_bk or _DEF_STREAM_BLOCK),
+            interpret, with_lse, q.dtype,
         )
         if with_lse:
             out, lse = res
@@ -629,6 +637,7 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
                     block_k, interpret):
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    raw_bq, raw_bk = block_q, block_k
     block_q, block_k = _resolve_blocks(block_q, block_k, causal)
     block_q = _auto_block(tq, block_q)
     block_k = _auto_block(tk, block_k)
@@ -653,7 +662,9 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
         and _resident_ok(tq, d, q.dtype.itemsize)
     ):
         dq, dk, dv = _flash_backward_streamed(
-            q3, k3, v3, do3, lse3, delta, causal, sm_scale, block_q, block_k,
+            q3, k3, v3, do3, lse3, delta, causal, sm_scale,
+            _auto_block(tq, raw_bq or _DEF_STREAM_BLOCK),
+            _auto_block(tk, raw_bk or _DEF_STREAM_BLOCK),
             interpret, (q.dtype, k.dtype, v.dtype),
         )
         return (
