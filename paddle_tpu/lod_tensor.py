@@ -8,7 +8,7 @@ and DataFeeder consume."""
 
 import numpy as np
 
-__all__ = ["create_lod_tensor", "create_random_int_lodtensor"]
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor", "to_dlpack", "from_dlpack"]
 
 
 def create_lod_tensor(data, recursive_seq_lens, place=None):
@@ -57,3 +57,30 @@ def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low, high
         for n in lens
     ]
     return create_lod_tensor(rows, [list(lens)], place)
+
+
+def to_dlpack(value):
+    """DLPack-capable view of a framework value (reference
+    framework/dlpack_tensor.cc — tensor interop with other frameworks).
+    Modern DLPack is object-protocol based: the returned object implements
+    __dlpack__/__dlpack_device__ and is consumed directly by
+    torch.utils.dlpack.from_dlpack / np.from_dlpack. CPU/GPU buffers
+    exchange zero-copy; TPU HBM is not DLPack-addressable, so TPU-resident
+    values are staged to host first (one copy, unavoidable by protocol)."""
+    import jax
+    import jax.numpy as jnp
+
+    arr = value if isinstance(value, jax.Array) else jnp.asarray(value)
+    try:
+        arr.__dlpack_device__()
+    except Exception:
+        return np.asarray(arr)  # host staging for non-DLPack devices (TPU)
+    return arr
+
+
+def from_dlpack(tensor):
+    """Import a DLPack-capable tensor (torch/numpy/another framework's) as
+    a framework (jax) array — zero-copy where the protocol allows."""
+    import jax.numpy as jnp
+
+    return jnp.from_dlpack(tensor)

@@ -202,3 +202,57 @@ def test_contrib_memory_usage():
     assert est >= 32 * 10 * 4 + 10 * 5 * 4 + 32 * 5 * 4
     with pytest.raises(ValueError):
         fluid.contrib.memory_usage(main, batch_size=0)
+
+
+def test_kube_gen_job_manifests(tmp_path):
+    """k8s job generator (reference benchmark/fluid/kube_gen_job.py): spmd
+    mode emits a headless service + per-host StatefulSet whose env matches
+    parallel.multihost's rendezvous contract; pserver mode emits the
+    pserver/trainer pair wired for the socket-RPC pserver."""
+    import sys as _sys
+
+    sys_path = os.path.join(os.path.dirname(__file__), "..", "tools")
+    _sys.path.insert(0, sys_path)
+    try:
+        import kube_gen_job as kg
+    finally:
+        _sys.path.pop(0)
+    import yaml
+
+    out = str(tmp_path / "job.yaml")
+    docs = kg.main([
+        "--jobname", "tj", "--mode", "spmd", "--hosts", "4",
+        "--tpu-accelerator", "tpu-v5p-slice", "--tpu-topology", "2x2x4",
+        "--out", out,
+    ])
+    svc, sts = docs
+    assert svc["kind"] == "Service" and svc["spec"]["clusterIP"] == "None"
+    assert sts["spec"]["replicas"] == 4
+    env = {e["name"]: e["value"] for e in
+           sts["spec"]["template"]["spec"]["containers"][0]["env"]}
+    eps = env["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(eps) == 4 and eps[0].startswith("tj-0.tj:")
+    cmd = sts["spec"]["template"]["spec"]["containers"][0]["command"][-1]
+    assert "PADDLE_TRAINER_ID" in cmd  # ordinal derived from pod name
+    assert (
+        sts["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"][
+            "google.com/tpu"
+        ]
+        == 4
+    )
+    # file round-trips as valid multi-doc yaml
+    with open(out) as f:
+        parsed = list(yaml.safe_load_all(f.read()))
+    assert len(parsed) == 2
+
+    docs = kg.generate(kg.parse_args([
+        "--jobname", "pj", "--mode", "pserver", "--pservers", "3",
+        "--trainers", "5",
+    ]))
+    svc, ps, tr = docs
+    assert ps["spec"]["replicas"] == 3
+    assert tr["spec"]["completions"] == 5 and tr["spec"]["completionMode"] == "Indexed"
+    ps_env = {e["name"]: e["value"] for e in
+              ps["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert len(ps_env["PADDLE_PSERVER_ENDPOINTS"].split(",")) == 3
+    assert ps_env["TRAINING_ROLE"] == "PSERVER"

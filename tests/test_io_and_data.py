@@ -284,3 +284,21 @@ def test_predictor_and_compiled_export(tmp_path):
     served = inference.load_compiled(artifact)
     (out2,) = served.run({"inf_x": feed})
     np.testing.assert_allclose(out2, out1, rtol=1e-5, atol=1e-6)
+
+
+def test_dlpack_interop_with_torch():
+    """DLPack tensor interop (reference framework/dlpack_tensor.cc):
+    framework tensors exchange with torch in both directions without a
+    host copy when on the same device."""
+    import torch
+
+    from paddle_tpu.lod_tensor import from_dlpack, to_dlpack
+
+    x = np.arange(12, dtype="float32").reshape(3, 4)
+    jx = from_dlpack(torch.tensor(x))  # torch -> framework
+    np.testing.assert_array_equal(np.asarray(jx), x)
+    t = torch.utils.dlpack.from_dlpack(to_dlpack(jx * 2))  # framework -> torch
+    np.testing.assert_array_equal(t.numpy(), x * 2)
+    # TPU-resident (or any non-DLPack-device) values stage via host
+    t2 = torch.utils.dlpack.from_dlpack(to_dlpack(np.float32([1, 2])))
+    np.testing.assert_array_equal(t2.numpy(), [1, 2])

@@ -590,3 +590,44 @@ class TestGradientMerge(unittest.TestCase):
             exe.run(main_b, feed={"gm_x": xs, "gm_y": ys}, fetch_list=[])
             w_big = np.asarray(scope_b.find_var("fc_0.w_0"))
         np.testing.assert_allclose(w_merged, w_big, rtol=1e-4, atol=1e-6)
+
+
+class TestInt8ServingArtifacts:
+    def test_int8_predictor_and_aot_export(self, tmp_path):
+        """Full int8 serving flow: QAT -> freeze -> convert_to_int8 ->
+        save_inference_model -> Predictor serve + AOT StableHLO export —
+        the int8 program round-trips through both serving artifacts."""
+        import paddle_tpu.inference as inference
+        import paddle_tpu.io as pio
+
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="ix", shape=[16], dtype="float32")
+                logits = fluid.layers.fc(
+                    fluid.layers.fc(x, size=32, act="relu"), size=4
+                )
+        qt = QuantizeTranspiler()
+        qt.training_transpile(main, startup)
+        rng = np.random.RandomState(9)
+        scope = Scope(seed=2)
+        model_dir = str(tmp_path / "int8_model")
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            infer = main.clone(for_test=True)
+            qt.freeze_program(infer, scope)
+            qt.convert_to_int8(infer, scope)
+            xb = rng.randn(5, 16).astype(np.float32)
+            (want,) = exe.run(infer, feed={"ix": xb}, fetch_list=[logits])
+            pio.save_inference_model(model_dir, ["ix"], [logits], exe,
+                                     main_program=infer)
+        pred = inference.Predictor(model_dir)
+        (got,) = pred.run({"ix": xb})
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+        artifact = str(tmp_path / "int8.npz")
+        inference.export_compiled(model_dir, {"ix": xb}, artifact)
+        served = inference.load_compiled(artifact)
+        (got2,) = served.run({"ix": xb})
+        np.testing.assert_allclose(got2, want, rtol=1e-5, atol=1e-5)

@@ -1,0 +1,255 @@
+"""Generate Kubernetes manifests for distributed training jobs.
+
+Reference analog: benchmark/fluid/kube_gen_job.py + kube_templates/ — the
+reference emits pserver ReplicaSet + trainer Job yamls (pserver mode) or an
+NCCL2 multi-node trainer set. The TPU-native redesign keeps the pserver mode
+(our parameter-shard server, distributed/listen_and_serv.py) and replaces the
+NCCL2 mode with `spmd`: one pod per TPU host in a StatefulSet, rendezvousing
+through jax.distributed (parallel/multihost.py) over the stable headless-
+service DNS of pod 0 — after which the GSPMD mesh spans all hosts and there
+is nothing else to launch (no NCCL ids, no per-GPU processes).
+
+Env contract (consumed by parallel.multihost.init_distributed and the
+DistributeTranspiler config):
+  PADDLE_TRAINER_ENDPOINTS  comma list, entry 0 = coordinator (spmd mode)
+  PADDLE_TRAINER_ID         pod ordinal (derived from the StatefulSet name)
+  PADDLE_PSERVER_ENDPOINTS  comma list of pserver addresses (pserver mode)
+  PADDLE_CURRENT_ENDPOINT   this pserver's own address (pserver mode)
+
+Usage: python tools/kube_gen_job.py --jobname myjob --mode spmd --hosts 4 \
+           --tpu-accelerator v5p-32 --image my/image --entry "python train.py"
+Writes <jobname>.yaml (use --out -) for `kubectl apply -f`.
+"""
+
+import argparse
+import sys
+
+
+def _env(name, value):
+    return {"name": name, "value": str(value)}
+
+
+def _container(args, env, resources=None):
+    c = {
+        "name": "trainer",
+        "image": args.image,
+        # the ordinal is only available through the pod name; export it
+        # before the entry (reference kube_templates derive trainer id the
+        # same way from the job name)
+        "command": [
+            "bash",
+            "-c",
+            'export PADDLE_TRAINER_ID="${HOSTNAME##*-}"; exec ' + args.entry,
+        ],
+        "env": env,
+    }
+    if resources:
+        c["resources"] = resources
+    return c
+
+
+def spmd_manifests(args):
+    """Headless service + StatefulSet: one pod per TPU host; pod 0's stable
+    DNS name is the jax.distributed coordinator."""
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": args.jobname},
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"app": args.jobname},
+            "ports": [{"port": args.port, "name": "coord"}],
+        },
+    }
+    endpoints = ",".join(
+        "%s-%d.%s:%d" % (args.jobname, i, args.jobname, args.port)
+        for i in range(args.hosts)
+    )
+    env = [
+        _env("PADDLE_TRAINER_ENDPOINTS", endpoints),
+        _env("PADDLE_TRAINERS_NUM", args.hosts),
+    ]
+    resources = None
+    pod_spec = {
+        "containers": [_container(args, env, resources)],
+    }
+    if args.tpu_accelerator:
+        # GKE TPU scheduling idiom: the accelerator/topology node selectors
+        # place one pod per TPU host of the slice; the google.com/tpu
+        # resource claims that host's chips
+        pod_spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": args.tpu_accelerator,
+            "cloud.google.com/gke-tpu-topology": args.tpu_topology or "",
+        }
+        pod_spec["containers"][0]["resources"] = {
+            "limits": {"google.com/tpu": args.tpu_chips_per_host}
+        }
+    sts = {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {"name": args.jobname},
+        "spec": {
+            "serviceName": args.jobname,
+            "replicas": args.hosts,
+            "podManagementPolicy": "Parallel",
+            "selector": {"matchLabels": {"app": args.jobname}},
+            "template": {
+                "metadata": {"labels": {"app": args.jobname}},
+                "spec": pod_spec,
+            },
+        },
+    }
+    return [svc, sts]
+
+
+def pserver_manifests(args):
+    """Pserver ReplicaSet + trainer Job (reference kube_templates/pserver +
+    trainer), wired for our socket-RPC pserver."""
+    ps_endpoints = ",".join(
+        "%s-pserver-%d.%s-pserver:%d" % (args.jobname, i, args.jobname, args.port)
+        for i in range(args.pservers)
+    )
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": args.jobname + "-pserver"},
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"app": args.jobname + "-pserver"},
+            "ports": [{"port": args.port, "name": "rpc"}],
+        },
+    }
+    ps_env = [
+        _env("PADDLE_PSERVER_ENDPOINTS", ps_endpoints),
+        _env("PADDLE_TRAINERS_NUM", args.trainers),
+        _env("TRAINING_ROLE", "PSERVER"),
+    ]
+    ps = {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {"name": args.jobname + "-pserver"},
+        "spec": {
+            "serviceName": args.jobname + "-pserver",
+            "replicas": args.pservers,
+            "podManagementPolicy": "Parallel",
+            "selector": {"matchLabels": {"app": args.jobname + "-pserver"}},
+            "template": {
+                "metadata": {"labels": {"app": args.jobname + "-pserver"}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "pserver",
+                            "image": args.image,
+                            "command": [
+                                "bash",
+                                "-c",
+                                'export PADDLE_CURRENT_ENDPOINT='
+                                '"${HOSTNAME}.%s-pserver:%d"; exec %s'
+                                % (args.jobname, args.port, args.entry),
+                            ],
+                            "env": ps_env,
+                        }
+                    ]
+                },
+            },
+        },
+    }
+    tr_env = [
+        _env("PADDLE_PSERVER_ENDPOINTS", ps_endpoints),
+        _env("PADDLE_TRAINERS_NUM", args.trainers),
+        _env("TRAINING_ROLE", "TRAINER"),
+    ]
+    tr = {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": args.jobname + "-trainer"},
+        "spec": {
+            "completions": args.trainers,
+            "parallelism": args.trainers,
+            "completionMode": "Indexed",
+            "template": {
+                "metadata": {"labels": {"app": args.jobname + "-trainer"}},
+                "spec": {
+                    "restartPolicy": "Never",
+                    "containers": [
+                        {
+                            "name": "trainer",
+                            "image": args.image,
+                            "command": [
+                                "bash",
+                                "-c",
+                                'export PADDLE_TRAINER_ID='
+                                '"${JOB_COMPLETION_INDEX}"; exec ' + args.entry,
+                            ],
+                            "env": tr_env,
+                        }
+                    ],
+                },
+            },
+        },
+    }
+    return [svc, ps, tr]
+
+
+def local_manifests(args):
+    return [
+        {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {"name": args.jobname},
+            "spec": {
+                "template": {
+                    "spec": {
+                        "restartPolicy": "Never",
+                        "containers": [_container(args, [])],
+                    }
+                }
+            },
+        }
+    ]
+
+
+def generate(args):
+    return {
+        "spmd": spmd_manifests,
+        "pserver": pserver_manifests,
+        "local": local_manifests,
+    }[args.mode](args)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="Generate dist-job k8s manifests")
+    p.add_argument("--jobname", default="paddletpu")
+    p.add_argument("--mode", default="spmd", choices=["spmd", "pserver", "local"])
+    p.add_argument("--image", default="paddle-tpu:latest")
+    p.add_argument("--entry", default="python train.py")
+    p.add_argument("--port", type=int, default=8476)
+    p.add_argument("--hosts", type=int, default=4, help="TPU hosts (spmd)")
+    p.add_argument("--pservers", type=int, default=2)
+    p.add_argument("--trainers", type=int, default=2)
+    p.add_argument("--tpu-accelerator", default=None,
+                   help="GKE accelerator type, e.g. tpu-v5p-slice")
+    p.add_argument("--tpu-topology", default=None, help="e.g. 2x2x4")
+    p.add_argument("--tpu-chips-per-host", type=int, default=4)
+    p.add_argument("--out", default=None, help="output path; '-' = stdout")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    import yaml
+
+    args = parse_args(argv)
+    docs = generate(args)
+    text = "---\n".join(yaml.safe_dump(d, sort_keys=False) for d in docs)
+    out = args.out or (args.jobname + ".yaml")
+    if out == "-":
+        sys.stdout.write(text)
+    else:
+        with open(out, "w") as f:
+            f.write(text)
+        print("wrote %s (%d manifests)" % (out, len(docs)))
+    return docs
+
+
+if __name__ == "__main__":
+    main()
