@@ -491,7 +491,15 @@ def softmax_with_cross_entropy(
     ignore_index=-100,
     numeric_stable_mode=True,
     return_softmax=False,
+    smooth_eps=0.0,
 ):
+    """smooth_eps (TPU-native extension, hard labels only): uniform label
+    smoothing fused into the CE — mathematically identical to
+    label_smooth(one_hot(label, V), ε) + soft_label CE, but never
+    materializes the [N, V] one-hot (which dominates loss-path HBM traffic
+    and memory at LM vocab sizes)."""
+    if smooth_eps and soft_label:
+        raise ValueError("smooth_eps applies to hard labels only")
     helper = LayerHelper("softmax_with_cross_entropy")
     softmax_out = helper.create_variable_for_type_inference(logits.dtype)
     loss = helper.create_variable_for_type_inference(logits.dtype)
@@ -503,6 +511,7 @@ def softmax_with_cross_entropy(
             "soft_label": soft_label,
             "ignore_index": ignore_index,
             "numeric_stable_mode": numeric_stable_mode,
+            "smooth_eps": float(smooth_eps),
         },
     )
     if return_softmax:

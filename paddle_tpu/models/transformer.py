@@ -236,14 +236,14 @@ def transformer(
         dec = decoder_layer(dec, enc, trg_slf_attn_bias, trg_src_attn_bias, cfg)
 
     logits = layers.fc(dec, size=trg_vocab_size, num_flatten_dims=2, bias_attr=False)
-    # label smoothing over one-hot targets (reference: label_smooth + softmax
-    # CE with soft_label=True), weighted to mask padding
+    # label smoothing (reference: label_smooth(one_hot) + soft_label CE) via
+    # the fused smooth_eps CE — same math, no [N, V] one-hot materialized
+    # (that tensor dominated loss-path memory at real vocab sizes)
     flat_logits = layers.reshape(logits, [-1, trg_vocab_size])
     flat_label = layers.reshape(label, [-1, 1])
-    smooth = layers.label_smooth(
-        layers.one_hot(flat_label, trg_vocab_size), epsilon=label_smooth_eps
+    ce = layers.softmax_with_cross_entropy(
+        flat_logits, flat_label, smooth_eps=label_smooth_eps
     )
-    ce = layers.softmax_with_cross_entropy(flat_logits, smooth, soft_label=True)
     w = layers.reshape(label_weight, [-1, 1])
     weighted = layers.elementwise_mul(ce, w)
     loss = layers.elementwise_div(

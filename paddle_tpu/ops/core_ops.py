@@ -349,7 +349,16 @@ def _softmax_with_ce(ctx, ins, attrs):
     else:
         lbl = label.reshape(label.shape[:-1]).astype(jnp.int32)
         picked = jnp.take_along_axis(logp, lbl[..., None], axis=-1)
-        loss = -picked
+        eps = float(attrs.get("smooth_eps", 0.0) or 0.0)
+        if eps:
+            # exact uniform label smoothing WITHOUT the [N, V] one-hot the
+            # reference pipeline materializes (label_smooth + soft_label CE):
+            # sum_j smooth_j·(−logp_j) with smooth = ε/V + (1−ε)δ_y reduces
+            # to −(1−ε)·logp_y − ε·mean_j logp_j
+            loss = -((1.0 - eps) * picked
+                     + eps * jnp.mean(logp, axis=-1, keepdims=True))
+        else:
+            loss = -picked
         ignore = int(attrs.get("ignore_index", -100))
         loss = jnp.where(lbl[..., None] == ignore, 0.0, loss)
     return {"Softmax": [softmax], "Loss": [loss]}

@@ -614,3 +614,56 @@ def test_model_average_apply_restore():
             assert (avg <= stacked.max(0) + 1e-6).all()
         restored = np.asarray(scope.find_var("w_ma"))
         np.testing.assert_allclose(restored, live)
+
+
+def test_smooth_eps_ce_matches_one_hot_label_smooth():
+    """The fused smooth_eps CE must equal the reference pipeline it
+    replaces (label_smooth(one_hot(label)) + soft_label CE) exactly — the
+    decomposition sum_j smooth_j*(-logp_j) = -(1-eps)*logp_y - eps*mean_j
+    logp_j is an identity, so tolerances are float-tight. Gradients too."""
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+
+    V, N, eps = 23, 9, 0.1
+    rng = np.random.RandomState(4)
+    logits_np = rng.randn(N, V).astype("float32") * 3
+    label_np = rng.randint(0, V, (N, 1)).astype("int64")
+
+    def build(fused):
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            lg = fluid.layers.data(name="lg", shape=[V], dtype="float32")
+            lg.stop_gradient = False
+            lb = fluid.layers.data(name="lb", shape=[1], dtype="int64")
+            if fused:
+                ce = fluid.layers.softmax_with_cross_entropy(
+                    lg, lb, smooth_eps=eps
+                )
+            else:
+                smooth = fluid.layers.label_smooth(
+                    fluid.layers.one_hot(lb, V), epsilon=eps
+                )
+                ce = fluid.layers.softmax_with_cross_entropy(
+                    lg, smooth, soft_label=True
+                )
+            loss = fluid.layers.mean(ce)
+            grads = fluid.backward.append_backward(loss, parameter_list=[])
+        return main, startup, ce, loss
+
+    outs = {}
+    for fused in (True, False):
+        main, startup, ce, loss = build(fused)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope(seed=0)):
+            exe.run(startup)
+            (cev, gv) = exe.run(
+                main,
+                feed={"lg": logits_np, "lb": label_np},
+                fetch_list=[ce.name, "lg@GRAD"],
+            )
+        outs[fused] = (cev, gv)
+    np.testing.assert_allclose(outs[True][0], outs[False][0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs[True][1], outs[False][1], rtol=1e-5, atol=1e-6)
