@@ -1,0 +1,157 @@
+"""Contrib class-based decoder (reference tests/test_beam_search_decoder.py
+pattern: encoder → StateCell with an fc updater → TrainingDecoder trains →
+BeamSearchDecoder decodes with the same cell)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.contrib.decoder import (
+    BeamSearchDecoder,
+    InitState,
+    StateCell,
+    TrainingDecoder,
+)
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.param_attr import ParamAttr
+
+VOCAB, WORD_DIM, HID = 40, 12, 16
+
+
+def _encoder(src_word):
+    emb = fluid.layers.embedding(
+        src_word, size=[VOCAB, WORD_DIM],
+        param_attr=ParamAttr(name="src_emb"),
+    )
+    fc1 = fluid.layers.fc(emb, size=HID * 4, act="tanh", num_flatten_dims=2)
+    fc1._len_name = getattr(src_word, "_len_name", None) or src_word.name + "@LEN"
+    h, c = fluid.layers.dynamic_lstm(fc1, size=HID * 4)
+    return fluid.layers.sequence_last_step(h)
+
+
+def _state_cell(context):
+    h = InitState(init=context, need_reorder=True)
+    cell = StateCell(inputs={"x": None}, states={"h": h}, out_state="h")
+
+    @cell.state_updater
+    def updater(cell):
+        current_word = cell.get_input("x")
+        prev_h = cell.get_state("h")
+        h = fluid.layers.fc(
+            fluid.layers.concat([prev_h, current_word], axis=1),
+            size=HID, act="tanh",
+            param_attr=ParamAttr(name="dec_fc_w"),
+            bias_attr=ParamAttr(name="dec_fc_b"),
+        )
+        cell.set_state("h", h)
+
+    return cell
+
+
+def test_training_decoder_trains_and_beam_decodes():
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        src_word = fluid.layers.data(
+            name="src_word", shape=[1], dtype="int64", lod_level=1
+        )
+        context = _encoder(src_word)
+        cell = _state_cell(context)
+
+        trg_word = fluid.layers.data(
+            name="trg_word", shape=[1], dtype="int64", lod_level=1
+        )
+        trg_emb = fluid.layers.embedding(
+            trg_word, size=[VOCAB, WORD_DIM],
+            param_attr=ParamAttr(name="bsd_trg_emb"),
+        )
+        trg_emb._len_name = trg_word.name + "@LEN"
+
+        decoder = TrainingDecoder(cell)
+        with decoder.block():
+            current_word = decoder.step_input(trg_emb)
+            current_word = fluid.layers.reshape(current_word, [-1, WORD_DIM])
+            decoder.state_cell.compute_state(inputs={"x": current_word})
+            score = fluid.layers.fc(
+                decoder.state_cell.get_state("h"), size=VOCAB, act="softmax",
+                param_attr=ParamAttr(name="bsd_out_w"),
+                bias_attr=ParamAttr(name="bsd_out_b"),
+            )
+            decoder.state_cell.update_states()
+            decoder.output(score)
+        probs = decoder()
+
+        label = fluid.layers.data(
+            name="label", shape=[1], dtype="int64", lod_level=1
+        )
+        flat = fluid.layers.reshape(probs, [-1, VOCAB])
+        ce = fluid.layers.cross_entropy(
+            flat, fluid.layers.reshape(label, [-1, 1])
+        )
+        loss = fluid.layers.mean(ce)
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    B, T = 4, 6
+
+    def batch():
+        lens = np.full((B,), T, "int32")
+        src = rng.randint(2, VOCAB, (B, T, 1)).astype("int64")
+        # learnable pattern: target = source word at each step
+        return {
+            "src_word": src, "src_word@LEN": lens,
+            "trg_word": src.copy(), "trg_word@LEN": lens,
+            "label": src.copy(), "label@LEN": lens,
+        }
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        losses = []
+        fixed = batch()
+        for _ in range(25):
+            (lv,) = exe.run(main, feed=fixed, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0] * 0.8, losses
+
+        # beam decode with the SAME scope (shared parameters by name)
+        infer = framework.Program()
+        infer_startup = framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(infer, infer_startup):
+            src_word_i = fluid.layers.data(
+                name="src_word", shape=[1], dtype="int64", lod_level=1
+            )
+            context_i = _encoder(src_word_i)
+            cell_i = _state_cell(context_i)
+            init_ids = fluid.layers.data(
+                name="init_ids", shape=[4, 1], dtype="int64",
+                append_batch_size=False,
+            )
+            init_scores = fluid.layers.data(
+                name="init_scores", shape=[4, 1], dtype="float32",
+                append_batch_size=False,
+            )
+            bsd = BeamSearchDecoder(
+                state_cell=cell_i, init_ids=init_ids, init_scores=init_scores,
+                target_dict_dim=VOCAB, word_dim=WORD_DIM, topk_size=12,
+                sparse_emb=False, max_len=T, beam_size=3, end_id=1, name="bsd",
+            )
+            bsd.decode()
+            trans_ids, trans_scores = bsd()
+
+        fd = fixed
+        (ids, scores) = exe.run(
+            infer,
+            feed={
+                "src_word": fd["src_word"], "src_word@LEN": fd["src_word@LEN"],
+                "init_ids": np.zeros((B, 1), "int64"),
+                "init_scores": np.zeros((B, 1), "float32"),
+            },
+            fetch_list=[trans_ids.name, trans_scores.name],
+        )
+        ids = np.asarray(ids)
+        scores = np.asarray(scores)
+        assert ids.shape[0] == B and ids.shape[1] == 3  # (B, beam, T)
+        assert np.isfinite(scores).all()
+        # the trained cell should echo the source-ish distribution: decoded
+        # ids stay in-vocab
+        assert (ids >= 0).all() and (ids < VOCAB).all()
