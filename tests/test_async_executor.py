@@ -105,3 +105,55 @@ def test_async_executor_trains():
             )
     assert means, "no fetch periods recorded"
     assert all(np.isfinite(means))
+
+
+def test_contrib_ctr_reader_feeds_program():
+    """contrib.reader.ctr_reader (reference contrib/reader/ctr_reader.py):
+    the reader's parse threads + staging feed a training program with no
+    explicit feed dict — same lifecycle as layers.py_reader (start/reset,
+    EOF ends the pass)."""
+    from paddle_tpu.contrib.reader.ctr_reader import ctr_reader
+    from paddle_tpu.py_reader import EOFException
+
+    with tempfile.TemporaryDirectory() as td:
+        files = _write_files(td)
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            # declare the slot vars (the reference's feed_data); the reader
+            # binds them by slot name
+            ids_v = fluid.layers.data(name="ids", shape=[-1, -1], dtype="int64",
+                                      append_batch_size=False)
+            dense_v = fluid.layers.data(name="dense_x", shape=[-1, 4],
+                                        dtype="float32", append_batch_size=False)
+            label_v = fluid.layers.data(name="label", shape=[-1, 1],
+                                        dtype="int64", append_batch_size=False)
+            reader = ctr_reader(
+                feed_data=[ids_v, dense_v, label_v],
+                capacity=8, thread_num=2, batch_size=8,
+                file_list=files, slots=PROTO,
+            )
+            emb = fluid.layers.embedding(
+                ids_v, size=[50, 8], is_sparse=False, padding_idx=-1
+            )
+            pooled = fluid.layers.reduce_mean(emb, dim=[1])
+            feat = fluid.layers.concat([pooled, dense_v], axis=1)
+            logits = fluid.layers.fc(feat, size=2)
+            lbl = label_v
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, lbl)
+            )
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope(seed=0)):
+            exe.run(startup)
+            reader.start()
+            losses = []
+            try:
+                while True:
+                    (lv,) = exe.run(main, fetch_list=[loss.name])
+                    losses.append(float(np.asarray(lv).ravel()[0]))
+            except EOFException:
+                reader.reset()
+            assert len(losses) == 10  # 80 lines / bs 8
+            assert np.isfinite(losses).all()
