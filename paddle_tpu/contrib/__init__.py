@@ -4,7 +4,8 @@ that graduated into first-class modules here re-export from their new homes
 so `fluid.contrib.*` call sites keep working."""
 
 from ..transpiler.quantize_transpiler import QuantizeTranspiler  # noqa: F401
-from . import decoder, memory_usage_calc, reader  # noqa: F401
+from . import decoder, memory_usage_calc, op_frequence, reader  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401
 
-__all__ = ["QuantizeTranspiler", "memory_usage", "memory_usage_calc", "decoder", "reader"]
+__all__ = ["QuantizeTranspiler", "memory_usage", "memory_usage_calc", "decoder", "reader", "op_freq_statistic"]

@@ -256,3 +256,22 @@ def test_kube_gen_job_manifests(tmp_path):
               ps["spec"]["template"]["spec"]["containers"][0]["env"]}
     assert len(ps_env["PADDLE_PSERVER_ENDPOINTS"].split(",")) == 3
     assert ps_env["TRAINING_ROLE"] == "PSERVER"
+
+
+def test_op_freq_statistic():
+    """contrib.op_freq_statistic (reference contrib/op_frequence.py): op and
+    adjacent-pair counts over a program."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.contrib import op_freq_statistic
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="ofx", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=3, act="relu")
+        y = fluid.layers.fc(h, size=2, act="relu")
+        fluid.layers.mean(y)
+    uni, adj = op_freq_statistic(main)
+    assert uni["mul"] == 2 and uni["relu"] == 2 and uni["mean"] == 1
+    assert adj.get("relu,mul") == 1  # first fc's act feeds second fc's mul
+    with pytest.raises(TypeError):
+        op_freq_statistic("not a program")
