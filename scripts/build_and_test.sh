@@ -12,9 +12,34 @@ native.lib()
 print("native runtime built:", native._LIB)
 PY
 
-echo "== python unittests (8-device CPU mesh) =="
-JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-    python -m pytest tests/ -q
+echo "== python unittests (8-device CPU mesh, sharded) =="
+# Sharded into fresh pytest processes with one retry per shard (reference
+# paddle_build.sh retries its flaky ctest tier the same way,
+# retry_times=3): the XLA *CPU* compiler in this jax build segfaults
+# intermittently (~1 in several hundred compile-heavy tests, observed in
+# scan/while compiles across unrelated tests — pe_crf, pe_while_train,
+# dynamic_lstm grad). Bisection shows it needs ~8+ test files of
+# accumulated compile state in one process (every ≤5-file subset of a
+# crashing shard passes), so small shards avoid it almost entirely and the
+# retry absorbs the residue; a real test failure still fails the build
+# (it fails twice).
+run_shard () {
+    JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m pytest "$@" -q
+}
+mapfile -t TEST_FILES < <(ls tests/test_*.py | sort)
+NSHARDS=${NSHARDS:-8}
+for ((s = 0; s < NSHARDS; s++)); do
+    SHARD=()
+    for ((i = s; i < ${#TEST_FILES[@]}; i += NSHARDS)); do
+        SHARD+=("${TEST_FILES[$i]}")
+    done
+    echo "-- shard $((s + 1))/$NSHARDS: ${#SHARD[@]} files"
+    if ! run_shard "${SHARD[@]}"; then
+        echo "-- shard $((s + 1)) failed; retrying once in a fresh process"
+        run_shard "${SHARD[@]}"
+    fi
+done
 
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current

@@ -128,11 +128,19 @@ class OpTest(unittest.TestCase):
         with scope_guard(Scope()):
             analytic = self._exe.run(main, feed=self._feed, fetch_list=grad_names)
 
-        # numeric: central differences on the loss program
+        # numeric: central differences on the loss program. ONE scope for the
+        # whole sweep — the executor's program cache is scope-keyed, so a
+        # fresh Scope per evaluation would recompile the program for every
+        # perturbed element (thousands of XLA compiles for an RNN op's grad
+        # check; measured as the dominant harness cost, and each compile is a
+        # roll of the flaky XLA-CPU-compiler dice — see build_and_test.sh).
+        # The loss program is stateless (inputs are fed, nothing persists),
+        # so sharing the scope only shares the compiled executable.
         fwd_main, fwd_loss = self._loss_program()
+        num_scope = Scope()
 
         def loss_at(feed):
-            with scope_guard(Scope()):
+            with scope_guard(num_scope):
                 (val,) = self._exe.run(fwd_main, feed=feed, fetch_list=[fwd_loss.name])
             return float(val.reshape(()))
 

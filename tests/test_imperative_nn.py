@@ -132,9 +132,17 @@ def test_eager_lenet_converges():
         x = rng.randn(n, 1, 8, 8).astype("float32") + y[:, None, None, None] * 2.0
         return x, y.astype("int32")
 
-    np.random.seed(0)  # Layer.create_parameter draws from the global RNG
+    # Layer.create_parameter draws from the global RNG: seed for
+    # deterministic init, but restore the stream afterwards — polluting the
+    # global state would change every downstream unseeded test in the suite
+    rng_state = np.random.get_state()
+    np.random.seed(0)
+    try:
+        with imperative.guard():
+            net = LeNet()
+    finally:
+        np.random.set_state(rng_state)
     with imperative.guard():
-        net = LeNet()
         opt = nn.AdamOptimizer(net.parameters(), learning_rate=5e-3)
         losses = []
         for _ in range(30):
