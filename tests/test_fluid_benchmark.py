@@ -1,0 +1,38 @@
+"""Benchmark-launcher smoke tests (reference benchmark/fluid harness: build
+model, train iterations, print throughput per pass)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benchmark"))
+import fluid_benchmark  # noqa: E402
+
+
+@pytest.mark.parametrize(
+    "model,extra",
+    [
+        ("mnist", []),
+        ("resnet", ["--data_set", "cifar10"]),
+        ("stacked_dynamic_lstm", []),
+        ("transformer", []),
+        ("machine_translation", []),
+    ],
+)
+def test_local_mode_trains(model, extra):
+    ips = fluid_benchmark.main([
+        "--model", model, "--device", "CPU", "--batch_size", "4",
+        "--iterations", "4", "--skip_batch_num", "1", "--pass_num", "1",
+    ] + extra)
+    assert len(ips) == 1 and np.isfinite(ips[0]) and ips[0] > 0
+
+
+def test_spmd_mode_trains():
+    ips = fluid_benchmark.main([
+        "--model", "mnist", "--device", "CPU", "--batch_size", "8",
+        "--iterations", "4", "--skip_batch_num", "1", "--pass_num", "1",
+        "--update_method", "spmd",
+    ])
+    assert len(ips) == 1 and np.isfinite(ips[0]) and ips[0] > 0
