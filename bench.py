@@ -150,6 +150,46 @@ NOMINAL_BF16_TFLOPS = 197.0  # TPU v5e peak (the bench chip)
 # on K40m (reference benchmark/README.md:113-121)
 BASELINE_LSTM_MS_PER_BATCH = 184.0
 
+# reference's best published VGG-19 TRAIN throughput: 30.44 img/s (bs=256,
+# MKL-DNN; benchmark/IntelOptimizedPaddle.md:29-37)
+BASELINE_VGG19_IMAGES_PER_SEC = 30.44
+
+
+def run_vgg19(bs=64, steps=12, warmup=3):
+    """Tertiary metric: VGG-19 bf16 train (the second model the reference
+    publishes a train baseline for)."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.models import vgg
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 224, 224], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        loss, _, _ = vgg.vgg19(img, label)
+        fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": jax.device_put(rng.randn(bs, 3, 224, 224).astype("float32")),
+        "label": jax.device_put(rng.randint(0, 1000, (bs, 1)).astype("int64")),
+    }
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        from paddle_tpu.transpiler.bf16_transpiler import Bf16Transpiler
+
+        Bf16Transpiler().transpile(main)
+        for _ in range(warmup):
+            (l,) = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
+        np.asarray(l)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (l,) = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
+        np.asarray(l)
+        return bs * steps / (time.perf_counter() - t0)
+
 
 def run_lstm(hid=512, bs=64, t=100, dict_dim=30000, steps=10, warmup=3):
     """Tertiary metric: BASELINE config 5 (stacked dynamic-LSTM text model,
@@ -300,6 +340,12 @@ def main():
         record["lstm_vs_baseline"] = round(BASELINE_LSTM_MS_PER_BATCH / lstm_ms, 2)
     except Exception as e:
         print("lstm pass failed: %r" % e, file=sys.stderr)
+    try:
+        vgg_ips = run_vgg19()
+        record["vgg19_images_per_sec"] = round(vgg_ips, 1)
+        record["vgg19_vs_baseline"] = round(vgg_ips / BASELINE_VGG19_IMAGES_PER_SEC, 2)
+    except Exception as e:
+        print("vgg19 pass failed: %r" % e, file=sys.stderr)
     print(json.dumps(record))
 
 

@@ -1,4 +1,6 @@
-"""VGG-16 (reference benchmark/fluid/models/vgg.py conv_block structure)."""
+"""VGG-16/19 (reference benchmark/fluid/models/vgg.py conv_block structure;
+VGG-19 is the configuration the reference publishes train/infer baselines
+for, benchmark/IntelOptimizedPaddle.md:29-37)."""
 
 from .. import layers
 
@@ -10,6 +12,26 @@ def conv_block(input, num_filter, groups):
             conv, num_filters=num_filter, filter_size=3, padding=1, act="relu"
         )
     return layers.pool2d(conv, pool_size=2, pool_stride=2)
+
+
+def _vgg(img, label, depths, class_num, dropout):
+    conv = img
+    for filters, groups in zip((64, 128, 256, 512, 512), depths):
+        conv = conv_block(conv, filters, groups)
+    fc1 = layers.fc(conv, size=4096, act="relu")
+    if dropout:
+        fc1 = layers.dropout(fc1, dropout_prob=0.5)
+    fc2 = layers.fc(fc1, size=4096, act="relu")
+    if dropout:
+        fc2 = layers.dropout(fc2, dropout_prob=0.5)
+    logits = layers.fc(fc2, size=class_num)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return loss, acc, logits
+
+
+def vgg19(img, label, class_num=1000, dropout=True):
+    return _vgg(img, label, (2, 2, 4, 4, 4), class_num, dropout)
 
 
 def vgg16(img, label, class_num=1000, dropout=True):

@@ -355,3 +355,30 @@ def test_alexnet_and_googlenet_train_tiny():
                 losses.append(float(np.asarray(lv).reshape(-1)[0]))
         assert np.isfinite(losses).all(), (name, losses)
         assert np.mean(losses[-2:]) < np.mean(losses[:2]), (name, losses)
+
+
+def test_vgg19_trains_tiny():
+    """VGG-19 (the reference's published-baseline VGG config,
+    IntelOptimizedPaddle.md:29) trains on cifar-sized input."""
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.models import vgg
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="vimg", shape=[3, 32, 32], dtype="float32")
+        label = fluid.layers.data(name="vlabel", shape=[1], dtype="int64")
+        loss, acc, _ = vgg.vgg19(img, label, class_num=10, dropout=False)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    rng = np.random.RandomState(0)
+    xb = rng.randn(4, 3, 32, 32).astype("float32")
+    yb = rng.randint(0, 10, (4, 1)).astype("int64")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        losses = []
+        for _ in range(4):
+            (lv,) = exe.run(
+                main, feed={"vimg": xb, "vlabel": yb}, fetch_list=[loss.name]
+            )
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
