@@ -313,9 +313,14 @@ def huber_loss(input, label, delta):
 
 def edit_distance(input, label, normalized=True, ignored_tokens=None):
     """Batched Levenshtein distance (reference layers/nn.py edit_distance →
-    edit_distance_op.cc). Returns (distance [B,1], seq_num [1])."""
+    edit_distance_op.cc). `ignored_tokens` are erased from both sequences
+    before the distance, via sequence_erase ops as the reference does
+    (reference layers/nn.py:4402-4417). Returns (distance [B,1], seq_num [1])."""
     if ignored_tokens:
-        raise NotImplementedError("edit_distance ignored_tokens not supported")
+        from .sequence import sequence_erase
+
+        input = sequence_erase(input, list(ignored_tokens))
+        label = sequence_erase(label, list(ignored_tokens))
     helper = LayerHelper("edit_distance", **locals())
     out = helper.create_variable_for_type_inference("float32")
     seq_num = helper.create_variable_for_type_inference("int64")

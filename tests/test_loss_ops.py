@@ -518,6 +518,35 @@ def test_edit_distance():
     assert np.asarray(n).reshape(())[()] == 2
 
 
+def test_edit_distance_ignored_tokens():
+    hyps = np.array([[[1], [9], [2], [3]], [[9], [4], [4], [9]]], np.int64)
+    refs = np.array([[[1], [3], [3]], [[4], [9], [6]]], np.int64)
+    hyp_len = np.array([4, 4], np.int64)
+    ref_len = np.array([3, 3], np.int64)
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        h = fluid.layers.data(name="h", shape=[2, 4, 1], dtype="int64",
+                              append_batch_size=False)
+        h._len_name = "hl"
+        main.global_block().create_var(name="hl", shape=(2,), dtype="int64")
+        r = fluid.layers.data(name="r", shape=[2, 3, 1], dtype="int64",
+                              append_batch_size=False)
+        r._len_name = "rl"
+        main.global_block().create_var(name="rl", shape=(2,), dtype="int64")
+        dist, seq_num = fluid.layers.edit_distance(
+            h, r, normalized=False, ignored_tokens=[9])
+    (d, n) = run_prog(main, startup,
+                      {"h": hyps, "r": refs, "hl": hyp_len, "rl": ref_len},
+                      [dist.name, seq_num.name])
+    d = np.asarray(d).reshape(-1)
+    want = [
+        _levenshtein([1, 2, 3], [1, 3, 3]),
+        _levenshtein([4, 4], [4, 6]),
+    ]
+    np.testing.assert_allclose(d, want)
+    assert np.asarray(n).reshape(())[()] == 2
+
+
 def test_precision_recall():
     idx = np.array([[0], [1], [1], [2]], np.int64)
     lbl = np.array([[0], [1], [2], [2]], np.int64)
