@@ -233,19 +233,13 @@ def run_lstm(hid=512, bs=64, t=100, dict_dim=30000, steps=10, warmup=3):
         return (time.perf_counter() - t0) / steps * 1e3
 
 
-def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=10,
-                        warmup=3):
-    """Secondary metric: MFU on a compute-dense Transformer train step (the
-    north-star metric is MFU, BASELINE.md — ResNet-50 on one v5e chip is
-    HBM-bound by its BN/elementwise tier (PROFILE.md), so a matmul-dominated
-    model is the honest vehicle for demonstrating MXU utilization). Model:
-    enc-dec Transformer (models/transformer.py) with Pallas flash attention,
-    bf16, Adam. FLOPs counted as fwd + 2x bwd over the matmul/attention
-    terms only (embedding gathers, softmax, norms uncounted)."""
+def build_transformer(b=8, t=1024, d=2048, n_layer=4, vocab=32000):
+    """Build the MFU-bench Transformer train step. Returns
+    (main, startup, feed, loss, flops_per_step) with the feed already staged
+    on device. Shared by run_transformer_mfu and tools/mfu_audit.py."""
     import jax
     import paddle_tpu.fluid as fluid
     from paddle_tpu import framework
-    from paddle_tpu.executor import Scope, scope_guard
     from paddle_tpu.models import transformer as T
 
     n_head, d_inner = 16, 4 * d
@@ -270,7 +264,6 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=10,
             )
             fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
 
-    exe = fluid.Executor(fluid.TPUPlace())
     rng = np.random.RandomState(0)
     pos = np.tile(np.arange(t), (b, 1)).astype("int64")
     feed = {
@@ -281,6 +274,28 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=10,
         "label": jax.device_put(rng.randint(0, vocab, (b, t)).astype("int64")),
         "label_weight": jax.device_put(np.ones((b, t, 1), "float32")),
     }
+    enc_mm = n_layer * (4 * d * d + 2 * d * d_inner)
+    dec_mm = n_layer * (8 * d * d + 2 * d * d_inner)
+    mm = 2 * b * t * (enc_mm + dec_mm) + 2 * b * t * d * vocab
+    attn = 4 * b * t * t * d * (3 * n_layer)
+    flops = 3 * (mm + attn)
+    return main, startup, feed, loss, flops
+
+
+def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=10,
+                        warmup=3):
+    """Secondary metric: MFU on a compute-dense Transformer train step (the
+    north-star metric is MFU, BASELINE.md — ResNet-50 on one v5e chip is
+    HBM-bound by its BN/elementwise tier (PROFILE.md), so a matmul-dominated
+    model is the honest vehicle for demonstrating MXU utilization). Model:
+    enc-dec Transformer (models/transformer.py) with Pallas flash attention,
+    bf16, Adam. FLOPs counted as fwd + 2x bwd over the matmul/attention
+    terms only (embedding gathers, softmax, norms uncounted)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+
+    main, startup, feed, loss, flops = build_transformer(b, t, d, n_layer, vocab)
+    exe = fluid.Executor(fluid.TPUPlace())
     with scope_guard(Scope(seed=0)):
         exe.run(startup)
         from paddle_tpu.transpiler.bf16_transpiler import Bf16Transpiler
@@ -294,11 +309,6 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=10,
             (l,) = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
         np.asarray(l)
         dt = (time.perf_counter() - t0) / steps
-    enc_mm = n_layer * (4 * d * d + 2 * d * d_inner)
-    dec_mm = n_layer * (8 * d * d + 2 * d * d_inner)
-    mm = 2 * b * t * (enc_mm + dec_mm) + 2 * b * t * d * vocab
-    attn = 4 * b * t * t * d * (3 * n_layer)
-    flops = 3 * (mm + attn)
     return flops / dt / 1e12
 
 

@@ -15,11 +15,11 @@ def binary_op(x, other, op_type, reverse=False):
         if op_type in _SCALAR_SCALE and not reverse:
             # scalar fast path as a scale op (reference math_op_patch scale)
             attrs = {
-                "elementwise_add": {"scale": 1.0, "bias": val},
-                "elementwise_sub": {"scale": 1.0, "bias": -val},
-                "elementwise_mul": {"scale": val, "bias": 0.0},
-                "elementwise_div": {"scale": 1.0 / val, "bias": 0.0},
-            }[op_type]
+                "elementwise_add": lambda: {"scale": 1.0, "bias": val},
+                "elementwise_sub": lambda: {"scale": 1.0, "bias": -val},
+                "elementwise_mul": lambda: {"scale": val, "bias": 0.0},
+                "elementwise_div": lambda: {"scale": 1.0 / val, "bias": 0.0},
+            }[op_type]()
             out = helper.create_variable_for_type_inference(x.dtype)
             helper.append_op(
                 type="scale",

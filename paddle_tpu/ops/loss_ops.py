@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .core_ops import _opt_f32
 from .registry import register
 
 
@@ -530,6 +531,7 @@ def _prox(p, lr, l1, l2):
 
 
 @register("proximal_gd", no_grad=True)
+@_opt_f32
 def _proximal_gd(ctx, ins, attrs):
     (p,) = ins["Param"]
     (g,) = ins["Grad"]
@@ -540,6 +542,7 @@ def _proximal_gd(ctx, ins, attrs):
 
 
 @register("proximal_adagrad", no_grad=True)
+@_opt_f32
 def _proximal_adagrad(ctx, ins, attrs):
     (p,) = ins["Param"]
     (g,) = ins["Grad"]

@@ -198,6 +198,44 @@ def _hlo_op_map(hlo_text):
     return mapping
 
 
+def device_instr_events(log_dir):
+    """Per-HLO-instruction device timings from an xla_trace log dir:
+    {instr_name: [count, total_ms, min_ms, max_ms]}. Shared base for
+    device_op_profile and tools/mfu_audit.py."""
+    import glob as _glob
+
+    from jax.profiler import ProfileData
+
+    paths = sorted(
+        _glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
+    )
+    if not paths:
+        raise FileNotFoundError("no xplane.pb under %r — run xla_trace first" % log_dir)
+    events = {}
+    pd = ProfileData.from_file(paths[-1])
+    for plane in pd.planes:
+        if "TPU" not in plane.name and "GPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = ev.name.lstrip("%").split(" ")[0]
+                dur_ms = None
+                for k, v in ev.stats or []:
+                    if k == "device_duration_ps":
+                        dur_ms = float(v) / 1e9
+                        break
+                if dur_ms is None:
+                    continue
+                row = events.setdefault(name, [0, 0.0, float("inf"), 0.0])
+                row[0] += 1
+                row[1] += dur_ms
+                row[2] = min(row[2], dur_ms)
+                row[3] = max(row[3], dur_ms)
+    return events
+
+
 def device_op_profile(log_dir, hlo_text=None, print_table=True):
     """Fold an xla_trace's per-HLO device timings back onto framework op
     types (ROADMAP 10; reference analog: device_tracer.cc correlating CUPTI
@@ -208,44 +246,20 @@ def device_op_profile(log_dir, hlo_text=None, print_table=True):
     framework op whose lowering emitted it; without it, instructions
     aggregate by HLO opcode. Returns {key: [count, total_ms, min_ms, max_ms]}
     in stop_profiler's table shape; prints the same report format."""
-    import glob as _glob
-
-    from jax.profiler import ProfileData
-
-    paths = sorted(
-        _glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
-    )
-    if not paths:
-        raise FileNotFoundError("no xplane.pb under %r — run xla_trace first" % log_dir)
     mapping = _hlo_op_map(hlo_text) if hlo_text else {}
     table = {}
-    pd = ProfileData.from_file(paths[-1])
-    for plane in pd.planes:
-        if "TPU" not in plane.name and "GPU" not in plane.name:
-            continue
-        for line in plane.lines:
-            if line.name != "XLA Ops":
-                continue
-            for ev in line.events:
-                name = ev.name.lstrip("%").split(" ")[0]
-                key = mapping.get(name)
-                if key is None:
-                    # strip SSA suffix then retry, else group by HLO opcode
-                    key = mapping.get(name.split(".")[0])
-                if key is None:
-                    key = "hlo:" + name.split(".")[0]
-                dur_ms = None
-                for k, v in ev.stats or []:
-                    if k == "device_duration_ps":
-                        dur_ms = float(v) / 1e9
-                        break
-                if dur_ms is None:
-                    continue
-                row = table.setdefault(key, [0, 0.0, float("inf"), 0.0])
-                row[0] += 1
-                row[1] += dur_ms
-                row[2] = min(row[2], dur_ms)
-                row[3] = max(row[3], dur_ms)
+    for name, (count, total, mn, mx) in device_instr_events(log_dir).items():
+        key = mapping.get(name)
+        if key is None:
+            # strip SSA suffix then retry, else group by HLO opcode
+            key = mapping.get(name.split(".")[0])
+        if key is None:
+            key = "hlo:" + name.split(".")[0]
+        row = table.setdefault(key, [0, 0.0, float("inf"), 0.0])
+        row[0] += count
+        row[1] += total
+        row[2] = min(row[2], mn)
+        row[3] = max(row[3], mx)
     if print_table and table:
         rows = sorted(table.items(), key=lambda kv: -kv[1][1])
         lines = [

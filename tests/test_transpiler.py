@@ -398,6 +398,100 @@ class TestQuantizeTranspiler:
 
 
 class TestBf16Transpiler:
+    def test_train_mode_master_weights(self):
+        """Train mode (optimizer ops present): persistable state keeps f32
+        masters, compute reads w@BF16 casts, training converges, and state
+        dtypes are STABLE across steps (a silent f32 promotion would change
+        numerics and force a recompile every step — round-4 regression)."""
+        import jax.numpy as jnp
+
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="bx", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="by", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, size=32, act="relu")
+            logits = fluid.layers.fc(h, size=4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y)
+            )
+            fluid.optimizer.Adam(learning_rate=5e-2).minimize(loss)
+
+        rng = np.random.RandomState(0)
+        xb = rng.randn(16, 8).astype(np.float32)
+        yb = rng.randint(0, 4, (16, 1)).astype(np.int64)
+        scope = Scope(seed=7)
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            Bf16Transpiler().transpile(main)
+            gb = main.global_block()
+            w = [n for n in gb.vars if n.endswith(".w_0")][0]
+            assert gb.var(w).dtype == "float32"  # master annotation
+            assert gb.has_var(w + "@BF16")  # per-step compute cast
+            assert gb.var(w + "@BF16").dtype == "bfloat16"
+            assert gb.var(h.name).dtype == "bfloat16"  # activation flipped
+            losses = []
+            for _ in range(20):
+                (lv,) = exe.run(
+                    main, feed={"bx": xb, "by": yb}, fetch_list=[loss.name]
+                )
+                losses.append(float(np.asarray(lv).ravel()[0]))
+            assert losses[-1] < losses[0] * 0.5, losses
+            assert scope.find_var(w).dtype == jnp.float32
+            m1 = [n for n in scope.vars if "moment1" in n]
+            if m1:
+                assert scope.find_var(m1[0]).dtype == jnp.float32
+
+    def test_train_mode_island_in_sub_block(self):
+        """Island ops inside a while sub-block reading parent-block
+        activations must transpile (recursive var lookup regression)."""
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="wx", shape=[4], dtype="float32")
+            h = fluid.layers.fc(x, size=4)
+            i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+            n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=2)
+            cond = fluid.layers.less_than(x=i, y=n)
+            acc = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+            w = fluid.layers.While(cond=cond)
+            with w.block():
+                sm = fluid.layers.softmax(h)  # blacklisted, reads parent var
+                s = fluid.layers.mean(sm)
+                fluid.layers.assign(fluid.layers.sums([acc, s]), acc)
+                i2 = fluid.layers.increment(i, value=1, in_place=True)
+                fluid.layers.less_than(x=i2, y=n, cond=cond)
+            loss = fluid.layers.mean(h) + 0.0 * acc
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        Bf16Transpiler().transpile(main)  # must not raise
+        with scope_guard(Scope(seed=0)):
+            exe = fluid.Executor()
+            exe.run(startup)
+            xb = np.ones((2, 4), np.float32)
+            (lv,) = exe.run(main, feed={"wx": xb}, fetch_list=[loss.name])
+            assert np.isfinite(np.asarray(lv).astype(np.float32)).all()
+
+    def test_train_mode_fill_constant_retyped(self):
+        """Attr-driven producers of flipped vars must emit bf16 (e.g. the
+        backward's loss@GRAD seed)."""
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="fx", shape=[4], dtype="float32")
+            loss = fluid.layers.mean(fluid.layers.fc(x, size=1))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        Bf16Transpiler().transpile(main)
+        gb = main.global_block()
+        seeds = [
+            op
+            for op in gb.ops
+            if op.type == "fill_constant"
+            and any(n.endswith("@GRAD") for ns in op.outputs.values() for n in ns)
+        ]
+        assert seeds, "no grad seed found"
+        for op in seeds:
+            out = [n for ns in op.outputs.values() for n in ns][0]
+            assert gb.var(out).dtype == "bfloat16"
+            assert str(op.attrs["dtype"]) == "bfloat16"
+
     def test_inference_bf16(self):
         main, startup = framework.Program(), framework.Program()
         with fluid.unique_name.guard():

@@ -1,0 +1,507 @@
+"""Per-HLO MFU gap audit (PROFILE.md round-4).
+
+For a bench training step (transformer / resnet), profiles per-HLO device
+time on the real chip, joins each top instruction with its compiled-HLO
+definition (shapes, opcode), computes achieved TF/s (matmul/conv/custom-call)
+or GB/s (fusions, from operand+result HBM bytes), and — with --probe — runs
+an isolated same-shape probe per top instruction to measure that shape's own
+ceiling on this chip. The achieved-vs-probe table is the evidence artifact
+for the MFU narrative: every top HLO is either at its probe ceiling (chip
+cap, not a framework defect) or the gap is a concrete work item.
+
+Usage (on the bench chip):
+    python tools/mfu_audit.py transformer [--probe] [--steps 10] [--top 12]
+    python tools/mfu_audit.py resnet      [--probe]
+
+Writes audit JSON to MFU_AUDIT_<model>.json and prints a markdown table.
+
+Reference analog: the per-op profiler tables the reference builds from CUPTI
+(platform/device_tracer.cc) — here extended with roofline accounting, which
+the reference never had.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+PEAK_MM_TFLOPS = 192.0  # measured: single large independent bf16 matmul
+PEAK_BW_GBS = 676.0  # measured: large elementwise fusion HBM bandwidth
+
+_SHAPE_RE = re.compile(r"(bf16|f32|f16|s32|s64|u32|u8|s8|pred|u64)\[([\d,]*)\]")
+_DTYPE_BYTES = {"bf16": 2, "f16": 2, "f32": 4, "s32": 4, "u32": 4, "s64": 8,
+                "u64": 8, "u8": 1, "s8": 1, "pred": 1}
+
+
+def _parse_shapes(text):
+    """All dtype[shape] tokens in an HLO snippet -> [(dtype, dims, bytes)]."""
+    out = []
+    for m in _SHAPE_RE.finditer(text):
+        dt = m.group(1)
+        dims = [int(x) for x in m.group(2).split(",") if x] or [1]
+        n = 1
+        for x in dims:
+            n *= x
+        out.append((dt, dims, n * _DTYPE_BYTES[dt]))
+    return out
+
+
+_OPCODE_RE = re.compile(r"(?:^| )([a-z][a-z0-9\-_]*)\(")
+
+
+class HloIndex:
+    """Instruction name -> definition line, with operand-shape lookup and
+    per-computation membership (to attribute dot/conv FLOPs inside fusions —
+    the TPU backend fuses dots into kOutput fusions, so top-level fusion
+    nodes carry the MXU work)."""
+
+    def __init__(self, hlo_text):
+        self.defs = {}
+        self.members = {}  # computation name -> [instr names]
+        cur = None
+        for line in hlo_text.splitlines():
+            if not line.startswith(" "):
+                # computation headers are unindented:
+                #   [ENTRY ]%name (params...) -> result {
+                cm = re.match(r"(?:ENTRY )?%?([\w.\-]+) \(.*->.*\{\s*$", line)
+                cur = cm.group(1) if cm else None
+                continue
+            m = re.match(r"\s*(?:ROOT )?%?([\w.\-]+) = (.*)", line)
+            if m:
+                self.defs[m.group(1)] = m.group(2)
+                if cur is not None:
+                    self.members.setdefault(cur, []).append(m.group(1))
+
+    def line(self, name):
+        return self.defs.get(name) or self.defs.get(name.split(".")[0], "")
+
+    def _split(self, name):
+        """def -> (result_text, opcode, operand_list_text). The result may be
+        a tuple, so the opcode is the first lowercase word directly before a
+        '(' (layout tokens like T(8,128) are uppercase; dtypes carry no
+        paren)."""
+        d = self.line(name)
+        m = _OPCODE_RE.search(d)
+        if not m:
+            return d, "?", ""
+        head = d[: m.start()]
+        args = d[m.end():].split(")", 1)[0]  # m ends just past the '('
+        return head, m.group(1), args
+
+    def result_shapes(self, name):
+        head, _, _ = self._split(name)
+        return _parse_shapes(head)
+
+    def opcode(self, name):
+        return self._split(name)[1]
+
+    def operand_names(self, name):
+        _, _, args = self._split(name)
+        return re.findall(r"%([\w.\-]+)", args)
+
+    def hbm_bytes(self, name):
+        """Result bytes + operand bytes (fusion roofline traffic estimate)."""
+        total = sum(b for _, _, b in self.result_shapes(name))
+        for op in self.operand_names(name):
+            total += sum(b for _, _, b in self.result_shapes(op))
+        return total
+
+    def dot_flops(self, name):
+        """2 * batch * M * N * K from a dot's operand shapes + dim numbers."""
+        d = self.line(name)
+        ops = self.operand_names(name)
+        if len(ops) < 2:
+            return 0
+        lhs = self.result_shapes(ops[0])
+        rhs = self.result_shapes(ops[1])
+        if not lhs or not rhs:
+            return 0
+        lhs_dims, rhs_dims = lhs[0][1], rhs[0][1]
+
+        def dims_of(attr):
+            m = re.search(attr + r"=\{([\d,]*)\}", d)
+            return [int(x) for x in m.group(1).split(",") if x] if m else []
+
+        lb, lc = dims_of("lhs_batch_dims"), dims_of("lhs_contracting_dims")
+        batch = 1
+        for i in lb:
+            batch *= lhs_dims[i]
+        k = 1
+        for i in lc:
+            k *= lhs_dims[i]
+        m_free = 1
+        for i, sz in enumerate(lhs_dims):
+            if i not in lb and i not in lc:
+                m_free *= sz
+        rb, rc = dims_of("rhs_batch_dims"), dims_of("rhs_contracting_dims")
+        n_free = 1
+        for i, sz in enumerate(rhs_dims):
+            if i not in rb and i not in rc:
+                n_free *= sz
+        return 2 * batch * m_free * n_free * k
+
+    def instr_flops(self, name):
+        """FLOPs of this instruction: dot/conv directly, or the sum over
+        dots/convs inside the called fused computation(s), recursively."""
+        op = self.opcode(name)
+        if op == "dot":
+            return self.dot_flops(name)
+        if op == "convolution":
+            return self.conv_flops(name)
+        if op == "fusion":
+            m = re.search(r"calls=%([\w.\-]+)", self.line(name))
+            if not m:
+                return 0
+            return sum(
+                self.instr_flops(n)
+                for n in self.members.get(m.group(1), [])
+                if self.opcode(n) in ("dot", "convolution")
+            )
+        return 0
+
+    def heavy_op_names(self, name):
+        """op_name metadata of the dots/convs inside this fusion (who put
+        the MXU work here)."""
+        out = []
+        op = self.opcode(name)
+        if op in ("dot", "convolution"):
+            m = re.search(r'op_name="([^"]+)"', self.line(name))
+            out.append(m.group(1) if m else name)
+        elif op == "fusion":
+            m = re.search(r"calls=%([\w.\-]+)", self.line(name))
+            if m:
+                for n in self.members.get(m.group(1), []):
+                    if self.opcode(n) in ("dot", "convolution"):
+                        out.extend(self.heavy_op_names(n))
+        return out
+
+    def conv_flops(self, name):
+        """Exact MAC count: 2 * batch * Cout * Cin_rhs * prod_d(valid taps
+        summed over output positions). The TPU backend rewrites batched dots
+        as windowed convs with pad/reversal tricks, so naive
+        out*cin*kernel overcounts — only taps landing on real (non-pad,
+        non-dilation-hole) input elements are MACs."""
+        d = self.line(name)
+        ops = self.operand_names(name)
+        res = self.result_shapes(name)
+        if len(ops) < 2 or not res:
+            return 0
+        lhs = self.result_shapes(ops[0])
+        rhs = self.result_shapes(ops[1])
+        if not rhs or not lhs:
+            return 0
+        m = re.search(r"dim_labels=([\w?]+)_([\w?]+)->([\w?]+)", d)
+        if not m:
+            return 0
+        lhs_lab, rhs_lab, out_lab = m.groups()
+        lhs_dims, rhs_dims, out_dims = lhs[0][1], rhs[0][1], res[0][1]
+        try:
+            batch = lhs_dims[lhs_lab.index("b")]
+            cin = rhs_dims[rhs_lab.index("i")]
+            cout = rhs_dims[rhs_lab.index("o")]
+        except ValueError:
+            return 0
+        n_spatial = len(rhs_lab) - 2
+
+        def wfield(key, default, n):
+            mm = re.search(key + r"=([\dx_]+)", d)
+            if not mm:
+                return [default] * n
+            return mm.group(1).split("x")
+
+        sizes = [int(x) for x in wfield("size", "1", n_spatial)]
+        strides = [int(x) for x in wfield("stride", "1", n_spatial)]
+        pads = [tuple(int(p) for p in x.split("_")) if isinstance(x, str) and "_" in str(x)
+                else (0, 0) for x in wfield("pad", "0_0", n_spatial)]
+        lhs_dil = [int(x) for x in wfield("lhs_dilate", "1", n_spatial)]
+        rhs_dil = [int(x) for x in wfield("rhs_dilate", "1", n_spatial)]
+
+        spatial_macs = 1
+        for sd in range(n_spatial):
+            lab = str(sd)
+            I = lhs_dims[lhs_lab.index(lab)]
+            K = rhs_dims[rhs_lab.index(lab)]
+            O = out_dims[out_lab.index(lab)]
+            if K != sizes[sd]:  # window size is authoritative
+                K = sizes[sd]
+            ext = (I - 1) * lhs_dil[sd] + 1  # dilated input extent
+            s_d = 0
+            for o in range(O):
+                base = o * strides[sd] - pads[sd][0]
+                for k in range(K):
+                    pos = base + k * rhs_dil[sd]
+                    if 0 <= pos < ext and pos % lhs_dil[sd] == 0:
+                        s_d += 1
+            spatial_macs *= s_d
+        return 2 * batch * cout * cin * spatial_macs
+
+
+
+def profile_step(model, steps, b=None):
+    """Run the bench step on the chip; return (hlo_text, events, wall_ms).
+
+    events: {instr_name: total_device_ms} summed over `steps` steps."""
+    import jax
+
+    import bench
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import profiler
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.transpiler.bf16_transpiler import Bf16Transpiler
+
+    if model == "transformer":
+        main, startup, feed, loss, flops = bench.build_transformer()
+    elif model == "resnet":
+        bs = b or 256
+        main, startup, loss = bench.build(bs)
+        rng = np.random.RandomState(0)
+        feed = {
+            "img": jax.device_put(rng.randn(bs, 3, 224, 224).astype("float32")),
+            "label": jax.device_put(rng.randint(0, 1000, (bs, 1)).astype("int32")),
+        }
+        flops = None
+    else:
+        raise SystemExit("unknown model %r" % model)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        Bf16Transpiler().transpile(main)
+        for _ in range(3):
+            (l,) = exe.run(main, feed=feed, fetch_list=[loss.name],
+                           return_numpy=False)
+        np.asarray(l)
+        hlo = exe.compiled_hlo()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (l,) = exe.run(main, feed=feed, fetch_list=[loss.name],
+                           return_numpy=False)
+        np.asarray(l)
+        wall_ms = (time.perf_counter() - t0) / steps * 1e3  # untraced wall
+        log_dir = tempfile.mkdtemp(prefix="mfu_audit_")
+        with profiler.xla_trace(log_dir):
+            for _ in range(steps):
+                (l,) = exe.run(main, feed=feed, fetch_list=[loss.name],
+                               return_numpy=False)
+            np.asarray(l)
+
+    events = collect_events(log_dir)
+    return hlo, events, wall_ms, flops
+
+
+def collect_events(log_dir):
+    """{instr: total_device_ms} via the shared profiler helper."""
+    from paddle_tpu import profiler
+
+    return {
+        name: row[1]
+        for name, row in profiler.device_instr_events(log_dir).items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# probes: isolated same-shape ceiling measurements
+# ---------------------------------------------------------------------------
+
+
+def _device_ms_of(fn, args, iters=8, instr_filter=None):
+    """Total device-busy ms of one call, from a trace around `iters` calls."""
+    import jax
+
+    from paddle_tpu import profiler
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.tree_util.tree_leaves(out)[0][..., :1])  # force host sync
+    log_dir = tempfile.mkdtemp(prefix="mfu_probe_")
+    with profiler.xla_trace(log_dir):
+        for _ in range(iters):
+            out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0][..., :1])
+    ev = collect_events(log_dir)
+    tot = sum(ms for name, ms in ev.items()
+              if instr_filter is None or instr_filter(name))
+    return tot / iters
+
+
+def probe_dot(lhs_shape, rhs_shape, dimension_numbers, dtype, out_dtype):
+    """Same-shape dot alone in a jit; returns ms/call (device)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(*lhs_shape), dtype)
+    bb = jnp.asarray(rng.randn(*rhs_shape), dtype)
+
+    @jax.jit
+    def f(a, bb):
+        return lax.dot_general(a, bb, dimension_numbers,
+                               preferred_element_type=out_dtype)
+
+    return _device_ms_of(f, (a, bb))
+
+
+def probe_bandwidth(n_bytes):
+    """Streaming elementwise probe moving ~n_bytes through HBM; GB/s."""
+    import jax
+    import jax.numpy as jnp
+
+    n = max(n_bytes // 3 // 2, 1 << 20)  # 2 reads + 1 write of bf16
+    x = jnp.ones((n,), jnp.bfloat16)
+    y = jnp.ones((n,), jnp.bfloat16)
+
+    @jax.jit
+    def f(x, y):
+        return x * 1.0001 + y
+
+    ms = _device_ms_of(f, (x, y))
+    return (3 * n * 2) / (ms / 1e3) / 1e9
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model", choices=["transformer", "resnet"])
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--top", type=int, default=12)
+    ap.add_argument("--probe", action="store_true",
+                    help="run isolated same-shape probes for top dots")
+    ap.add_argument("--hlo-out", default=None,
+                    help="also write the compiled HLO text here")
+    args = ap.parse_args()
+
+    hlo, events, wall_ms, flops = profile_step(args.model, args.steps)
+    if args.hlo_out:
+        with open(args.hlo_out, "w") as f:
+            f.write(hlo)
+    idx = HloIndex(hlo)
+    busy_ms = sum(events.values()) / args.steps
+
+    rows = []
+    tot_fl = tot_bytes = tot_est = 0.0
+    for name, tot in sorted(events.items(), key=lambda kv: -kv[1]):
+        ms = tot / args.steps
+        d = idx.line(name)
+        opcode = idx.opcode(name)
+        fl = idx.instr_flops(name)
+        nbytes = idx.hbm_bytes(name)
+        # roofline: overlapped MXU + HBM model against this chip's measured
+        # ceilings (memory file / PROFILE.md probes)
+        est_ms = max(fl / PEAK_MM_TFLOPS / 1e9, nbytes / PEAK_BW_GBS / 1e6)
+        tot_fl += fl
+        tot_bytes += nbytes
+        tot_est += est_ms
+        rows.append({
+            "instr": name, "opcode": opcode, "ms_per_step": round(ms, 3),
+            "pct_busy": round(100 * ms / busy_ms, 1) if busy_ms else 0,
+            "tflops": round(fl / (ms / 1e3) / 1e12, 1) if fl and ms else None,
+            "gbs": round(nbytes / (ms / 1e3) / 1e9, 0) if ms else None,
+            "roofline_ms": round(est_ms, 3),
+            "x_roofline": round(ms / est_ms, 2) if est_ms else None,
+            "ops": sorted(set(idx.heavy_op_names(name)))[:3],
+            "def": d[:160],
+        })
+
+    # category roll-up: how the busy time splits
+    cats = {}
+    for r in rows:
+        if r["opcode"] == "custom-call":
+            c = "custom-call (pallas flash)"
+        elif r["tflops"]:
+            c = "matmul-bearing fusions"
+        elif r["opcode"] in ("fusion",):
+            c = "elementwise/reduce fusions"
+        else:
+            c = r["opcode"]
+        e = cats.setdefault(c, [0.0, 0.0, 0.0])  # ms, tflop, gb
+        e[0] += r["ms_per_step"]
+        e[1] += (r["tflops"] or 0) * r["ms_per_step"] / 1e3
+        e[2] += (r["gbs"] or 0) * r["ms_per_step"] / 1e3
+
+    top = rows[: args.top]
+    measured_bw = None
+    if args.probe:
+        # validate the PEAK_BW_GBS constant on this chip while we're here
+        measured_bw = round(probe_bandwidth(1 << 30), 0)
+        for r in top:
+            if r["opcode"] == "dot":
+                r["probe_ms"] = probe_same_dot(idx, r["instr"])
+                if r["probe_ms"]:
+                    r["probe_tflops"] = round(
+                        idx.dot_flops(r["instr"]) / (r["probe_ms"] / 1e3) / 1e12, 1)
+                    r["frac_of_probe"] = round(r["probe_ms"] / r["ms_per_step"], 3)
+
+    out = {
+        "model": args.model, "steps": args.steps,
+        "wall_ms_per_step": round(wall_ms, 1),
+        "device_busy_ms_per_step": round(busy_ms, 1),
+        "duty": round(busy_ms / wall_ms, 3),
+        "hlo_total_tflops": round(tot_fl / 1e12, 2),
+        "hlo_total_gb": round(tot_bytes / 1e9, 2),
+        "roofline_min_busy_ms": round(tot_est, 1),
+        "busy_x_roofline": round(busy_ms / tot_est, 2) if tot_est else None,
+        "measured_bw_gbs": measured_bw,
+        "categories": {
+            c: {"ms": round(v[0], 1), "tflop": round(v[1], 2),
+                "gb": round(v[2], 1)}
+            for c, v in sorted(cats.items(), key=lambda kv: -kv[1][0])
+        },
+        "rows": rows,
+    }
+    if flops:
+        out["counted_tflops_per_step"] = round(flops / 1e12, 2)
+        out["achieved_tflops_wall"] = round(flops / (wall_ms / 1e3) / 1e12, 1)
+        out["achieved_tflops_busy"] = round(flops / (busy_ms / 1e3) / 1e12, 1)
+    path = "MFU_AUDIT_%s.json" % args.model
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "rows"}))
+    fmt = "%-28s %-10s %8s %6s %7s %7s %8s %6s  %s"
+    print(fmt % ("instr", "opcode", "ms/step", "%busy", "TF/s", "GB/s",
+                 "roof_ms", "x_roof", "ops"))
+    for r in top:
+        print(fmt % (r["instr"][:28], r["opcode"][:10], r["ms_per_step"],
+                     r["pct_busy"], r.get("tflops") or "", r.get("gbs") or "",
+                     r["roofline_ms"], r.get("x_roofline") or "",
+                     ",".join(o.split("/")[-2] if "/" in o else o for o in r["ops"])[:40]))
+    print("wrote", path)
+
+
+def probe_same_dot(idx, name):
+    """Re-run this dot's exact shape isolated; ms/call or None."""
+    import jax.numpy as jnp
+
+    d = idx.line(name)
+    ops = idx.operand_names(name)
+    if len(ops) < 2:
+        return None
+    lhs = idx.result_shapes(ops[0])
+    rhs = idx.result_shapes(ops[1])
+    res = idx.result_shapes(name)
+    if not (lhs and rhs and res):
+        return None
+
+    def dims_of(attr):
+        m = re.search(attr + r"=\{([\d,]*)\}", d)
+        return tuple(int(x) for x in m.group(1).split(",") if x) if m else ()
+
+    dn = ((dims_of("lhs_contracting_dims"), dims_of("rhs_contracting_dims")),
+          (dims_of("lhs_batch_dims"), dims_of("rhs_batch_dims")))
+    jdt = {"bf16": jnp.bfloat16, "f32": jnp.float32}
+    try:
+        return round(probe_dot(tuple(lhs[0][1]), tuple(rhs[0][1]), dn,
+                               jdt[lhs[0][0]], jdt[res[0][0]]), 3)
+    except Exception as e:
+        print("probe failed for %s: %r" % (name, e), file=sys.stderr)
+        return None
+
+
+if __name__ == "__main__":
+    main()
