@@ -56,6 +56,19 @@ def _parse_shapes(text):
 _OPCODE_RE = re.compile(r"(?:^| )([a-z][a-z0-9\-_]*)\(")
 
 
+def _attr_dims(d, attr):
+    """Parse `attr={1,2}` from an HLO def -> tuple of ints."""
+    m = re.search(attr + r"=\{([\d,]*)\}", d)
+    return tuple(int(x) for x in m.group(1).split(",") if x) if m else ()
+
+
+def _window_field(d, key, default, n):
+    """Parse a window sub-field `key=v0xv1...` -> list of n string tokens
+    (values may be negative, e.g. backward-conv pads)."""
+    m = re.search(key + r"=([-\dx_]+)", d)
+    return m.group(1).split("x") if m else [default] * n
+
+
 class HloIndex:
     """Instruction name -> definition line, with operand-shape lookup and
     per-computation membership (to attribute dot/conv FLOPs inside fusions —
@@ -124,12 +137,7 @@ class HloIndex:
         if not lhs or not rhs:
             return 0
         lhs_dims, rhs_dims = lhs[0][1], rhs[0][1]
-
-        def dims_of(attr):
-            m = re.search(attr + r"=\{([\d,]*)\}", d)
-            return [int(x) for x in m.group(1).split(",") if x] if m else []
-
-        lb, lc = dims_of("lhs_batch_dims"), dims_of("lhs_contracting_dims")
+        lb, lc = _attr_dims(d, "lhs_batch_dims"), _attr_dims(d, "lhs_contracting_dims")
         batch = 1
         for i in lb:
             batch *= lhs_dims[i]
@@ -140,7 +148,7 @@ class HloIndex:
         for i, sz in enumerate(lhs_dims):
             if i not in lb and i not in lc:
                 m_free *= sz
-        rb, rc = dims_of("rhs_batch_dims"), dims_of("rhs_contracting_dims")
+        rb, rc = _attr_dims(d, "rhs_batch_dims"), _attr_dims(d, "rhs_contracting_dims")
         n_free = 1
         for i, sz in enumerate(rhs_dims):
             if i not in rb and i not in rc:
@@ -209,19 +217,12 @@ class HloIndex:
         except ValueError:
             return 0
         n_spatial = len(rhs_lab) - 2
-
-        def wfield(key, default, n):
-            mm = re.search(key + r"=([\dx_]+)", d)
-            if not mm:
-                return [default] * n
-            return mm.group(1).split("x")
-
-        sizes = [int(x) for x in wfield("size", "1", n_spatial)]
-        strides = [int(x) for x in wfield("stride", "1", n_spatial)]
-        pads = [tuple(int(p) for p in x.split("_")) if isinstance(x, str) and "_" in str(x)
-                else (0, 0) for x in wfield("pad", "0_0", n_spatial)]
-        lhs_dil = [int(x) for x in wfield("lhs_dilate", "1", n_spatial)]
-        rhs_dil = [int(x) for x in wfield("rhs_dilate", "1", n_spatial)]
+        sizes = [int(x) for x in _window_field(d, "size", "1", n_spatial)]
+        strides = [int(x) for x in _window_field(d, "stride", "1", n_spatial)]
+        pads = [tuple(int(p) for p in x.split("_")) if "_" in x else (0, 0)
+                for x in _window_field(d, "pad", "0_0", n_spatial)]
+        lhs_dil = [int(x) for x in _window_field(d, "lhs_dilate", "1", n_spatial)]
+        rhs_dil = [int(x) for x in _window_field(d, "rhs_dilate", "1", n_spatial)]
 
         spatial_macs = 1
         for sd in range(n_spatial):
@@ -296,14 +297,22 @@ def profile_step(model, steps, b=None):
     return hlo, events, wall_ms, flops
 
 
-def collect_events(log_dir):
-    """{instr: total_device_ms} via the shared profiler helper."""
+def collect_events(log_dir, cleanup=True):
+    """{instr: total_device_ms} via the shared profiler helper. Removes the
+    trace dir afterwards (probe loops would otherwise pile up multi-MB
+    xplane dumps in /tmp)."""
+    import shutil
+
     from paddle_tpu import profiler
 
-    return {
-        name: row[1]
-        for name, row in profiler.device_instr_events(log_dir).items()
-    }
+    try:
+        return {
+            name: row[1]
+            for name, row in profiler.device_instr_events(log_dir).items()
+        }
+    finally:
+        if cleanup:
+            shutil.rmtree(log_dir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------
@@ -311,7 +320,7 @@ def collect_events(log_dir):
 # ---------------------------------------------------------------------------
 
 
-def _device_ms_of(fn, args, iters=8, instr_filter=None):
+def _device_ms_of(fn, args, iters=8):
     """Total device-busy ms of one call, from a trace around `iters` calls."""
     import jax
 
@@ -325,10 +334,7 @@ def _device_ms_of(fn, args, iters=8, instr_filter=None):
         for _ in range(iters):
             out = fn(*args)
         np.asarray(jax.tree_util.tree_leaves(out)[0][..., :1])
-    ev = collect_events(log_dir)
-    tot = sum(ms for name, ms in ev.items()
-              if instr_filter is None or instr_filter(name))
-    return tot / iters
+    return sum(collect_events(log_dir).values()) / iters
 
 
 def probe_dot(lhs_shape, rhs_shape, dimension_numbers, dtype, out_dtype):
@@ -431,12 +437,14 @@ def main():
         # validate the PEAK_BW_GBS constant on this chip while we're here
         measured_bw = round(probe_bandwidth(1 << 30), 0)
         for r in top:
-            if r["opcode"] == "dot":
-                r["probe_ms"] = probe_same_dot(idx, r["instr"])
-                if r["probe_ms"]:
-                    r["probe_tflops"] = round(
-                        idx.dot_flops(r["instr"]) / (r["probe_ms"] / 1e3) / 1e12, 1)
-                    r["frac_of_probe"] = round(r["probe_ms"] / r["ms_per_step"], 3)
+            fl = idx.instr_flops(r["instr"])
+            if not fl:
+                continue
+            probe_ms = probe_instr(idx, r["instr"])
+            if probe_ms:
+                r["probe_ms"] = probe_ms
+                r["probe_tflops"] = round(fl / (probe_ms / 1e3) / 1e12, 1)
+                r["x_probe"] = round(r["ms_per_step"] / probe_ms, 2)
 
     out = {
         "model": args.model, "steps": args.steps,
@@ -474,8 +482,31 @@ def main():
     print("wrote", path)
 
 
-def probe_same_dot(idx, name):
-    """Re-run this dot's exact shape isolated; ms/call or None."""
+_JDT = {"bf16": "bfloat16", "f32": "float32"}
+
+
+def probe_instr(idx, name):
+    """Isolated same-shape ceiling for the MXU work this instruction (or the
+    fusion wrapping it) carries: sum of per-dot/conv probes; ms or None."""
+    op = idx.opcode(name)
+    if op in ("dot", "convolution"):
+        return _probe_one(idx, name)
+    if op == "fusion":
+        m = re.search(r"calls=%([\w.\-]+)", idx.line(name))
+        if not m:
+            return None
+        total = 0.0
+        for n in idx.members.get(m.group(1), []):
+            if idx.opcode(n) in ("dot", "convolution"):
+                p = _probe_one(idx, n)
+                if p is None:
+                    return None
+                total += p
+        return round(total, 3) or None
+    return None
+
+
+def _probe_one(idx, name):
     import jax.numpy as jnp
 
     d = idx.line(name)
@@ -487,20 +518,74 @@ def probe_same_dot(idx, name):
     res = idx.result_shapes(name)
     if not (lhs and rhs and res):
         return None
-
-    def dims_of(attr):
-        m = re.search(attr + r"=\{([\d,]*)\}", d)
-        return tuple(int(x) for x in m.group(1).split(",") if x) if m else ()
-
-    dn = ((dims_of("lhs_contracting_dims"), dims_of("rhs_contracting_dims")),
-          (dims_of("lhs_batch_dims"), dims_of("rhs_batch_dims")))
-    jdt = {"bf16": jnp.bfloat16, "f32": jnp.float32}
+    jdt = {k: getattr(jnp, v) for k, v in _JDT.items()}
     try:
-        return round(probe_dot(tuple(lhs[0][1]), tuple(rhs[0][1]), dn,
-                               jdt[lhs[0][0]], jdt[res[0][0]]), 3)
+        if idx.opcode(name) == "dot":
+            dn = (
+                (_attr_dims(d, "lhs_contracting_dims"),
+                 _attr_dims(d, "rhs_contracting_dims")),
+                (_attr_dims(d, "lhs_batch_dims"),
+                 _attr_dims(d, "rhs_batch_dims")),
+            )
+            return round(
+                probe_dot(tuple(lhs[0][1]), tuple(rhs[0][1]), dn,
+                          jdt[lhs[0][0]], jdt[res[0][0]]), 3)
+        # convolution: matmul-like (2-letter labels) probes as dot_general;
+        # spatial convs probe via conv_general_dilated with the same window
+        m = re.search(r"dim_labels=([\w?]+)_([\w?]+)->([\w?]+)", d)
+        if not m:
+            return None
+        lhs_lab, rhs_lab, out_lab = m.groups()
+        if len(rhs_lab) == 2:  # pure matmul as conv
+            dn = (((lhs_lab.index("f"),), (rhs_lab.index("i"),)), ((), ()))
+            return round(
+                probe_dot(tuple(lhs[0][1]), tuple(rhs[0][1]), dn,
+                          jdt[lhs[0][0]], jdt[res[0][0]]), 3)
+        return round(
+            _probe_conv(d, tuple(lhs[0][1]), tuple(rhs[0][1]),
+                        jdt[lhs[0][0]], jdt[rhs[0][0]], jdt[res[0][0]],
+                        lhs_lab, rhs_lab, out_lab), 3)
     except Exception as e:
         print("probe failed for %s: %r" % (name, e), file=sys.stderr)
         return None
+
+
+def _probe_conv(d, lhs_shape, rhs_shape, lhs_dt, rhs_dt, out_dt,
+                lhs_lab, rhs_lab, out_lab):
+    """Same-shape conv_general_dilated, window attrs parsed from the HLO."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_spatial = len(rhs_lab) - 2
+    strides = [int(x) for x in _window_field(d, "stride", "1", n_spatial)]
+    pads = [tuple(int(p) for p in x.split("_")) if "_" in x else (0, 0)
+            for x in _window_field(d, "pad", "0_0", n_spatial)]
+    lhs_dil = [int(x) for x in _window_field(d, "lhs_dilate", "1", n_spatial)]
+    rhs_dil = [int(x) for x in _window_field(d, "rhs_dilate", "1", n_spatial)]
+
+    def spec(lab):
+        # HLO conv labels -> XLA dimension_numbers string: b->N, f->C, i->I,
+        # o->O, digits stay
+        return "".join(
+            {"b": "N", "f": "C", "i": "I", "o": "O"}.get(c, c) for c in lab
+        )
+
+    dn = lax.conv_dimension_numbers(
+        lhs_shape, rhs_shape, (spec(lhs_lab), spec(rhs_lab), spec(out_lab))
+    )
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(*lhs_shape), lhs_dt)
+    w = jnp.asarray(rng.randn(*rhs_shape), rhs_dt)
+
+    @jax.jit
+    def f(a, w):
+        return lax.conv_general_dilated(
+            a, w, strides, pads, lhs_dilation=lhs_dil, rhs_dilation=rhs_dil,
+            dimension_numbers=dn, preferred_element_type=out_dt,
+        )
+
+    return _device_ms_of(f, (a, w))
 
 
 if __name__ == "__main__":
