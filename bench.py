@@ -91,9 +91,50 @@ def run(batch_size=256, steps=20, warmup=3, n_staged=4, bf16=True,
             )
         np.asarray(l)  # sync
         dt = time.perf_counter() - t0
-        staged_ips = batch_size * steps / dt
+        single_ips = batch_size * steps / dt
+
+        # multi-step dispatch (the headline): n_staged iterations per XLA
+        # call (Executor steps_per_run -> lax.scan with donated state), so
+        # the per-call host dispatch cost (~480 state buffers; ~3 ms on the
+        # bench tunnel, PROFILE.md "dispatch") is paid once per k steps and
+        # wall-clock tracks device-busy time.
+        import jax.numpy as jnp
+
+        # k=2*n_staged per call: on-chip sweep showed ~13 ms of per-call
+        # host overhead (dispatch + fetch sync), so k=8 holds the step
+        # within ~2% of device-busy time while keeping the stacked feed at
+        # ~1.2 GB (k x 154 MB for bs=256). If the extra feed memory does
+        # not fit, the measured single-dispatch result stands as headline
+        # rather than dropping the whole bench to a smaller batch tier.
+        try:
+            stacked = {
+                n: jnp.stack([b[n] for b in batches] * 2) for n in batches[0]
+            }
+            del batches  # free per-step staged copies before the stacked pass
+            k = 2 * n_staged
+            calls = max(2, steps // k)
+            (l,) = exe.run(
+                main, feed=stacked, fetch_list=[loss.name],
+                return_numpy=False, steps_per_run=k,
+            )  # compile + warm
+            np.asarray(l)
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                (l,) = exe.run(
+                    main, feed=stacked, fetch_list=[loss.name],
+                    return_numpy=False, steps_per_run=k,
+                )
+            np.asarray(l)  # sync
+            dt = time.perf_counter() - t0
+            staged_ips = batch_size * k * calls / dt
+            del stacked, l  # free ~1.2 GB before the pipeline passes stage
+        except Exception as e:
+            print("multi-step pass failed, keeping single-dispatch headline: %r"
+                  % e, file=sys.stderr)
+            staged_ips = single_ips
         if not measure_pipeline:
-            return staged_ips, None
+            return staged_ips, single_ips, None, None
+        pyreader_ips = pyreader_u8_ips = None
         try:
             pyreader_ips = _run_pyreader_pass(
                 exe, main, loss, batch_size, steps, warmup, n_staged, rng
@@ -101,21 +142,45 @@ def run(batch_size=256, steps=20, warmup=3, n_staged=4, bf16=True,
         except Exception as e:
             # evidence pass must never invalidate the measured headline
             print("pyreader pass failed: %r" % e, file=sys.stderr)
-            pyreader_ips = None
-    return staged_ips, pyreader_ips
+        try:
+            # compact wire format (VERDICT-4b): uint8 pixels over the link
+            # (38.5 MB/step at bs=256 instead of 154 MB), cast to the
+            # declared f32/bf16 var dtype ON device, fused into the step
+            pyreader_u8_ips = _run_pyreader_pass(
+                exe, main, loss, batch_size, steps, warmup, n_staged, rng,
+                wire="uint8",
+            )
+        except Exception as e:
+            print("uint8 pyreader pass failed: %r" % e, file=sys.stderr)
+    return staged_ips, single_ips, pyreader_ips, pyreader_u8_ips
 
 
-def _run_pyreader_pass(exe, main, loss, batch_size, steps, warmup, n_staged, rng):
-    """PyReader-fed pass: fresh host batches each step, async staging."""
+def _run_pyreader_pass(exe, main, loss, batch_size, steps, warmup, n_staged,
+                       rng, wire="float32"):
+    """PyReader-fed pass: fresh host batches each step, async staging.
+    wire="uint8" feeds raw pixel bytes (4x fewer bytes over the
+    host->device link); the executor casts to the declared var dtype on
+    device at trace time, fused into the compiled step."""
     from paddle_tpu.py_reader import PyReader
 
-    host_batches = [
-        {
-            "img": rng.randn(batch_size, 3, 224, 224).astype("float32"),
-            "label": rng.randint(0, 1000, (batch_size, 1)).astype("int32"),
-        }
-        for _ in range(n_staged)
-    ]
+    if wire == "uint8":
+        host_batches = [
+            {
+                "img": rng.randint(
+                    0, 256, (batch_size, 3, 224, 224)
+                ).astype("uint8"),
+                "label": rng.randint(0, 1000, (batch_size, 1)).astype("int32"),
+            }
+            for _ in range(n_staged)
+        ]
+    else:
+        host_batches = [
+            {
+                "img": rng.randn(batch_size, 3, 224, 224).astype("float32"),
+                "label": rng.randint(0, 1000, (batch_size, 1)).astype("int32"),
+            }
+            for _ in range(n_staged)
+        ]
 
     def gen():
         for i in range(steps + warmup):
@@ -191,7 +256,8 @@ def run_vgg19(bs=64, steps=12, warmup=3):
         return bs * steps / (time.perf_counter() - t0)
 
 
-def run_lstm(hid=512, bs=64, t=100, dict_dim=30000, steps=10, warmup=3):
+def run_lstm(hid=512, bs=64, t=100, dict_dim=30000, steps=10, warmup=3,
+             measure_pipeline=False):
     """Tertiary metric: BASELINE config 5 (stacked dynamic-LSTM text model,
     models/stacked_lstm.py) at the reference's published RNN benchmark shape.
     Full-length sequences (the reference pads to t=100 for its comparison
@@ -223,14 +289,70 @@ def run_lstm(hid=512, bs=64, t=100, dict_dim=30000, steps=10, warmup=3):
         from paddle_tpu.transpiler.bf16_transpiler import Bf16Transpiler
 
         Bf16Transpiler().transpile(main)
-        for _ in range(warmup):
-            (l,) = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
+        # multi-step dispatch: at 18 ms/batch the ~3 ms per-call dispatch is
+        # a real fraction; one scan call runs all `steps` batches (token
+        # feeds are ~50 KB, stacking is free)
+        import jax.numpy as jnp
+
+        stacked = {n: jnp.stack([v] * steps) for n, v in feed.items()}
+        for _ in range(warmup // 2 + 1):
+            (l,) = exe.run(
+                main, feed=stacked, fetch_list=[loss.name],
+                return_numpy=False, steps_per_run=steps,
+            )
         np.asarray(l)
         t0 = time.perf_counter()
-        for _ in range(steps):
-            (l,) = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
+        (l,) = exe.run(
+            main, feed=stacked, fetch_list=[loss.name],
+            return_numpy=False, steps_per_run=steps,
+        )
         np.asarray(l)
-        return (time.perf_counter() - t0) / steps * 1e3
+        staged_ms = (time.perf_counter() - t0) / steps * 1e3
+        if not measure_pipeline:
+            return staged_ms, None
+
+        # Input-pipeline keep-up on a byte-light feed (the VERDICT-4a
+        # evidence): this config moves ~51.5 KB/step over the wire
+        # (64x100 int64 words + lens + labels), so even this harness's
+        # ~22 MB/s host->device tunnel stages a batch in ~2.3 ms — far
+        # inside the ~15 ms/step device time the feeder thread has to hide
+        # it in. capacity >= steps keeps a full multi-step pull staged
+        # ahead, so the timed call pops k device-resident batches and
+        # dispatches immediately.
+        from paddle_tpu.py_reader import PyReader
+
+        try:
+            host = {n: np.asarray(v) for n, v in feed.items()}
+
+            def gen():
+                for _ in range(3 * steps):
+                    yield host
+
+            reader = PyReader(list(feed), capacity=steps + 2)
+            reader.decorate_tensor_provider(gen)
+            main._py_readers = [reader]
+            reader.start()
+            try:
+                (l,) = exe.run(
+                    main, fetch_list=[loss.name], return_numpy=False,
+                    steps_per_run=steps,
+                )
+                np.asarray(l)
+                t0 = time.perf_counter()
+                (l,) = exe.run(
+                    main, fetch_list=[loss.name], return_numpy=False,
+                    steps_per_run=steps,
+                )
+                np.asarray(l)
+                pyreader_ms = (time.perf_counter() - t0) / steps * 1e3
+            finally:
+                reader.reset()
+                main._py_readers = []
+            return staged_ms, staged_ms / pyreader_ms
+        except Exception as e:
+            # evidence pass must never invalidate the measured headline
+            print("lstm pyreader pass failed: %r" % e, file=sys.stderr)
+            return staged_ms, None
 
 
 def build_transformer(b=8, t=1024, d=2048, n_layer=4, vocab=32000):
@@ -294,6 +416,8 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=10,
     import paddle_tpu.fluid as fluid
     from paddle_tpu.executor import Scope, scope_guard
 
+    import jax.numpy as jnp
+
     main, startup, feed, loss, flops = build_transformer(b, t, d, n_layer, vocab)
     exe = fluid.Executor(fluid.TPUPlace())
     with scope_guard(Scope(seed=0)):
@@ -301,12 +425,20 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=10,
         from paddle_tpu.transpiler.bf16_transpiler import Bf16Transpiler
 
         Bf16Transpiler().transpile(main)
-        for _ in range(warmup):
-            (l,) = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
+        # multi-step dispatch: all `steps` iterations in one XLA call (the
+        # token feeds are ~KB-scale, so stacking k copies is free)
+        stacked = {n: jnp.stack([v] * steps) for n, v in feed.items()}
+        for _ in range(warmup // 2 + 1):
+            (l,) = exe.run(
+                main, feed=stacked, fetch_list=[loss.name],
+                return_numpy=False, steps_per_run=steps,
+            )
         np.asarray(l)
         t0 = time.perf_counter()
-        for _ in range(steps):
-            (l,) = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
+        (l,) = exe.run(
+            main, feed=stacked, fetch_list=[loss.name],
+            return_numpy=False, steps_per_run=steps,
+        )
         np.asarray(l)
         dt = (time.perf_counter() - t0) / steps
     return flops / dt / 1e12
@@ -314,11 +446,11 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=10,
 
 def main():
     batch_size = int(sys.argv[1]) if len(sys.argv) > 1 else 256
-    ips = pyreader_ips = None
+    ips = single_ips = pyreader_ips = pyreader_u8_ips = None
     ladder = [batch_size] + [b for b in (128, 64, 32) if b < batch_size]
     for bs in ladder:  # memory-headroom fallback: strictly smaller sizes only
         try:
-            ips, pyreader_ips = run(batch_size=bs)
+            ips, single_ips, pyreader_ips, pyreader_u8_ips = run(batch_size=bs)
             break
         except Exception as e:
             print("bench fallback from bs=%d: %r" % (bs, e), file=sys.stderr)
@@ -330,14 +462,28 @@ def main():
         "unit": "images/sec",
         "vs_baseline": round(ips / BASELINE_IMAGES_PER_SEC, 2),
     }
+    if single_ips:
+        # one dispatch per step, for comparison against the multi-step
+        # headline (the delta IS the measured per-step dispatch cost)
+        record["resnet50_singledispatch_images_per_sec"] = round(single_ips, 2)
     if pyreader_ips:
         # input-pipeline evidence: PyReader-fed throughput as a fraction of
         # the staged-batch ceiling (target >=0.95 — async staging overlaps
-        # the host->device transfer with compute; on THIS bench harness the
-        # axon tunnel's 22 MB/s host->device path caps the fraction far below
-        # that, see PROFILE.md "Input pipeline")
+        # the host->device transfer with compute). TUNNEL BYTE MATH: this
+        # harness's host->device path moves ~22 MB/s; an f32 bs=256 image
+        # batch is 154 MB -> ~7 s/step of wire time vs ~0.11 s of compute,
+        # so the f32 image frac measures the tunnel, not the pipeline
+        # (uint8 wire cuts it 4x; the byte-light token frac below is the
+        # keep-up proof the design target speaks to).
+        # denominator: the SINGLE-dispatch staged ceiling — the pyreader
+        # passes run one dispatch per step, so dividing by the multi-step
+        # headline would misattribute dispatch overhead to the pipeline
+        denom = single_ips or ips
         record["pyreader_images_per_sec"] = round(pyreader_ips, 2)
-        record["pyreader_frac"] = round(pyreader_ips / ips, 3)
+        record["pyreader_frac"] = round(pyreader_ips / denom, 3)
+    if pyreader_u8_ips:
+        record["pyreader_uint8_images_per_sec"] = round(pyreader_u8_ips, 2)
+        record["pyreader_frac_uint8"] = round(pyreader_u8_ips / (single_ips or ips), 3)
     try:
         tfs = run_transformer_mfu()
         record["transformer_tflops_per_sec"] = round(tfs, 1)
@@ -345,9 +491,13 @@ def main():
     except Exception as e:
         print("transformer MFU pass failed: %r" % e, file=sys.stderr)
     try:
-        lstm_ms = run_lstm()
+        lstm_ms, token_frac = run_lstm(measure_pipeline=True)
         record["lstm_ms_per_batch"] = round(lstm_ms, 1)
         record["lstm_vs_baseline"] = round(BASELINE_LSTM_MS_PER_BATCH / lstm_ms, 2)
+        if token_frac:
+            # byte-light keep-up proof: ~51.5 KB/step token feed -> ~2.3 ms
+            # wire time hidden inside ~15 ms/step compute (target >= 0.95)
+            record["pyreader_frac_tokens"] = round(token_frac, 3)
     except Exception as e:
         print("lstm pass failed: %r" % e, file=sys.stderr)
     try:

@@ -299,6 +299,8 @@ class _CompiledBlock:
             }
             ro_sh = {n: state_sharding(n) for n in self.ro_names}
             mut_sh = {n: state_sharding(n) for n in self.mut_names}
+            # stashed for _MultiStepBlock, which reuses this block's analysis
+            self._ro_sh, self._mut_sh = ro_sh, mut_sh
             # created dict's membership is only known at trace time (ops may
             # omit declared outputs), so its sharding is left to XLA (None)
             out_sh = (
@@ -324,6 +326,167 @@ class _CompiledBlock:
         scope.vars.update(created)
         scope.rng_key = new_key
         return fetches
+
+
+class _MultiStepBlock:
+    """k iterations of a training block compiled into ONE XLA call.
+
+    `jax.lax.scan` drives the block's lowering over a stacked feed (leading
+    axis k), threading the donated mutated-state pytree (params, optimizer
+    state, running stats) and the PRNG key through the loop carry. Per-step
+    fetches come back stacked [k, ...].
+
+    Reference analog: scope_buffered_ssa_graph_executor.h:37
+    `num_iteration_per_drop_scope` — the reference amortizes per-iteration
+    host work (scope GC) over k iterations without leaving the executor. Here
+    the amortized cost is the dispatch itself: a training step carries ~480
+    state buffers per call, which costs ~3 ms of host work per step on a
+    tunneled chip (ROADMAP "Executor arg packing" probe); one multi-step call
+    pays that once for k steps, so wall-clock tracks device-busy time without
+    hand-packing state into per-dtype arenas.
+
+    RNG equivalence: the scan body threads the key exactly as k sequential
+    Executor.run calls would (registry.lower_ops splits per stochastic op),
+    so dropout-bearing programs produce bitwise-identical trajectories either
+    way — asserted by tests/test_multistep.py.
+    """
+
+    def __init__(self, program, block, feed_names, fetch_names, scope,
+                 steps_per_run, mesh=None, data_axes=("dp",), feed_ranks=None):
+        if steps_per_run < 1:
+            raise ValueError("steps_per_run must be >= 1")
+        self.steps_per_run = steps_per_run
+        # reuse _CompiledBlock's whole analysis (state split, shardings) and
+        # its raw lowering closure; its own .jitted is lazy and never compiled
+        inner = _CompiledBlock(
+            program, block, feed_names, fetch_names, scope,
+            mesh=mesh, data_axes=data_axes, feed_ranks=feed_ranks,
+        )
+        if inner.created_persistables:
+            raise RuntimeError(
+                "steps_per_run>1 requires a block that creates no new "
+                "persistables (run the startup program separately first); "
+                "this block creates %s" % inner.created_persistables
+            )
+        self._inner = inner
+        self.feed_names = inner.feed_names
+        self.fetch_names = inner.fetch_names
+        self.ro_names = inner.ro_names
+        self.mut_names = inner.mut_names
+        self._feed_sharding = None
+
+        def run_k(stacked_feeds, ro_state, mut_state, rng_key):
+            def body(carry, feeds):
+                mut, key = carry
+                fetches, new_mut, _created, new_key = inner.fn(
+                    feeds, ro_state, mut, key
+                )
+                return (new_mut, new_key), fetches
+
+            (mut, key), stacked_fetches = jax.lax.scan(
+                body, (mut_state, rng_key), stacked_feeds, length=steps_per_run
+            )
+            return stacked_fetches, mut, key
+
+        if mesh is None:
+            self.jitted = jax.jit(run_k, donate_argnums=(2,))
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(mesh, P())
+            # stacked feeds: scan axis unsharded, batch dim on the data axes
+            batch = NamedSharding(mesh, P(None, data_axes))
+            self._feed_sharding = batch
+            feed_ranks = feed_ranks or {}
+            feed_sh = {
+                n: (batch if feed_ranks.get(n, 1) else repl)
+                for n in self.feed_names
+            }
+            out_sh = ([repl] * len(self.fetch_names), inner._mut_sh, repl)
+            self.jitted = jax.jit(
+                run_k,
+                donate_argnums=(2,),
+                in_shardings=(feed_sh, inner._ro_sh, inner._mut_sh, repl),
+                out_shardings=out_sh,
+            )
+
+    def __call__(self, scope, stacked_feed_arrays):
+        ro = {n: scope.vars[n] for n in self.ro_names}
+        mut = {n: scope.vars[n] for n in self.mut_names}
+        stacked_fetches, new_mut, new_key = self.jitted(
+            stacked_feed_arrays, ro, mut, scope.rng_key
+        )
+        scope.vars.update(new_mut)
+        scope.rng_key = new_key
+        return stacked_fetches
+
+
+def _pull_reader_steps(readers, steps_per_run):
+    """Pull up to k staged batches from started py_readers and stack them.
+    If the epoch ends mid-pull, the completed steps are NOT discarded: the
+    call proceeds as a shorter multi-step run (the sequential path would
+    have trained on them before raising EOF) and EOFException surfaces on
+    the NEXT run, once nothing is left. Returns (stacked_feed, k_actual);
+    the feed is ALWAYS stacked [k, ...] — even a 1-batch tail keeps the
+    multi-step fetch contract (fetches come back [k, ...])."""
+    from .py_reader import EOFException
+
+    step_feeds = []
+    try:
+        for _ in range(steps_per_run):
+            d = {}
+            for rd in readers:
+                d.update(rd.next_batch())
+            step_feeds.append(d)
+    except EOFException:
+        if not step_feeds:
+            raise
+        # tail consumed now; surface the EOF on the NEXT run
+        for rd in readers:
+            rd._eof_deferred = True
+    return _stack_feed_steps(step_feeds), len(step_feeds)
+
+
+def _started_readers(program):
+    """Started py_readers of the program; raises the EOFException a previous
+    partial multi-step pull deferred (its tail batches were trained on, so
+    the epoch end belongs to THIS call)."""
+    from .py_reader import EOFException
+
+    readers, deferred = [], False
+    for rd in getattr(program, "_py_readers", []):
+        if getattr(rd, "_eof_deferred", False):
+            rd._eof_deferred = False
+            deferred = True
+        elif rd.started:
+            readers.append(rd)
+    if deferred and not readers:
+        raise EOFException(
+            "reader exhausted (tail consumed by the previous multi-step run)"
+        )
+    return readers
+
+
+def _stack_feed_steps(feed_list):
+    """List of k per-step feed dicts -> one dict of stacked arrays
+    (leading axis k). Device-resident values stack on device."""
+    if not feed_list:
+        raise ValueError("empty feed list")
+    names = set(feed_list[0])
+    for d in feed_list[1:]:
+        if set(d) != names:
+            raise ValueError(
+                "per-step feeds must share the same names; got %s vs %s"
+                % (sorted(names), sorted(d))
+            )
+    out = {}
+    for name in names:
+        vals = [d[name] for d in feed_list]
+        if any(isinstance(v, jax.Array) for v in vals):
+            out[name] = jnp.stack([jnp.asarray(v) for v in vals])
+        else:
+            out[name] = np.stack([np.asarray(v) for v in vals])
+    return out
 
 
 class _SegmentedBlock:
@@ -464,16 +627,41 @@ class Executor:
         scope=None,
         return_numpy=True,
         use_program_cache=True,
+        steps_per_run=1,
     ):
+        """steps_per_run > 1 compiles k iterations into ONE XLA call
+        (_MultiStepBlock): `feed` is then either a list of k per-step dicts
+        or a dict of stacked arrays with leading axis k, and each fetch comes
+        back stacked [k, ...]. With no feed, k staged batches are pulled from
+        the program's started py_readers."""
         if program is None:
             program = framework.default_main_program()
+        # force_multi: a reader pull that returned a 1-batch epoch tail still
+        # runs through _MultiStepBlock so fetches keep their [k, ...] axis
+        force_multi = False
         if feed is None:
-            feed = {}
             # pull staged batches from started py_readers (reference read_op
             # popping the LoDTensorBlockingQueue); raises EOFException at end
-            for rd in getattr(program, "_py_readers", []):
-                if rd.started:
+            readers = _started_readers(program)
+            if steps_per_run > 1 and readers:
+                feed, steps_per_run = _pull_reader_steps(readers, steps_per_run)
+                force_multi = True
+            else:
+                feed = {}
+                for rd in readers:
                     feed.update(rd.next_batch())
+        elif isinstance(feed, (list, tuple)):
+            if steps_per_run == 1:
+                steps_per_run = len(feed)
+            if len(feed) != steps_per_run:
+                raise ValueError(
+                    "feed list has %d entries but steps_per_run=%d"
+                    % (len(feed), steps_per_run)
+                )
+            if steps_per_run == 1:
+                feed = dict(feed[0])  # single step: no stacking, no scan
+            else:
+                feed = _stack_feed_steps(list(feed))
         if fetch_list is None:
             fetch_list = []
         scope = scope or global_scope()
@@ -499,12 +687,20 @@ class Executor:
             tuple(sorted((n, a.shape, str(a.dtype)) for n, a in feed_arrays.items())),
             tuple(fetch_names),
             scope._uid,
+            steps_per_run,
+            # only the k==1 case needs disambiguating from single-step; for
+            # k>1 an explicit stacked feed and a reader pull share the
+            # compiled scan
+            force_multi and steps_per_run == 1,
         )
         from . import profiler as _prof
 
-        if _prof.is_profiling() and _flags_profile_ops():
+        is_multi = steps_per_run > 1 or force_multi
+        if _prof.is_profiling() and _flags_profile_ops() and not is_multi:
             # per-op attribution mode: never cached (diagnosis path); falls
-            # through to the shared nan-check/return tail below
+            # through to the shared nan-check/return tail below. Multi-step
+            # runs skip it — unfused per-op eager execution is the opposite
+            # of what steps_per_run exists to measure.
             compiled = _PerOpProfiledBlock(
                 program, block, list(feed_arrays.keys()), fetch_names
             )
@@ -522,8 +718,19 @@ class Executor:
             )
             with _prof.RecordEvent("prepare/block0"):
                 if has_host:
+                    if is_multi:
+                        raise RuntimeError(
+                            "steps_per_run>1 cannot span host ops (send/recv/"
+                            "listen_and_serv): the k-step scan is one XLA "
+                            "computation with no host re-entry"
+                        )
                     compiled = _SegmentedBlock(
                         program, block, list(feed_arrays.keys()), fetch_names
+                    )
+                elif is_multi:
+                    compiled = _MultiStepBlock(
+                        program, block, list(feed_arrays.keys()), fetch_names,
+                        scope, steps_per_run,
                     )
                 else:
                     compiled = _CompiledBlock(
@@ -544,7 +751,7 @@ class Executor:
         # AVALS of the latest run (abstract shapes only — storing the
         # concrete arrays would pin a whole batch of device memory), from
         # which compiled_hlo() lowers the metadata-carrying HLO text
-        if isinstance(compiled, _CompiledBlock):
+        if isinstance(compiled, (_CompiledBlock, _MultiStepBlock)):
             # weakref: _last_run must not keep a dropped scope's parameters
             # alive in device memory
             self._last_run = (

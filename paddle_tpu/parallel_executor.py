@@ -20,7 +20,12 @@ import jax
 from jax.sharding import Mesh
 
 from . import framework
-from .executor import _CompiledBlock, _as_feed_array, global_scope
+from .executor import (
+    _CompiledBlock,
+    _MultiStepBlock,
+    _as_feed_array,
+    global_scope,
+)
 from .framework import Variable
 
 __all__ = ["ParallelExecutor", "BuildStrategy", "ExecutionStrategy"]
@@ -110,15 +115,37 @@ class ParallelExecutor:
         """Number of ways the batch is split (the 'dp' axis extent)."""
         return self._mesh.shape.get("dp", self._mesh.size)
 
-    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True,
+            steps_per_run=1):
+        """steps_per_run > 1 compiles k iterations into one SPMD XLA call
+        (executor._MultiStepBlock over this mesh): `feed` is then a dict of
+        stacked arrays with leading axis k (a feed LIST keeps its reference
+        meaning of per-DEVICE dicts and is only valid for k=1); fetches come
+        back stacked [k, ...]."""
         feed = feed if feed is not None else (feed_dict or {})
+        force_multi = False  # 1-batch epoch tail keeps the [k, ...] contract
         if not feed:
             # pull staged batches from started py_readers, like Executor.run
-            feed = {}
-            for rd in getattr(self._program, "_py_readers", []):
-                if rd.started:
+            from .executor import _pull_reader_steps, _started_readers
+
+            readers = _started_readers(self._program)
+            if steps_per_run > 1 and readers:
+                feed, steps_per_run = _pull_reader_steps(
+                    readers, steps_per_run
+                )
+                force_multi = True
+            else:
+                feed = {}
+                for rd in readers:
                     feed.update(rd.next_batch())
+        is_multi = steps_per_run > 1 or force_multi
         if isinstance(feed, (list, tuple)):
+            if steps_per_run > 1:
+                raise TypeError(
+                    "with steps_per_run>1 feed must be a dict of stacked "
+                    "arrays (leading axis k); a feed list means per-device "
+                    "dicts (reference parallel_executor.py:183-213)"
+                )
             # reference API form: one dict per device (reference
             # parallel_executor.py:183-213) — concatenate along the batch dim
             merged = {}
@@ -137,14 +164,18 @@ class ParallelExecutor:
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
         feed_arrays = {}
+        batch_dim = 1 if is_multi else 0  # stacked feeds: [k, N, ...]
         for name, value in feed.items():
             var = block.vars.get(name)
             arr = _as_feed_array(value, var)
-            if arr.shape and arr.shape[0] % self.device_count != 0:
+            if (
+                len(arr.shape) > batch_dim
+                and arr.shape[batch_dim] % self.device_count != 0
+            ):
                 raise ValueError(
                     "batch dim %d of feed %r not divisible by device count %d "
                     "(reference PE splits the batch across devices the same way)"
-                    % (arr.shape[0], name, self.device_count)
+                    % (arr.shape[batch_dim], name, self.device_count)
                 )
             feed_arrays[name] = arr
 
@@ -154,18 +185,31 @@ class ParallelExecutor:
             tuple(sorted((n, a.shape, str(a.dtype)) for n, a in feed_arrays.items())),
             tuple(fetch_names),
             self._scope._uid,
+            steps_per_run,
+            force_multi and steps_per_run == 1,
         )
         compiled = self._cache.get(key)
         if compiled is None:
-            compiled = _CompiledBlock(
-                program,
-                block,
-                list(feed_arrays.keys()),
-                fetch_names,
-                self._scope,
-                mesh=self._mesh,
-                feed_ranks={n: np.ndim(a) for n, a in feed_arrays.items()},
-            )
+            # feed_ranks are UNSTACKED ranks: rank 0 (scalars) replicate
+            feed_ranks = {
+                n: np.ndim(a) - batch_dim for n, a in feed_arrays.items()
+            }
+            if is_multi:
+                compiled = _MultiStepBlock(
+                    program, block, list(feed_arrays.keys()), fetch_names,
+                    self._scope, steps_per_run, mesh=self._mesh,
+                    feed_ranks=feed_ranks,
+                )
+            else:
+                compiled = _CompiledBlock(
+                    program,
+                    block,
+                    list(feed_arrays.keys()),
+                    fetch_names,
+                    self._scope,
+                    mesh=self._mesh,
+                    feed_ranks=feed_ranks,
+                )
             self._cache[key] = compiled
 
         # place the global batch sharded over the mesh before dispatch;
@@ -174,7 +218,12 @@ class ParallelExecutor:
 
         repl = NamedSharding(self._mesh, P())
         sharded = {
-            n: jax.device_put(a, compiled._feed_sharding if np.ndim(a) else repl)
+            n: jax.device_put(
+                a,
+                compiled._feed_sharding
+                if np.ndim(a) > batch_dim
+                else repl,
+            )
             for n, a in feed_arrays.items()
         }
         fetches = compiled(self._scope, sharded)

@@ -36,9 +36,24 @@ class _FeederError:
 
 
 class PyReader:
-    def __init__(self, feed_names, capacity=4, return_device_arrays=True):
+    def __init__(self, feed_names, capacity=4, return_device_arrays=True,
+                 wire_dtypes=None):
+        """wire_dtypes: optional {feed_name: dtype} COMPACT WIRE FORMAT —
+        batches are converted to this dtype on the host before staging, so
+        the host->device transfer carries e.g. uint8 pixels (4x fewer bytes
+        than f32) or bf16 activations (2x); the executor's trace-time
+        declared-dtype cast (executor._CompiledBlock feed_want) then converts
+        to the program's var dtype ON DEVICE, fused into the compiled step.
+        Reference analog: the double-buffer reader moves whatever dtype the
+        LoDTensor holds (operators/reader/buffered_reader.h:48) — uint8
+        image feeds + an in-graph cast were the reference's own trick for
+        byte-bound input pipelines."""
         self.feed_names = list(feed_names)
         self.capacity = capacity
+        self._wire_dtypes = {
+            k: (jax.numpy.bfloat16 if str(v) == "bfloat16" else v)
+            for k, v in (wire_dtypes or {}).items()
+        }
         self._queue = None
         self._thread = None
         self._stop = None
@@ -70,6 +85,12 @@ class PyReader:
         self._feeder = feeder
         return self
 
+    @property
+    def started(self):
+        """Same contract as the program-registered reader handles
+        (layers/io.py) so Executor.run can pull from either kind."""
+        return self._started
+
     # --- lifecycle ---
     def start(self):
         if self._started:
@@ -77,6 +98,9 @@ class PyReader:
         self._queue = Queue.Queue(maxsize=self.capacity)
         self._stop = threading.Event()
         self._started = True
+        # a previous partial multi-step pull may have deferred its epoch-end
+        # signal (executor._pull_reader_steps); a restart begins a new epoch
+        self._eof_deferred = False
 
         # local refs: reset() swaps these out mid-epoch
         q = self._queue
@@ -113,6 +137,17 @@ class PyReader:
                     if stop.is_set():
                         return
                     feed = _convert(item)
+                    if self._wire_dtypes:
+                        import numpy as np
+
+                        feed = {
+                            k: (
+                                np.asarray(v).astype(self._wire_dtypes[k])
+                                if k in self._wire_dtypes
+                                else v
+                            )
+                            for k, v in feed.items()
+                        }
                     if self._return_device:
                         # stage on device ahead of compute (double buffering)
                         feed = {k: jax.device_put(v) for k, v in feed.items()}
@@ -138,6 +173,7 @@ class PyReader:
         self._queue = None
         self._thread = None
         self._stop = None
+        self._eof_deferred = False
 
     def next_batch(self):
         if not self._started:

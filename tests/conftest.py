@@ -2,6 +2,11 @@
 multi-chip SPMD paths compile and run without TPU hardware (the pattern the
 driver's dryrun_multichip also uses). Shared bootstrap logic lives in
 paddle_tpu.platform_setup.
+
+PADDLE_OPTEST_PLACE=tpu skips the CPU forcing so the same op-test suite runs
+against the real chip (scripts/optest_tpu.py lane — the reference runs every
+op test on CPUPlace AND CUDAPlace, reference op_test.py:303-385,427; this env
+switch is the TPU analog of that second place).
 """
 
 import os
@@ -9,6 +14,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from paddle_tpu.platform_setup import force_virtual_cpu_devices
+if os.environ.get("PADDLE_OPTEST_PLACE", "").lower() != "tpu":
+    from paddle_tpu.platform_setup import force_virtual_cpu_devices
 
-force_virtual_cpu_devices(8)
+    force_virtual_cpu_devices(8)

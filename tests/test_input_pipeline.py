@@ -77,3 +77,65 @@ def test_pyreader_overlaps_feed_with_compute():
         "no feed/compute overlap: wall=%.3fs sequential=%.3fs overlapped=%.3fs"
         % (wall, sequential, overlapped)
     )
+
+
+def test_pyreader_compact_wire_uint8():
+    """wire_dtypes stages the batch in the compact dtype (uint8 pixels: 4x
+    fewer bytes over the link) and the executor's declared-dtype cast
+    converts on device — results must equal feeding the f32 directly."""
+    import jax
+
+    main, startup, loss = _build(n=64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    img_u8 = rng.randint(0, 256, (8, 64)).astype("uint8")
+
+    def reader():
+        yield {"x": img_u8}
+
+    wire = PyReader(["x"], capacity=2, wire_dtypes={"x": "uint8"})
+    wire.decorate_tensor_provider(reader)
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        wire.start()
+        try:
+            batch = wire.next_batch()
+            # the staged array really is the compact wire dtype on device
+            assert isinstance(batch["x"], jax.Array)
+            assert str(batch["x"].dtype) == "uint8"
+            (got,) = exe.run(main, feed=batch, fetch_list=[loss.name])
+        finally:
+            wire.reset()
+        (want,) = exe.run(
+            main,
+            feed={"x": img_u8.astype("float32")},
+            fetch_list=[loss.name],
+        )
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_pyreader_compact_wire_bf16():
+    """bf16 wire: half the bytes of f32; device cast back to the declared
+    f32 var dtype keeps the program's compute precision unchanged."""
+    import jax
+
+    main, startup, loss = _build(n=64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(2)
+    x = rng.rand(8, 64).astype("float32")
+
+    wire = PyReader(["x"], capacity=2, wire_dtypes={"x": "bfloat16"})
+    wire.decorate_tensor_provider(lambda: iter([{"x": x}]))
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        wire.start()
+        try:
+            batch = wire.next_batch()
+            assert str(batch["x"].dtype) == "bfloat16"
+            assert batch["x"].nbytes == x.nbytes // 2
+            (got,) = exe.run(main, feed=batch, fetch_list=[loss.name])
+        finally:
+            wire.reset()
+        (want,) = exe.run(main, feed={"x": x}, fetch_list=[loss.name])
+    # bf16 quantization of the input is the only difference
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=1e-3)

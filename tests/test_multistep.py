@@ -1,0 +1,284 @@
+"""Multi-step dispatch (Executor.run steps_per_run=k): k training iterations
+compiled into ONE XLA call via lax.scan over stacked feeds with the donated
+state pytree threaded through the loop carry.
+
+Reference analog: scope_buffered_ssa_graph_executor.h:37
+num_iteration_per_drop_scope (amortize per-iteration host work inside the
+executor). The contract tested here: a k-step scan produces the SAME loss
+trajectory and final parameters as k sequential Executor.run calls —
+including the PRNG split sequence, asserted via a dropout-bearing program.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _build_mlp(dropout=0.0, seed=0):
+    main = framework.Program()
+    startup = framework.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(x, size=16, act="relu")
+            if dropout:
+                h = fluid.layers.dropout(h, dropout_prob=dropout)
+            pred = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    main.random_seed = seed
+    return main, startup, loss
+
+
+def _batches(k, bs=16, seed=3):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(k):
+        x = rng.randn(bs, 8).astype("float32")
+        y = (x.sum(axis=1, keepdims=True) > 0).astype("float32")
+        out.append({"x": x, "y": y})
+    return out
+
+
+def _train(main, startup, loss, batches, steps_per_run):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope(seed=11)
+    with scope_guard(scope):
+        exe.run(startup)
+        if steps_per_run == 1:
+            losses = [
+                float(exe.run(main, feed=b, fetch_list=[loss.name])[0])
+                for b in batches
+            ]
+        else:
+            assert len(batches) % steps_per_run == 0
+            losses = []
+            for i in range(0, len(batches), steps_per_run):
+                (stacked,) = exe.run(
+                    main,
+                    feed=batches[i : i + steps_per_run],
+                    fetch_list=[loss.name],
+                    steps_per_run=steps_per_run,
+                )
+                assert stacked.shape[0] == steps_per_run
+                losses.extend(float(v) for v in stacked.reshape(steps_per_run))
+        params = {
+            n: np.asarray(v)
+            for n, v in scope.vars.items()
+            if n.startswith("fc_") and v is not None
+        }
+    return losses, params
+
+
+def test_multistep_matches_sequential():
+    """k-step scan == k sequential runs: same losses, same final params."""
+    batches = _batches(8)
+    main1, st1, loss1 = _build_mlp()
+    seq_losses, seq_params = _train(main1, st1, loss1, batches, 1)
+    main2, st2, loss2 = _build_mlp()
+    multi_losses, multi_params = _train(main2, st2, loss2, batches, 4)
+    np.testing.assert_allclose(seq_losses, multi_losses, rtol=1e-5)
+    assert seq_params.keys() == multi_params.keys() and seq_params
+    for n in seq_params:
+        np.testing.assert_allclose(
+            seq_params[n], multi_params[n], rtol=1e-5, atol=1e-6
+        )
+    # and it actually trains
+    assert multi_losses[-1] < multi_losses[0]
+
+
+def test_multistep_rng_threading_matches_sequential():
+    """Dropout-bearing program: the scan body must consume the PRNG key in
+    the same split order as sequential runs (bitwise-equal trajectories)."""
+    batches = _batches(6, seed=5)
+    main1, st1, loss1 = _build_mlp(dropout=0.5, seed=23)
+    seq_losses, _ = _train(main1, st1, loss1, batches, 1)
+    main2, st2, loss2 = _build_mlp(dropout=0.5, seed=23)
+    multi_losses, _ = _train(main2, st2, loss2, batches, 3)
+    np.testing.assert_allclose(seq_losses, multi_losses, rtol=1e-6)
+
+
+def test_multistep_stacked_dict_feed():
+    """A dict of pre-stacked arrays (leading axis k) is accepted directly."""
+    batches = _batches(4)
+    stacked = {
+        n: np.stack([b[n] for b in batches]) for n in batches[0]
+    }
+    main, st, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=1)):
+        exe.run(st)
+        (vals,) = exe.run(
+            main, feed=stacked, fetch_list=[loss.name], steps_per_run=4
+        )
+    assert vals.shape[0] == 4
+    assert np.isfinite(vals).all()
+
+
+def test_multistep_pyreader_pulls_k_batches():
+    """With no feed and started py_readers, steps_per_run pulls and stacks
+    k staged batches."""
+    from paddle_tpu.py_reader import PyReader
+
+    batches = _batches(8, seed=9)
+    main, st, loss = _build_mlp()
+    reader = PyReader(["x", "y"], capacity=4)
+    reader.decorate_tensor_provider(lambda: iter(batches))
+    main._py_readers = [reader]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=2)):
+        exe.run(st)
+        reader.start()
+        try:
+            (v1,) = exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+            (v2,) = exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+        finally:
+            reader.reset()
+    assert v1.shape[0] == 4 and v2.shape[0] == 4
+    # second call consumed fresh batches (training progressed)
+    assert float(v2.mean()) < float(v1.mean())
+
+
+def test_multistep_parallel_executor():
+    """steps_per_run over the 8-device dp mesh: stacked [k, N, ...] feeds,
+    batch dim sharded, loss trajectory matches the single-device run."""
+    batches = _batches(4, bs=16)
+    main1, st1, loss1 = _build_mlp()
+    seq_losses, _ = _train(main1, st1, loss1, batches, 1)
+
+    main2, st2, loss2 = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope(seed=11)
+    with scope_guard(scope):
+        exe.run(st2)
+        pe = fluid.ParallelExecutor(
+            use_cuda=False, loss_name=loss2.name, main_program=main2
+        )
+        stacked = {n: np.stack([b[n] for b in batches]) for n in batches[0]}
+        (vals,) = pe.run(
+            [loss2.name], feed=stacked, steps_per_run=len(batches)
+        )
+    np.testing.assert_allclose(seq_losses, np.asarray(vals).reshape(-1), rtol=1e-4, atol=1e-5)
+
+
+def test_single_element_feed_list():
+    """A one-entry feed list must run unstacked through the single-step
+    path (regression: it used to stack to leading-axis-1 shapes)."""
+    main, st, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    b = _batches(1)[0]
+    with scope_guard(Scope(seed=4)):
+        exe.run(st)
+        (v,) = exe.run(main, feed=[b], fetch_list=[loss.name])
+        (w,) = exe.run(main, feed=b, fetch_list=[loss.name])
+    assert np.asarray(v).shape == np.asarray(w).shape
+
+
+def test_multistep_eof_mid_pull_trains_on_tail():
+    """Epoch of 6 with steps_per_run=4: the second call must train on the
+    remaining 2 batches (shorter scan), EOF surfaces on the third."""
+    import pytest
+
+    from paddle_tpu.py_reader import EOFException, PyReader
+
+    batches = _batches(6, seed=13)
+    main, st, loss = _build_mlp()
+    reader = PyReader(["x", "y"], capacity=8)
+    reader.decorate_tensor_provider(lambda: iter(batches))
+    main._py_readers = [reader]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=6)):
+        exe.run(st)
+        reader.start()
+        (v1,) = exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+        assert v1.shape[0] == 4
+        (v2,) = exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+        assert v2.shape[0] == 2  # tail of the epoch, not discarded
+        with pytest.raises(EOFException):
+            exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+
+
+def test_multistep_eof_tail_of_one_keeps_stacked_contract():
+    """Epoch of 5 with steps_per_run=4: the 1-batch tail still comes back
+    stacked [1, ...], and a reader RESTART after the deferred EOF begins a
+    fresh epoch instead of raising a stale EOFException."""
+    import pytest
+
+    from paddle_tpu.py_reader import EOFException, PyReader
+
+    batches = _batches(5, seed=19)
+    main, st, loss = _build_mlp()
+    reader = PyReader(["x", "y"], capacity=8)
+    reader.decorate_tensor_provider(lambda: iter(batches))
+    main._py_readers = [reader]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=6)):
+        exe.run(st)
+        reader.start()
+        (v1,) = exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+        assert v1.shape[0] == 4
+        (v2,) = exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+        assert v2.shape[0] == 1  # stacked tail, not a scalar fetch
+        with pytest.raises(EOFException):
+            exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+        # restart = new epoch: must NOT see a stale deferred EOF
+        reader.reset()
+        reader.start()
+        (v3,) = exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+        assert v3.shape[0] == 4
+        reader.reset()
+
+
+def test_multistep_parallel_executor_pyreader():
+    """ParallelExecutor with a started py_reader and steps_per_run pulls
+    and stacks k batches (regression: it used to hand one unstacked batch
+    to the k-step scan)."""
+    from paddle_tpu.py_reader import PyReader
+
+    batches = _batches(4, bs=16, seed=17)
+    main, st, loss = _build_mlp()
+    reader = PyReader(["x", "y"], capacity=6)
+    reader.decorate_tensor_provider(lambda: iter(batches))
+    main._py_readers = [reader]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=8)):
+        exe.run(st)
+        pe = fluid.ParallelExecutor(
+            use_cuda=False, loss_name=loss.name, main_program=main
+        )
+        reader.start()
+        try:
+            (vals,) = pe.run([loss.name], steps_per_run=4)
+        finally:
+            reader.reset()
+    assert np.asarray(vals).shape[0] == 4
+    assert np.isfinite(np.asarray(vals)).all()
+
+
+def test_multistep_rejects_host_ops():
+    import pytest
+
+    main, st, loss = _build_mlp()
+    # splice a host op type into the block artificially
+    prog = framework.Program()
+    with fluid.program_guard(prog, framework.Program()):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        out = fluid.layers.fc(x, size=4)
+    prog.global_block().append_op(
+        type="send",
+        inputs={"X": [out]},
+        outputs={},
+        attrs={"epmap": ["127.0.0.1:0"], "sync_mode": True},
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=0)):
+        with pytest.raises(RuntimeError, match="steps_per_run"):
+            exe.run(
+                prog,
+                feed=[{"x": np.zeros((4, 8), "float32")}] * 2,
+                fetch_list=[],
+                steps_per_run=2,
+            )
