@@ -132,6 +132,7 @@ def run(batch_size=256, steps=20, warmup=3, n_staged=4, bf16=True,
             print("multi-step pass failed, keeping single-dispatch headline: %r"
                   % e, file=sys.stderr)
             staged_ips = single_ips
+            stacked = l = None  # free device buffers before pipeline passes
         if not measure_pipeline:
             return staged_ips, single_ips, None, None
         pyreader_ips = pyreader_u8_ips = None

@@ -467,6 +467,21 @@ def _started_readers(program):
     return readers
 
 
+def _resolve_reader_feed(program, steps_per_run):
+    """Shared Executor/ParallelExecutor path for feed=None: pull from the
+    program's started py_readers — k batches stacked for a multi-step run
+    (force_multi keeps the [k, ...] fetch contract even for a 1-batch epoch
+    tail), one batch otherwise. Returns (feed, steps_per_run, force_multi)."""
+    readers = _started_readers(program)
+    if steps_per_run > 1 and readers:
+        feed, k = _pull_reader_steps(readers, steps_per_run)
+        return feed, k, True
+    feed = {}
+    for rd in readers:
+        feed.update(rd.next_batch())
+    return feed, steps_per_run, False
+
+
 def _stack_feed_steps(feed_list):
     """List of k per-step feed dicts -> one dict of stacked arrays
     (leading axis k). Device-resident values stack on device."""
@@ -642,14 +657,9 @@ class Executor:
         if feed is None:
             # pull staged batches from started py_readers (reference read_op
             # popping the LoDTensorBlockingQueue); raises EOFException at end
-            readers = _started_readers(program)
-            if steps_per_run > 1 and readers:
-                feed, steps_per_run = _pull_reader_steps(readers, steps_per_run)
-                force_multi = True
-            else:
-                feed = {}
-                for rd in readers:
-                    feed.update(rd.next_batch())
+            feed, steps_per_run, force_multi = _resolve_reader_feed(
+                program, steps_per_run
+            )
         elif isinstance(feed, (list, tuple)):
             if steps_per_run == 1:
                 steps_per_run = len(feed)

@@ -114,6 +114,16 @@ class GraphPyReader:
     def started(self):
         return self._impl._started
 
+    # the executor's deferred-EOF flag (executor._pull_reader_steps) must
+    # live on the impl so start()/reset() clear it with the epoch state
+    @property
+    def _eof_deferred(self):
+        return self._impl._eof_deferred
+
+    @_eof_deferred.setter
+    def _eof_deferred(self, value):
+        self._impl._eof_deferred = value
+
 
 def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
               use_double_buffer=True):

@@ -126,18 +126,11 @@ class ParallelExecutor:
         force_multi = False  # 1-batch epoch tail keeps the [k, ...] contract
         if not feed:
             # pull staged batches from started py_readers, like Executor.run
-            from .executor import _pull_reader_steps, _started_readers
+            from .executor import _resolve_reader_feed
 
-            readers = _started_readers(self._program)
-            if steps_per_run > 1 and readers:
-                feed, steps_per_run = _pull_reader_steps(
-                    readers, steps_per_run
-                )
-                force_multi = True
-            else:
-                feed = {}
-                for rd in readers:
-                    feed.update(rd.next_batch())
+            feed, steps_per_run, force_multi = _resolve_reader_feed(
+                self._program, steps_per_run
+            )
         is_multi = steps_per_run > 1 or force_multi
         if isinstance(feed, (list, tuple)):
             if steps_per_run > 1:

@@ -62,6 +62,7 @@ class PyReader:
         self._batched_tuples = False
         self._return_device = return_device_arrays
         self._started = False
+        self._eof_deferred = False
 
     # --- decoration (reference py_reader.decorate_paddle_reader) ---
     def decorate_paddle_reader(self, reader, places=None):

@@ -1,0 +1,167 @@
+"""Artifact-only training: export a compiled TRAIN step and run it with no
+Program/frontend in the loop.
+
+Reference analog: /root/reference/paddle/fluid/train/demo/demo_trainer.cc —
+the reference ships a C++ driver that loads saved program artifacts
+(startup/main ProgramDesc + persistables) and trains without the Python
+frontend. The TPU-native equivalent exports the WHOLE optimizer-bearing
+train step — forward, backward, and parameter update, exactly as the
+Executor would jit it — as one serialized StableHLO artifact (jax.export)
+together with the initial state pytree (params, optimizer accumulators,
+running stats, PRNG key). `TrainStepRunner` deserializes the artifact and
+loops feed -> step -> new state; the training loop touches no Program,
+no ops, no layers — just arrays in, loss out, state carried.
+
+Unlike `inference.export_compiled` (serving: fetches only, state frozen),
+the train artifact returns its mutated state and threads the PRNG key, so
+dropout/augmentation ops stay stochastic across artifact steps.
+
+The artifact records the platform it was lowered for (cpu/tpu); jax.export
+enforces it at call time.
+"""
+
+import os
+
+import numpy as np
+
+__all__ = ["export_train_step", "TrainStepRunner", "load_train_step"]
+
+
+def export_train_step(out_path, feed_example, fetch_list, program=None,
+                      scope=None):
+    """AOT-compile the training block for the example feed shapes and write
+    the artifact: StableHLO blob + read-only state + mutable state + PRNG
+    key. Run the startup program first (the block must create no new
+    persistables — accumulators are startup-initialized).
+
+    feed_example: dict name -> numpy array (shapes/dtypes fix the artifact).
+    fetch_list: Variables or names fetched each step (e.g. the loss).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+
+    from . import framework
+    from .executor import _CompiledBlock, global_scope
+
+    program = program or framework.default_main_program()
+    scope = scope or global_scope()
+    block = program.global_block()
+    feed = {k: np.asarray(v) for k, v in feed_example.items()}
+    fetch_names = [
+        f.name if isinstance(f, framework.Variable) else str(f)
+        for f in fetch_list
+    ]
+    compiled = _CompiledBlock(
+        program, block, list(feed.keys()), fetch_names, scope
+    )
+    if compiled.created_persistables:
+        raise RuntimeError(
+            "train block creates persistables %s — run the startup program "
+            "before exporting" % compiled.created_persistables
+        )
+
+    def step(feeds, ro, mut, key_data):
+        key = jax.random.wrap_key_data(key_data)
+        fetches, new_mut, _created, new_key = compiled.fn(feeds, ro, mut, key)
+        return fetches, new_mut, jax.random.key_data(new_key)
+
+    ro = {n: jnp.asarray(scope.vars[n]) for n in compiled.ro_names}
+    mut = {n: jnp.asarray(scope.vars[n]) for n in compiled.mut_names}
+    key_data = jax.random.key_data(scope.rng_key)
+    exported = jax_export.export(jax.jit(step, donate_argnums=(2,)))(
+        {k: jnp.asarray(v) for k, v in feed.items()}, ro, mut, key_data
+    )
+    blob = exported.serialize()
+
+    arrays = {
+        "__stablehlo__": np.frombuffer(blob, np.uint8),
+        "__feed_names__": np.array(sorted(feed.keys())),
+        "__fetch_names__": np.array(fetch_names),
+        "__rng__": np.asarray(key_data),
+    }
+    for n, v in ro.items():
+        arrays["ro:" + n] = np.asarray(v)
+    for n, v in mut.items():
+        arrays["mut:" + n] = np.asarray(v)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path if out_path.endswith(".npz") else out_path + ".npz",
+              "wb") as f:
+        np.savez(f, **arrays)
+    return out_path if out_path.endswith(".npz") else out_path + ".npz"
+
+
+class TrainStepRunner:
+    """Program-free training loop over an export_train_step artifact (the
+    demo_trainer.cc role). State (params + accumulators + PRNG) is carried
+    inside the runner; run() takes a feed dict and returns the fetches."""
+
+    def __init__(self, exported, feed_names, fetch_names, ro, mut, key_data):
+        import jax
+
+        self._call = jax.jit(exported.call, donate_argnums=(2,))
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+        self._ro = ro
+        self._mut = mut
+        self._key = key_data
+
+    @classmethod
+    def load(cls, path):
+        import jax.numpy as jnp
+        from jax import export as jax_export
+
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        exported = jax_export.deserialize(data["__stablehlo__"].tobytes())
+        return cls(
+            exported,
+            [str(s) for s in data["__feed_names__"]],
+            [str(s) for s in data["__fetch_names__"]],
+            {k[3:]: jnp.asarray(data[k]) for k in data.files
+             if k.startswith("ro:")},
+            {k[4:]: jnp.asarray(data[k]) for k in data.files
+             if k.startswith("mut:")},
+            jnp.asarray(data["__rng__"]),
+        )
+
+    def run(self, feed):
+        """One training step: feed dict name -> array; returns numpy fetches
+        (loss etc.). Mutated state is donated and re-carried."""
+        import jax.numpy as jnp
+
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise ValueError("missing feeds: %s" % missing)
+        feeds = {n: jnp.asarray(feed[n]) for n in self.feed_names}
+        fetches, self._mut, self._key = self._call(
+            feeds, self._ro, self._mut, self._key
+        )
+        return [np.asarray(f) for f in fetches]
+
+    def state(self):
+        """Snapshot of the mutable state (params, accumulators) as numpy —
+        feed into io-style checkpointing or back into a Scope."""
+        return {n: np.asarray(v) for n, v in self._mut.items()}
+
+    def save_state(self, path):
+        with open(path if path.endswith(".npz") else path + ".npz", "wb") as f:
+            np.savez(f, **self.state())
+        return path if path.endswith(".npz") else path + ".npz"
+
+    def load_state(self, path):
+        import jax.numpy as jnp
+
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        if set(data.files) != set(self._mut):
+            raise ValueError(
+                "checkpoint does not match this artifact's state: missing %s,"
+                " unexpected %s"
+                % (sorted(set(self._mut) - set(data.files)),
+                   sorted(set(data.files) - set(self._mut)))
+            )
+        for n in list(self._mut):
+            self._mut[n] = jnp.asarray(data[n])
+
+
+def load_train_step(path):
+    return TrainStepRunner.load(path)

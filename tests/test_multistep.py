@@ -232,6 +232,52 @@ def test_multistep_eof_tail_of_one_keeps_stacked_contract():
         reader.reset()
 
 
+def test_multistep_graph_pyreader_restart_clears_deferred_eof():
+    """Same restart contract through the program-registered layers.py_reader
+    wrapper (its start/reset delegate to the impl — the deferred-EOF flag
+    must live there too)."""
+    import pytest
+
+    from paddle_tpu.py_reader import EOFException
+
+    main = framework.Program()
+    startup = framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=8, shapes=[[-1, 8], [-1, 1]],
+            dtypes=["float32", "float32"], use_double_buffer=False,
+        )
+        x, y = fluid.layers.read_file(reader)
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    batches = _batches(5, seed=29)
+    slot_names = [v.name for v in reader.vars]
+
+    def provider():
+        return iter(
+            {slot_names[0]: b["x"], slot_names[1]: b["y"]} for b in batches
+        )
+
+    reader.decorate_tensor_provider(provider)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=3)):
+        exe.run(startup)
+        reader.start()
+        (v1,) = exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+        assert v1.shape[0] == 4
+        (v2,) = exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+        assert v2.shape[0] == 1
+        with pytest.raises(EOFException):
+            exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+        reader.reset()
+        reader.start()
+        (v3,) = exe.run(main, fetch_list=[loss.name], steps_per_run=4)
+        assert v3.shape[0] == 4
+        reader.reset()
+
+
 def test_multistep_parallel_executor_pyreader():
     """ParallelExecutor with a started py_reader and steps_per_run pulls
     and stacks k batches (regression: it used to hand one unstacked batch
