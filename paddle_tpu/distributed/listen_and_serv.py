@@ -76,6 +76,21 @@ def run_pserver(op, scope):
     prefetch_ids = {}  # (trainer_id, "<table>:<req>") -> staged __prefetch__ ids
     optimized_rounds = [0]
     ready = threading.Condition()
+    # gradient-merge window state, shared with the checkpoint handler so a
+    # mid-window checkpoint/restore resumes the exact trajectory: acc holds
+    # the rounds accumulated so far, phase the count of rounds into the
+    # current window. Restored values arrive via scope under the reserved
+    # __gm_acc__:/__gm_rnd_phase__ names (written by a prior checkpoint).
+    gm_state = {"acc": {}, "phase": 0}
+    for vname in list(scope.vars):
+        if vname == "__gm_rnd_phase__":
+            gm_state["phase"] = int(
+                np.asarray(scope.vars.pop(vname)).reshape(())
+            )
+        elif vname.startswith("__gm_acc__:"):
+            gm_state["acc"][vname[len("__gm_acc__:"):]] = np.asarray(
+                scope.vars.pop(vname)
+            )
 
     def on_send(name, arr, trainer_id):
         if arr is None:
@@ -153,6 +168,15 @@ def run_pserver(op, scope):
                     for vname, val in scope.vars.items()
                     if val is not None and "@" not in vname
                 }
+                # gradient-merge window state rides in the checkpoint under
+                # reserved names so a restored pserver resumes mid-window
+                # (run_pserver pops them back out of the scope at start)
+                if gm_state["acc"] or gm_state["phase"]:
+                    snapshot["__gm_rnd_phase__"] = np.asarray(
+                        [gm_state["phase"]], np.int64
+                    )
+                    for g, arr in gm_state["acc"].items():
+                        snapshot["__gm_acc__:" + g] = arr
             fluid_io.save_arrays(ckpt_dir, snapshot)
             return np.ones((1,), np.int64)
         if sync_mode:
@@ -171,6 +195,18 @@ def run_pserver(op, scope):
 
     try:
         if sync_mode:
+            # pserver-side gradient merge (reference
+            # ir/multi_batch_merge_pass.cc driven by
+            # test_dist_mnist_batch_merge.py — there the TRAINER accumulates k
+            # micro-batch grads before one optimizer step; summing on the
+            # pserver across k sync rounds is numerically the same fold and
+            # composes with sharding without conditional RPC): accumulate the
+            # trainer-summed grads each round, run the optimize blocks every
+            # k-th round on the (optionally k-averaged) accumulator. A
+            # partial window at training end is discarded, like the
+            # reference's trailing micro-batches.
+            gm_k = int(attrs.get("gradient_merge_k", 0) or 0)
+            gm_avg = bool(attrs.get("gradient_merge_avg", True))
             rnd = 0
             while True:
                 if not server.wait_barrier(SEND_BARRIER, rnd):
@@ -180,16 +216,39 @@ def run_pserver(op, scope):
                 with state_lock:
                     grads = dict(staged)
                     staged.clear()
-                    for g, arr in grads.items():
-                        # sync merge = sum over trainers, then the per-grad
-                        # optimize block (request_handler_impl.cc scope merge)
-                        scope.set_var(g, _to_device(arr))
-                    if lr_runner is not None:
-                        lr_runner.run()
-                    for g in grads:
-                        bid = grad_block.get(g)
-                        if bid is not None:
-                            runners[bid].run()
+                    if gm_k > 1:
+                        gm_acc = gm_state["acc"]
+                        for g, arr in grads.items():
+                            gm_acc[g] = (
+                                arr if g not in gm_acc else gm_acc[g] + arr
+                            )
+                        gm_state["phase"] += 1
+                        if gm_state["phase"] % gm_k == 0:
+                            for g, arr in gm_acc.items():
+                                scope.set_var(
+                                    g,
+                                    _to_device(arr / gm_k if gm_avg else arr),
+                                )
+                            if lr_runner is not None:
+                                lr_runner.run()
+                            for g in gm_acc:
+                                bid = grad_block.get(g)
+                                if bid is not None:
+                                    runners[bid].run()
+                            gm_acc.clear()
+                            gm_state["phase"] = 0
+                    else:
+                        for g, arr in grads.items():
+                            # sync merge = sum over trainers, then the
+                            # per-grad optimize block
+                            # (request_handler_impl.cc scope merge)
+                            scope.set_var(g, _to_device(arr))
+                        if lr_runner is not None:
+                            lr_runner.run()
+                        for g in grads:
+                            bid = grad_block.get(g)
+                            if bid is not None:
+                                runners[bid].run()
                 with ready:
                     optimized_rounds[0] = rnd + 1
                     ready.notify_all()

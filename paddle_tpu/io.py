@@ -61,6 +61,34 @@ def save_arrays(dirname, arrays):
             json.dump(meta, f)
 
 
+def load_arrays(dirname):
+    """Inverse of save_arrays: read every `<name>.npy` in dirname back into a
+    name->array dict (bf16 restored per `__dtypes__.json`). Used by pserver
+    shard-checkpoint restore (a pserver's shard var names are only known to
+    the transpiled program, so restore is by-directory, not by-program)."""
+    import jax.numpy as jnp
+
+    meta_path = os.path.join(dirname, "__dtypes__.json")
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    out = {}
+    for root, _dirs, files in os.walk(dirname):
+        for fname in sorted(files):
+            if not fname.endswith(".npy"):
+                continue
+            path = os.path.join(root, fname)
+            # var names may contain path separators (save_arrays makes the
+            # subdirs); reconstruct the name relative to dirname
+            name = os.path.relpath(path, dirname)[: -len(".npy")]
+            arr = np.load(path)
+            if meta.get(name) == "bfloat16":
+                arr = jnp.asarray(arr, dtype=jnp.bfloat16)
+            out[name] = arr
+    return out
+
+
 def save_vars(
     executor,
     dirname,

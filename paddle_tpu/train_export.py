@@ -27,6 +27,10 @@ import numpy as np
 __all__ = ["export_train_step", "TrainStepRunner", "load_train_step"]
 
 
+def _npz(path):
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def export_train_step(out_path, feed_example, fetch_list, program=None,
                       scope=None):
     """AOT-compile the training block for the example feed shapes and write
@@ -84,11 +88,11 @@ def export_train_step(out_path, feed_example, fetch_list, program=None,
         arrays["ro:" + n] = np.asarray(v)
     for n, v in mut.items():
         arrays["mut:" + n] = np.asarray(v)
+    out_path = _npz(out_path)
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
-    with open(out_path if out_path.endswith(".npz") else out_path + ".npz",
-              "wb") as f:
+    with open(out_path, "wb") as f:
         np.savez(f, **arrays)
-    return out_path if out_path.endswith(".npz") else out_path + ".npz"
+    return out_path
 
 
 class TrainStepRunner:
@@ -111,7 +115,7 @@ class TrainStepRunner:
         import jax.numpy as jnp
         from jax import export as jax_export
 
-        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        data = np.load(_npz(path))
         exported = jax_export.deserialize(data["__stablehlo__"].tobytes())
         return cls(
             exported,
@@ -144,14 +148,15 @@ class TrainStepRunner:
         return {n: np.asarray(v) for n, v in self._mut.items()}
 
     def save_state(self, path):
-        with open(path if path.endswith(".npz") else path + ".npz", "wb") as f:
+        path = _npz(path)
+        with open(path, "wb") as f:
             np.savez(f, **self.state())
-        return path if path.endswith(".npz") else path + ".npz"
+        return path
 
     def load_state(self, path):
         import jax.numpy as jnp
 
-        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        data = np.load(_npz(path))
         if set(data.files) != set(self._mut):
             raise ValueError(
                 "checkpoint does not match this artifact's state: missing %s,"

@@ -72,6 +72,13 @@ class DistributeTranspilerConfig:
     split_method = RoundRobin
     min_block_size = 8192
     mode = "pserver"
+    # pserver-side gradient merge (sync mode): accumulate k rounds of
+    # trainer-summed grads, apply the optimizer every k-th round on the
+    # (averaged, if gradient_merge_avg) accumulator — the reference's
+    # multi_batch_merge_pass composed with pserver sharding
+    # (test_dist_mnist_batch_merge.py semantics).
+    gradient_merge_k = 0
+    gradient_merge_avg = True
 
 
 class VarBlock:
@@ -401,6 +408,12 @@ class DistributeTranspiler:
                 "optimize_blocks": [b.idx for b in optimize_blocks],
                 "grad_to_block_id": grad_to_block_id,
                 "lr_decay_block_id": lr_block_idx,
+                "gradient_merge_k": int(
+                    getattr(self.config, "gradient_merge_k", 0) or 0
+                ),
+                "gradient_merge_avg": bool(
+                    getattr(self.config, "gradient_merge_avg", True)
+                ),
                 OpRole.OP_ROLE_KEY: RPC_OP_ROLE_ATTR,
             },
         )

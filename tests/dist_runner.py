@@ -1,6 +1,22 @@
 """Subprocess role runner for the distributed tests (reference
 test_dist_base.py's model-file pattern: the same script is Popen'd as pserver
-or trainer with role flags; trainer pickles losses to stdout)."""
+or trainer with role flags; trainer prints losses to stdout as JSON).
+
+Models (reference analogs):
+- mlp: dense regression (dist_base's se_resnext stand-in tier)
+- word2vec: CBOW over a shared embedding table — the sparse-model tier
+  (reference dist_word2vec.py); with min_block_size=1 the [dict, emb] table
+  is row-sliced across pservers like any large param.
+
+Flags beyond the round-3 set:
+- --lr: learning rate (parity harnesses rescale it)
+- --gm_k: pserver-side gradient merge window (test_dist_mnist_batch_merge)
+- --save_dir + --save_after: trainer 0 issues checkpoint_notify after that
+  many steps — every pserver persists its shard vars into the dir
+- --load_dir: each pserver restores its shard vars from the dir after
+  running its startup program (dist save/load resume, dist_save_load.py)
+- --start_step: offset into the deterministic batch schedule (resume)
+"""
 
 import argparse
 import json
@@ -8,19 +24,67 @@ import sys
 
 import numpy as np
 
+DICT_DIM = 64
+EMB_DIM = 8
+CTX = 4
 
-def build():
+
+def make_batch(model, trainer_id, step, bs=16):
+    """Deterministic batch for (trainer, step) so parity harnesses can
+    rebuild the exact global schedule."""
+    rng = np.random.RandomState(1000 * (trainer_id + 1) + step)
+    if model == "mlp":
+        w_true = np.random.RandomState(0).randn(8, 1).astype(np.float32)
+        x = rng.randn(bs, 8).astype(np.float32)
+        y = (np.abs(x) @ np.abs(w_true)) + 0.01 * rng.randn(bs, 1).astype(
+            np.float32
+        )
+        return {"x": x, "y": y}
+    if model == "word2vec":
+        ctx = rng.randint(0, DICT_DIM, (bs, CTX)).astype("int64")
+        # target correlated with context so the model can learn
+        tgt = ((ctx.sum(axis=1) + 1) % DICT_DIM).astype("int64")[:, None]
+        return {"ctx": ctx, "target": tgt}
+    raise ValueError(model)
+
+
+def build(model, lr, with_eval=False):
+    """with_eval=True additionally returns a pre-minimize for_test clone
+    (loss evaluation without parameter updates — the single-process parity
+    harness needs it for non-apply gradient-merge rounds)."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu import framework
 
+    eval_prog = None
     main, startup = framework.Program(), framework.Program()
     with fluid.unique_name.guard(), fluid.program_guard(main, startup):
-        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
-        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
-        h = fluid.layers.fc(input=x, size=16, act="relu")
-        pred = fluid.layers.fc(input=h, size=1)
-        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
-        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        if model == "mlp":
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(input=x, size=16, act="relu")
+            pred = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        elif model == "word2vec":
+            ctx = fluid.layers.data(name="ctx", shape=[CTX], dtype="int64")
+            target = fluid.layers.data(name="target", shape=[1], dtype="int64")
+            emb = fluid.layers.embedding(
+                input=ctx,
+                size=[DICT_DIM, EMB_DIM],
+                param_attr="shared_emb",
+            )
+            bow = fluid.layers.reduce_sum(emb, dim=1)
+            h = fluid.layers.fc(input=bow, size=EMB_DIM * 2, act="relu")
+            logits = fluid.layers.fc(input=h, size=DICT_DIM)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, target)
+            )
+        else:
+            raise ValueError(model)
+        if with_eval:
+            eval_prog = main.clone(for_test=True)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    if with_eval:
+        return main, startup, loss, eval_prog
     return main, startup, loss
 
 
@@ -33,18 +97,28 @@ def main():
     ap.add_argument("--trainers", type=int, default=1)
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--sync_mode", type=int, default=1)
+    ap.add_argument("--model", default="mlp", choices=["mlp", "word2vec"])
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--gm_k", type=int, default=0)
+    ap.add_argument("--save_dir", default="")
+    ap.add_argument("--save_after", type=int, default=0)
+    ap.add_argument("--load_dir", default="")
+    ap.add_argument("--start_step", type=int, default=0)
     args = ap.parse_args()
 
     import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
     from paddle_tpu.executor import Scope, scope_guard
     from paddle_tpu.transpiler import (
         DistributeTranspiler,
         DistributeTranspilerConfig,
     )
 
-    main_prog, startup, loss = build()
+    main_prog, startup, loss = build(args.model, args.lr)
     config = DistributeTranspilerConfig()
     config.min_block_size = 1
+    if args.gm_k:
+        config.gradient_merge_k = args.gm_k
     t = DistributeTranspiler(config)
     t.transpile(
         trainer_id=args.trainer_id,
@@ -58,27 +132,78 @@ def main():
     if args.role == "pserver":
         prog = t.get_pserver_program(args.current_endpoint)
         sstartup = t.get_startup_program(args.current_endpoint, prog)
-        with scope_guard(Scope(seed=3)):
+        scope = Scope(seed=3)
+        with scope_guard(scope):
             exe = fluid.Executor()
             exe.run(sstartup)
+            if args.load_dir:
+                # dist save/load resume: restore THIS shard's vars (names
+                # created by the startup program) from the checkpoint dir
+                from paddle_tpu import io as fluid_io
+
+                saved = fluid_io.load_arrays(args.load_dir)
+                mine = set(scope.var_names())
+                for name, arr in saved.items():
+                    # __gm_* names restore the gradient-merge window state
+                    # (run_pserver pops them out of the scope at start)
+                    if name in mine or name.startswith("__gm_"):
+                        scope.set_var(name, arr)
             print("PSERVER_READY", flush=True)
             exe.run(prog)  # blocks until all trainers send COMPLETE
         return
 
     trainer_prog = t.get_trainer_program()
-    rng = np.random.RandomState(100 + args.trainer_id)
-    w_true = np.random.RandomState(0).randn(8, 1).astype(np.float32)
+
+    def load_into_trainer(scope):
+        """Full-name arrays load directly; pserver shard checkpoints
+        (<name>.blockN) are reassembled by dim-0 concat (resume: the
+        trainer must start from the checkpointed params, not its local
+        init — reference dist_save_load.py loads on the trainer too)."""
+        from paddle_tpu import io as fluid_io
+
+        saved = fluid_io.load_arrays(args.load_dir)
+        mine = set(scope.var_names())
+        groups = {}
+        for name, arr in saved.items():
+            if name in mine:
+                scope.set_var(name, arr)
+            elif ".block" in name:
+                base, _, idx = name.rpartition(".block")
+                if base in mine:
+                    groups.setdefault(base, []).append((int(idx), arr))
+        for base, parts in groups.items():
+            arrs = [a for _, a in sorted(parts, key=lambda p: p[0])]
+            scope.set_var(base, np.concatenate(arrs, axis=0))
+
     losses = []
-    with scope_guard(Scope(seed=5)):
+    scope = Scope(seed=5)
+    with scope_guard(scope):
         exe = fluid.Executor()
         exe.run(startup)
-        for _ in range(args.steps):
-            xb = rng.randn(16, 8).astype(np.float32)
-            yb = (np.abs(xb) @ np.abs(w_true)) + 0.01 * rng.randn(16, 1).astype(
-                np.float32
-            )
-            (lv,) = exe.run(trainer_prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        if args.load_dir:
+            load_into_trainer(scope)
+        for s in range(args.start_step, args.start_step + args.steps):
+            feed = make_batch(args.model, args.trainer_id, s)
+            (lv,) = exe.run(trainer_prog, feed=feed, fetch_list=[loss])
             losses.append(float(np.asarray(lv).reshape(-1)[0]))
+            if (
+                args.save_dir
+                and args.trainer_id == 0
+                and s - args.start_step + 1 == args.save_after
+            ):
+                ck = framework.Program()
+                ck.global_block().append_op(
+                    type="checkpoint_notify",
+                    inputs={},
+                    outputs={},
+                    attrs={
+                        "dir": args.save_dir,
+                        "epmap": args.endpoints.split(","),
+                        "trainer_id": args.trainer_id,
+                    },
+                )
+                exe.run(ck)
+                print("CHECKPOINT_SAVED", flush=True)
         exe.close()  # SendComplete → pserver exits when all trainers did
     print("LOSSES " + json.dumps(losses), flush=True)
 

@@ -14,7 +14,12 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu import framework
 from paddle_tpu.executor import Scope, scope_guard
 
-from op_test import OpTest
+from op_test import _TOL_SCALE, OpTest
+
+# function tests compare f32 device results against f64 numpy references;
+# on the TPU lane (PADDLE_OPTEST_PLACE=tpu) device rounding differs from
+# CPU by ~1e-4 relative, so the fixed bounds scale like OpTest.check_output
+FN_RTOL = min(1e-4 * _TOL_SCALE, 2e-2)
 
 
 def run_prog(main, startup, feed, fetch, seed=0):
@@ -209,7 +214,7 @@ def test_warpctc_matches_brute_force():
         [loss.name])
     logp = logits[0] - np.log(np.exp(logits[0]).sum(1, keepdims=True))
     want = _ctc_brute_force(logp, [1, 2], blank=0)
-    np.testing.assert_allclose(np.asarray(lv).reshape(()), want, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(lv).reshape(()), want, rtol=FN_RTOL)
 
 
 def test_ctc_greedy_decoder_collapses():
@@ -353,7 +358,7 @@ def test_hsigmoid_matches_manual():
             t = float(x[b] @ w[idx])
             want[b] += softplus(t) - bit * t
             j += 1
-    np.testing.assert_allclose(got, want, rtol=1e-4)
+    np.testing.assert_allclose(got, want, rtol=FN_RTOL)
 
 
 def test_hsigmoid_trains():
