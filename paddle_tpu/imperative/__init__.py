@@ -17,8 +17,14 @@ code — the per-op dispatch the reference's tracer did never exists here.
 from . import nn  # noqa: F401
 from .base import Tape, Variable, enabled, guard, to_variable  # noqa: F401
 from .layers import Layer, PyLayer  # noqa: F401
+from .parallel import (  # noqa: F401
+    DataParallel,
+    Env,
+    ParallelEnv,
+    prepare_context,
+)
 
 __all__ = [
     "guard", "enabled", "to_variable", "Variable", "Layer", "PyLayer", "Tape",
-    "nn",
+    "nn", "ParallelEnv", "Env", "DataParallel", "prepare_context",
 ]

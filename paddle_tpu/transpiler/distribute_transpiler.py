@@ -161,6 +161,13 @@ class DistributeTranspiler:
         self.trainer_id = trainer_id
         self.trainer_num = trainers
         self.sync_mode = sync_mode
+        if int(getattr(self.config, "gradient_merge_k", 0) or 0) > 1 and not sync_mode:
+            raise ValueError(
+                "gradient_merge_k > 1 requires sync_mode=True: the merge "
+                "window is defined by sync rounds (async applies each grad "
+                "as it arrives, so a silent no-merge would train at the "
+                "wrong effective batch size)"
+            )
         self.origin_program = program or framework.default_main_program()
         self.startup_program = (
             startup_program or framework.default_startup_program()
