@@ -313,42 +313,48 @@ def run_lstm(hid=512, bs=64, t=100, dict_dim=30000, steps=10, warmup=3,
             return staged_ms, None
 
         # Input-pipeline keep-up on a byte-light feed (the VERDICT-4a
-        # evidence): this config moves ~51.5 KB/step over the wire
-        # (64x100 int64 words + lens + labels), so even this harness's
-        # ~22 MB/s host->device tunnel stages a batch in ~2.3 ms — far
-        # inside the ~15 ms/step device time the feeder thread has to hide
-        # it in. capacity >= steps keeps a full multi-step pull staged
-        # ahead, so the timed call pops k device-resident batches and
-        # dispatches immediately.
+        # evidence): this config moves ~51.5 KB/step over the wire (64x100
+        # int64 words + lens + labels). BYTE math is easy (~2-3 ms/step at
+        # the tunnel's ~20 MB/s) but this harness's tunnel is LATENCY-bound
+        # per transfer (~10 ms/device_put x 3 arrays/batch ~= the 11.5 ms
+        # step itself — per-step staging measured frac ~0.63). The pipeline
+        # design answer is staging granularity: the reader yields SUPER-
+        # batches at the steps_per_run granularity (3 transfers per k
+        # steps — the reference's double_buffer over paddle.batch batches
+        # is the same batching-of-transfers pattern), and next_batch()
+        # returns the stacked [k, ...] feed the multi-step call consumes
+        # directly.
         from paddle_tpu.py_reader import PyReader
 
         try:
-            host = {n: np.asarray(v) for n, v in feed.items()}
+            host = {
+                n: np.stack([np.asarray(v)] * steps) for n, v in feed.items()
+            }
 
             def gen():
-                for _ in range(3 * steps):
+                for _ in range(4):
                     yield host
 
-            reader = PyReader(list(feed), capacity=steps + 2)
+            reader = PyReader(list(feed), capacity=3)
             reader.decorate_tensor_provider(gen)
-            main._py_readers = [reader]
             reader.start()
             try:
                 (l,) = exe.run(
-                    main, fetch_list=[loss.name], return_numpy=False,
-                    steps_per_run=steps,
+                    main, feed=reader.next_batch(), fetch_list=[loss.name],
+                    return_numpy=False, steps_per_run=steps,
                 )
                 np.asarray(l)
                 t0 = time.perf_counter()
-                (l,) = exe.run(
-                    main, fetch_list=[loss.name], return_numpy=False,
-                    steps_per_run=steps,
-                )
+                for _ in range(2):
+                    (l,) = exe.run(
+                        main, feed=reader.next_batch(),
+                        fetch_list=[loss.name],
+                        return_numpy=False, steps_per_run=steps,
+                    )
                 np.asarray(l)
-                pyreader_ms = (time.perf_counter() - t0) / steps * 1e3
+                pyreader_ms = (time.perf_counter() - t0) / (2 * steps) * 1e3
             finally:
                 reader.reset()
-                main._py_readers = []
             return staged_ms, staged_ms / pyreader_ms
         except Exception as e:
             # evidence pass must never invalidate the measured headline
@@ -417,8 +423,6 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=10,
     import paddle_tpu.fluid as fluid
     from paddle_tpu.executor import Scope, scope_guard
 
-    import jax.numpy as jnp
-
     main, startup, feed, loss, flops = build_transformer(b, t, d, n_layer, vocab)
     exe = fluid.Executor(fluid.TPUPlace())
     with scope_guard(Scope(seed=0)):
@@ -426,20 +430,18 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=10,
         from paddle_tpu.transpiler.bf16_transpiler import Bf16Transpiler
 
         Bf16Transpiler().transpile(main)
-        # multi-step dispatch: all `steps` iterations in one XLA call (the
-        # token feeds are ~KB-scale, so stacking k copies is free)
-        stacked = {n: jnp.stack([v] * steps) for n, v in feed.items()}
-        for _ in range(warmup // 2 + 1):
-            (l,) = exe.run(
-                main, feed=stacked, fetch_list=[loss.name],
-                return_numpy=False, steps_per_run=steps,
-            )
+        # per-step dispatch, deliberately: on this 236 ms step the ~3 ms
+        # dispatch is 1.3%, while the k-step scan measured SLOWER (122.1 ->
+        # 120.5 TF/s — XLA copies part of the donated f32 optimizer-state
+        # carry through the loop). Multi-step pays off on short steps
+        # (ResNet 110 ms, LSTM 12 ms), not here. (Measured round 4,
+        # PROFILE.md "Multi-step dispatch".)
+        for _ in range(warmup):
+            (l,) = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
         np.asarray(l)
         t0 = time.perf_counter()
-        (l,) = exe.run(
-            main, feed=stacked, fetch_list=[loss.name],
-            return_numpy=False, steps_per_run=steps,
-        )
+        for _ in range(steps):
+            (l,) = exe.run(main, feed=feed, fetch_list=[loss.name], return_numpy=False)
         np.asarray(l)
         dt = (time.perf_counter() - t0) / steps
     return flops / dt / 1e12

@@ -55,10 +55,19 @@ def save_arrays(dirname, arrays):
             meta[name] = orig_dtype
         path = os.path.join(dirname, name + ".npy")
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        np.save(path, arr)
+        # atomic write-then-rename: concurrent checkpointers may legally
+        # write the same file (two pserver shards of one cluster checkpoint
+        # both record shared vars like the lr); a torn np.save would
+        # corrupt the restore of a LATER run, so each writer lands a whole
+        # file and os.replace picks a winner
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        np.save(tmp, arr)
+        os.replace(tmp + ("" if tmp.endswith(".npy") else ".npy"), path)
     if meta:
-        with open(os.path.join(dirname, "__dtypes__.json"), "w") as f:
+        tmp = os.path.join(dirname, "__dtypes__.json.tmp.%d" % os.getpid())
+        with open(tmp, "w") as f:
             json.dump(meta, f)
+        os.replace(tmp, os.path.join(dirname, "__dtypes__.json"))
 
 
 def load_arrays(dirname):
@@ -76,8 +85,8 @@ def load_arrays(dirname):
     out = {}
     for root, _dirs, files in os.walk(dirname):
         for fname in sorted(files):
-            if not fname.endswith(".npy"):
-                continue
+            if not fname.endswith(".npy") or ".tmp." in fname:
+                continue  # skip orphaned atomic-write temps
             path = os.path.join(root, fname)
             # var names may contain path separators (save_arrays makes the
             # subdirs); reconstruct the name relative to dirname

@@ -34,8 +34,15 @@ DEFAULT_FILES = [
     "tests/test_loss_ops.py",
     "tests/test_ops_final.py",
 ]
-# flash-attention kernel equivalence runs on-chip via its own test module
-EXTRA_FILES = ["tests/test_nn_extra_ops.py"]
+# flash attention + control flow + detection + frame/RNN-compose ops: the
+# device segments of these compile to the chip too (host RPC ops stay host)
+EXTRA_FILES = [
+    "tests/test_nn_extra_ops.py",
+    "tests/test_control_flow.py",
+    "tests/test_detection.py",
+    "tests/test_compose_frame_ops.py",
+    "tests/test_ops_roundout.py",
+]
 
 
 def main():
