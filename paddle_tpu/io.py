@@ -59,29 +59,48 @@ def save_arrays(dirname, arrays):
         # write the same file (two pserver shards of one cluster checkpoint
         # both record shared vars like the lr); a torn np.save would
         # corrupt the restore of a LATER run, so each writer lands a whole
-        # file and os.replace picks a winner
+        # file and os.replace picks a winner (np.save on an open file
+        # object appends no suffix)
         tmp = "%s.tmp.%d" % (path, os.getpid())
-        np.save(tmp, arr)
-        os.replace(tmp + ("" if tmp.endswith(".npy") else ".npy"), path)
+        with open(tmp, "wb") as f:
+            np.save(f, arr)
+        os.replace(tmp, path)
     if meta:
-        tmp = os.path.join(dirname, "__dtypes__.json.tmp.%d" % os.getpid())
+        # per-writer dtype meta (merged by load_arrays/load_vars):
+        # concurrent shard checkpointers record DISJOINT bf16 vars, and a
+        # shared last-writer-wins __dtypes__.json would silently drop the
+        # losing shard's entries
+        meta_path = os.path.join(dirname, "__dtypes__.%d.json" % os.getpid())
+        tmp = meta_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(meta, f)
-        os.replace(tmp, os.path.join(dirname, "__dtypes__.json"))
+        os.replace(tmp, meta_path)
+
+
+def _load_dtype_meta(dirname):
+    """Merge every `__dtypes__*.json` in dirname (one per concurrent
+    checkpoint writer — see save_arrays) into a single name->dtype map."""
+    meta = {}
+    try:
+        names = sorted(os.listdir(dirname))
+    except OSError:
+        return meta
+    for fname in names:
+        if fname.startswith("__dtypes__") and fname.endswith(".json"):
+            with open(os.path.join(dirname, fname)) as f:
+                meta.update(json.load(f))
+    return meta
 
 
 def load_arrays(dirname):
     """Inverse of save_arrays: read every `<name>.npy` in dirname back into a
-    name->array dict (bf16 restored per `__dtypes__.json`). Used by pserver
-    shard-checkpoint restore (a pserver's shard var names are only known to
-    the transpiled program, so restore is by-directory, not by-program)."""
+    name->array dict (bf16 restored per the `__dtypes__*.json` metas). Used
+    by pserver shard-checkpoint restore (a pserver's shard var names are only
+    known to the transpiled program, so restore is by-directory, not
+    by-program)."""
     import jax.numpy as jnp
 
-    meta_path = os.path.join(dirname, "__dtypes__.json")
-    meta = {}
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
+    meta = _load_dtype_meta(dirname)
     out = {}
     for root, _dirs, files in os.walk(dirname):
         for fname in sorted(files):
@@ -172,11 +191,7 @@ def load_vars(
     if vars is None:
         vars = [v for v in program.list_vars() if predicate is None or predicate(v)]
     scope = global_scope()
-    meta_path = os.path.join(dirname, "__dtypes__.json")
-    meta = {}
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
+    meta = _load_dtype_meta(dirname)
     combined = None
     if filename is not None:
         combined = np.load(os.path.join(dirname, filename + (".npz" if not filename.endswith(".npz") else "")))

@@ -353,6 +353,57 @@ class TestProximalAdagradOp(OpTest):
         self.check_output(atol=1e-5)
 
 
+def _train_adam_mlp(moment_dtype, steps=40):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.Adam(
+            learning_rate=0.01, moment_dtype=moment_dtype
+        ).minimize(loss)
+    rng = np.random.RandomState(4)
+    scope = Scope(seed=9)
+    losses = []
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            xb = rng.randn(32, 8).astype("float32")
+            yb = xb.sum(1, keepdims=True).astype("float32")
+            (lv,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(())))
+        moment_dtypes = {
+            str(np.asarray(v).dtype) if "bfloat16" not in str(getattr(v, "dtype", "")) else "bfloat16"
+            for n, v in scope.vars.items()
+            if "_moment" in n and v is not None
+        }
+    return losses, moment_dtypes
+
+
+def test_adam_bf16_moments_converge_like_f32():
+    """moment_dtype="bfloat16": stored moments really are bf16, the update
+    still computes f32 (_opt_f32), and convergence matches f32 moments to
+    bf16-noise tolerance (the 8-bit-Adam-family state-compression tier)."""
+    f32_losses, f32_dtypes = _train_adam_mlp(None)
+    bf16_losses, bf16_dtypes = _train_adam_mlp("bfloat16")
+    assert f32_dtypes == {"float32"}
+    assert bf16_dtypes == {"bfloat16"}
+    # both train to a small loss; trajectories agree loosely (bf16 mantissa
+    # noise on m/v compounds over steps)
+    assert bf16_losses[-1] < 0.1 * bf16_losses[0]
+    np.testing.assert_allclose(bf16_losses[:5], f32_losses[:5], rtol=0.05)
+    assert abs(bf16_losses[-1] - f32_losses[-1]) < 0.15 * max(
+        f32_losses[0], 1e-3
+    )
+
+
 if __name__ == "__main__":
     import unittest
 
