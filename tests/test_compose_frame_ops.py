@@ -10,7 +10,7 @@ import unittest
 import numpy as np
 
 import paddle_tpu.fluid as fluid
-from op_test import OpTest
+from op_test import _TOL_SCALE, OpTest
 from paddle_tpu import framework
 from paddle_tpu.executor import Executor, Scope, scope_guard
 
@@ -516,7 +516,11 @@ class TestCudnnLstmStackedBidirec(unittest.TestCase):
             )
         self.assertEqual(out.shape, (t, n, 2 * h))
         self.assertEqual(lh.shape, (4, n, h))  # 2 layers x 2 directions
-        np.testing.assert_allclose(out, cur, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            out, cur,
+            rtol=min(1e-4 * _TOL_SCALE, 2e-2),
+            atol=min(1e-5 * _TOL_SCALE, 2e-3),
+        )
 
 
 if __name__ == "__main__":

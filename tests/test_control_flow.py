@@ -8,8 +8,15 @@ import numpy as np
 import pytest
 
 import paddle_tpu.fluid as fluid
+from op_test import _TOL_SCALE
 from paddle_tpu import framework
 from paddle_tpu.executor import Scope, scope_guard
+
+# RNN scans compound per-step device rounding; on the TPU lane
+# (PADDLE_OPTEST_PLACE=tpu) the fixed f32 bounds scale like
+# OpTest.check_output (measured <=7e-4 rel over 6 tanh-matmul steps)
+RNN_RTOL = min(1e-5 * _TOL_SCALE, 2e-2)
+RNN_ATOL = min(1e-6 * _TOL_SCALE, 2e-3)
 
 
 def _fresh():
@@ -137,7 +144,7 @@ def test_static_rnn_matches_numpy():
     for t in range(T):
         h = np.tanh(xv[t] + h)
         expect.append(h)
-    np.testing.assert_allclose(out_v, np.stack(expect), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out_v, np.stack(expect), rtol=RNN_RTOL, atol=RNN_ATOL)
 
 
 def test_dynamic_rnn_masks_finished_rows():
@@ -176,8 +183,8 @@ def test_dynamic_rnn_masks_finished_rows():
         active = (t < lens)[:, None]
         h = np.where(active, nh, h)
         outs[:, t] = np.where(active, nh, 0.0)
-    np.testing.assert_allclose(out_v, outs, rtol=1e-5, atol=1e-6)
-    np.testing.assert_allclose(last_v, h, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out_v, outs, rtol=RNN_RTOL, atol=RNN_ATOL)
+    np.testing.assert_allclose(last_v, h, rtol=RNN_RTOL, atol=RNN_ATOL)
     # padding is zero
     assert np.all(out_v[1, 3:] == 0) and np.all(out_v[2, 1:] == 0)
 
