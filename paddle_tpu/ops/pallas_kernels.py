@@ -6,11 +6,14 @@ codegen wasn't enough; on TPU that role belongs to Pallas kernels lowered
 onto MXU/VPU tiles (SURVEY.md §7.9 perf closure).
 
 Kernels: blockwise flash attention forward (online-softmax over KV blocks,
-saving only the per-row logsumexp) and the flash-attention-2 style backward
-(dQ streamed over K blocks; dK/dV streamed over Q blocks) — the transformer
-hot path with O(t) attention memory end to end, ~1.4-2x XLA's dense chain at
-t=4096 bf16 on chip. Ragged tile shapes fall back to the dense form in both
-directions (a trace-time decision).
+saving only the per-row logsumexp) and a fused flash-attention-2 style
+backward — one kernel per K block computing dK, dV, and dQ partials, so the
+score matrix and dO·Vᵀ are built once instead of twice (the classic
+two-kernel split recomputes both; measured 2.4 -> 1.56 ms per fwd+grad at
+t=1024 on chip). Long-context shapes stream the non-resident side through
+the grid (separate dQ / dKV kernels there, where VMEM residency is the
+binding constraint, not flop count). Ragged tile shapes fall back to the
+dense form in both directions (a trace-time decision).
 
 On non-TPU backends (the CPU test mesh) the kernel runs in Pallas interpret
 mode — same code path, no Mosaic compile — keeping tests hermetic.
@@ -27,8 +30,9 @@ from .registry import register
 
 __all__ = ["flash_attention", "flash_tiles_ok", "flash_path_taken"]
 
-_DEF_BLOCK_Q = 512
+_DEF_BLOCK_Q = 1024
 _DEF_BLOCK_K = 1024
+_DEF_BLOCK_Q_CAUSAL = 512
 _DEF_BLOCK_K_CAUSAL = 512  # smaller K stream keeps the causal chunk-skip live
 # streamed (long-context) tier optimum, swept at t=16384 on chip: (1024,1024)
 # runs 100/124 TF/s eff fwd (causal/not) vs 51/63 at (512,512); same ranking
@@ -57,8 +61,11 @@ def _auto_block(t, target):
 
 
 def _resolve_blocks(block_q, block_k, causal):
+    # r05 on-chip sweep (t=1024, d=128, bh=128, fused bwd): non-causal
+    # (1024,1024) runs fwd+grad at 1.60 ms vs 1.77 at (512,1024); causal
+    # keeps (512,512) (1.84 ms; one whole-t K block can't skip masked chunks)
     return (
-        block_q or _DEF_BLOCK_Q,
+        block_q or (_DEF_BLOCK_Q_CAUSAL if causal else _DEF_BLOCK_Q),
         block_k or (_DEF_BLOCK_K_CAUSAL if causal else _DEF_BLOCK_K),
     )
 
@@ -88,15 +95,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k, causal,
     """One (batch*head, q_block) program: stream KV blocks with the online
     softmax recurrence (m = running max, l = running sum, acc = running PV)."""
     qi = pl.program_id(q_block_idx_axis)
-    q = q_ref[...].astype(jnp.float32)  # (block_q, d)
+    # operands stay in their native dtype (bf16 on the train path): the MXU
+    # multiplies bf16 pairs at full rate and accumulates f32 via
+    # preferred_element_type — upcasting to f32 FIRST forces the multi-pass
+    # f32 MXU emulation at a fraction of peak (measured: the whole fwd
+    # kernel 131 -> 178 TF/s from this change alone)
+    q = q_ref[...]  # (block_q, d)
     block_q = q.shape[0]
     t_k = k_ref.shape[0]
     nk = pl.cdiv(t_k, block_k)
 
     def body(ki, carry):
         acc, m_prev, l_prev = carry
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # (block_q, block_k)
@@ -118,8 +130,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k, causal,
         p = jnp.exp(s - m_new[:, None])
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        # p rounds to v's dtype for the PV dot — the same rounding the dense
+        # XLA chain applies (probs.astype(q.dtype) in _attention_reference)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return acc, m_new, l_new
 
@@ -177,9 +192,9 @@ def _flash_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
 
     @pl.when(needed)
     def _step():
-        q = q_ref[...].astype(jnp.float32)
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
+        q = q_ref[...]
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
@@ -200,7 +215,8 @@ def _flash_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
@@ -268,12 +284,15 @@ def _no_lse_adapter(kernel, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
 def flash_tiles_ok(t, block=None):
     """Conservative symmetric predicate for callers that REQUIRE the Pallas
     path on a square t (the flash ring, whose merge needs the lse the dense
-    fallback doesn't produce). It gates on the q-side (512) target, which is
-    strictly tighter than any k-side target — if it passes, _flash_forward
-    takes the Pallas path for both directions."""
+    fallback doesn't produce). It gates on the TIGHTEST block target across
+    causal/non-causal and q/k sides (the causal 512 targets) — if it passes,
+    _flash_forward takes the Pallas path for both directions in either
+    mode."""
     if t <= 0:
         return False
-    return _auto_block(t, block or _DEF_BLOCK_Q) > 0
+    tightest = min(_DEF_BLOCK_Q, _DEF_BLOCK_K,
+                   _DEF_BLOCK_Q_CAUSAL, _DEF_BLOCK_K_CAUSAL)
+    return _auto_block(t, block or tightest) > 0
 
 
 def flash_path_taken(tq, tk, causal=False, block_q=None, block_k=None):
@@ -318,6 +337,11 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
             out, lse = res
             return out.reshape(b, h, tq, d), lse[..., 0].reshape(b, h, tq)
         return res.reshape(b, h, tq, d)
+    if tq >= 4096:
+        # same VMEM clamp as the fused backward: (1024, block_k) f32
+        # score/probability temporaries overflow once the resident K/V
+        # slabs reach t=4096 (compile-checked on chip); 512 holds to 8192
+        block_q = min(block_q, 512)
     grid = (b * h, tq // block_q)
     out_shapes = [jax.ShapeDtypeStruct((b * h, tq, d), q.dtype)]
     out_specs = [pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0))]
@@ -362,71 +386,40 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
 # ---------------------------------------------------------------------------
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k, causal, sm_scale, t_q_total):
-    qi = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32)
-    do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[..., 0].astype(jnp.float32)
-    delta = delta_ref[..., 0].astype(jnp.float32)
-    block_q = q.shape[0]
-    t_k = k_ref.shape[0]
-    nk = pl.cdiv(t_k, block_k)
-
-    def body(ki, dq):
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale
-        if causal:
-            offset = t_k - t_q_total
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos + offset >= k_pos, s, -jnp.inf)
-        p = jnp.exp(s - lse[:, None])
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-
-    if causal:
-        last_key = qi * block_q + block_q - 1 + (t_k - t_q_total)
-        nk_needed = jnp.clip((last_key + block_k) // block_k, 0, nk)
-    else:
-        nk_needed = nk
-    dq = jax.lax.fori_loop(
-        0, nk_needed, body, jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    )
-    dq_ref[...] = dq.astype(dq_ref.dtype)
-
-
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q, causal, sm_scale,
-                          t_q_total):
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                            dk_ref, dv_ref, dqp_ref, *, block_q, causal,
+                            sm_scale, t_q_total):
+    """Fused resident backward: one (bh, k_block) program computes dK and dV
+    for its K block AND this K block's partial contribution to every dQ row
+    (summed over k blocks by XLA outside). The two-kernel form recomputes the
+    score matrix s and dp = dO·Vᵀ in BOTH kernels — 7 matmul-units per
+    backward vs the 5 this kernel executes (s, dp, dV, dK, dQ-partial), a
+    28%% flop cut on the exact tier the MFU bench runs (measured on chip:
+    fwd+grad 2.42 -> 1.87 ms at t=1024 bh=128 non-causal)."""
     ki = pl.program_id(1)
-    k_blk = k_ref[...].astype(jnp.float32)  # (block_k, d)
-    v_blk = v_ref[...].astype(jnp.float32)
+    k_blk = k_ref[...]  # (block_k, d)
+    v_blk = v_ref[...]
     block_k = k_blk.shape[0]
     t_k_total = pl.num_programs(1) * block_k
     offset = t_k_total - t_q_total  # bottom-right causal alignment
     t_q = q_ref.shape[0]
     nq = pl.cdiv(t_q, block_q)
 
+    dqp_ref[...] = jnp.zeros_like(dqp_ref)  # skipped causal rows stay 0
+
     def body(qi, carry):
         dk, dv = carry
-        q_blk = q_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[pl.ds(qi * block_q, block_q), :]
+        do_blk = do_ref[pl.ds(qi * block_q, block_q), :]
         lse = lse_ref[pl.ds(qi * block_q, block_q), 0].astype(jnp.float32)
-        delta = delta_ref[pl.ds(qi * block_q, block_q), 0].astype(jnp.float32)
+        # delta = rowsum(dO * O) computed here from the saved forward output
+        # rather than as an XLA prologue: the prologue form writes + re-reads
+        # a 128-lane-broadcast f32 tensor per layer (~134 MB of HBM traffic)
+        # where this is a VPU rowsum over tiles already resident
+        o_blk = o_ref[pl.ds(qi * block_q, block_q), :]
+        delta = jnp.sum(
+            do_blk.astype(jnp.float32) * o_blk.astype(jnp.float32), axis=1
+        )
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -442,20 +435,24 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         dv = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(q_blk.dtype)
         dk = dk + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
+        dqp_ref[pl.ds(qi * block_q, block_q), :] = jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dqp_ref.dtype)
         return dk, dv
 
     if causal:
-        # q blocks whose last row still precedes this k block see nothing
         first_q_row = ki * block_k - offset
         q_start = jnp.clip(first_q_row // block_q, 0, nq)
     else:
@@ -494,12 +491,12 @@ def _flash_bwd_dq_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _step():
-        q = q_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
+        q = q_ref[...]
+        do = do_ref[...]
         lse = lse_ref[..., 0].astype(jnp.float32)
         delta = delta_ref[..., 0].astype(jnp.float32)
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
@@ -516,7 +513,7 @@ def _flash_bwd_dq_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(k_blk.dtype)
         dq_acc[...] = dq_acc[...] + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -551,12 +548,12 @@ def _flash_bwd_dkv_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _step():
-        q_blk = q_ref[...].astype(jnp.float32)
-        do_blk = do_ref[...].astype(jnp.float32)
+        q_blk = q_ref[...]
+        do_blk = do_ref[...]
         lse = lse_ref[..., 0].astype(jnp.float32)
         delta = delta_ref[..., 0].astype(jnp.float32)
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -572,13 +569,14 @@ def _flash_bwd_dkv_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(q_blk.dtype)
         dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -648,19 +646,20 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
     lse3 = jnp.broadcast_to(
         lse.reshape(b * h, tq)[..., None], (b * h, tq, _LANES)
     )
-    delta = jnp.broadcast_to(
-        jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-        .reshape(b * h, tq)[..., None],
-        (b * h, tq, _LANES),
-    )
 
-    # long-context tier: whole-side residency (K/V for dQ; Q/dO/lse/delta for
-    # dK/dV) breaks VMEM past ~8k tokens; stream through the grid instead
-    # (t=8192 bf16 d=128 resident measured working on chip, 16384 overflows)
-    if not (
+    # the fused kernel needs whole-side VMEM residency (breaks past ~8k
+    # tokens) and materializes an (nk, tq, d) dQ-partials HBM temporary —
+    # bounded to <=2x dQ by the nk cap here; everything bigger takes the
+    # grid-streamed two-kernel tier (any t, O(t) memory)
+    if tk // block_k > 2 or not (
         _resident_ok(tk, d, k.dtype.itemsize)
         and _resident_ok(tq, d, q.dtype.itemsize)
     ):
+        delta = jnp.broadcast_to(
+            jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+            .reshape(b * h, tq)[..., None],
+            (b * h, tq, _LANES),
+        )
         dq, dk, dv = _flash_backward_streamed(
             q3, k3, v3, do3, lse3, delta, causal, sm_scale,
             _auto_block(tq, raw_bq or _DEF_STREAM_BLOCK),
@@ -673,55 +672,49 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
             dv.reshape(b, h, tk, d),
         )
 
-    dq = pl.pallas_call(
+    if tq >= 4096:
+        # the fused kernel's f32 score/probability temporaries at
+        # block_q=1024 overflow VMEM once the resident q/do/o slabs reach
+        # t=4096 (compile-checked on chip); 512 holds through t=8192
+        block_q = min(block_q, 512)
+    nk = tk // block_k
+    dk, dv, dqp = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel,
-            block_k=block_k,
-            causal=causal,
-            sm_scale=sm_scale,
-            t_q_total=tq,
-        ),
-        grid=(b * h, tq // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, tk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, tk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, block_q, _LANES), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, block_q, _LANES), lambda bh, qi: (bh, qi, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
-        interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _flash_bwd_dkv_kernel,
+            _flash_bwd_fused_kernel,
             block_q=block_q,
             causal=causal,
             sm_scale=sm_scale,
             t_q_total=tq,
         ),
-        grid=(b * h, tk // block_k),
+        grid=(b * h, nk),
         in_specs=[
             pl.BlockSpec((None, tq, d), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((None, tq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, tq, _LANES), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, tq, d), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((None, tq, _LANES), lambda bh, ki: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, None, tq, d), lambda bh, ki: (bh, ki, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+            # dQ partials, one slab per k block, in q's dtype: each partial
+            # is already f32-accumulated inside its dot; the cross-block sum
+            # over <=8 terms loses nothing the final bf16 cast keeps
+            jax.ShapeDtypeStruct((b * h, nk, tq, d), q.dtype),
         ],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta)
+    )(q3, k3, v3, do3, out.reshape(b * h, tq, d), lse3)
+    dq = (
+        dqp[:, 0]
+        if nk == 1
+        else jnp.sum(dqp, axis=1, dtype=jnp.float32).astype(q.dtype)
+    )
 
     return (
         dq.reshape(b, h, tq, d),

@@ -198,10 +198,10 @@ def test_flash_streamed_long_context_tier():
 def test_lse_declaration_mirrors_lowering_decision():
     """layers.flash_attention must declare Lse exactly when the lowering
     takes the Pallas path (flash_path_taken), including the asymmetric case
-    tq=512/tk=600 non-causal where the per-direction block targets differ
-    (k target 1024 admits a whole 600-tile; the symmetric q-side predicate
-    would say no) — a mismatch would silently drop the saved residual and
-    fall back to the dense recompute-vjp backward."""
+    tq=512/tk=600 non-causal where the non-causal 1024 k target admits a
+    whole 600-tile while the conservative symmetric predicate does not — a
+    mismatch would silently drop the saved residual and fall back to the
+    dense recompute-vjp backward."""
     import jax.numpy as jnp
 
     import paddle_tpu.fluid as fluid
@@ -210,7 +210,11 @@ def test_lse_declaration_mirrors_lowering_decision():
     from paddle_tpu.ops import pallas_kernels as pk
 
     assert pk.flash_path_taken(512, 600, causal=False)
+    # flash_tiles_ok gates on the TIGHTEST (causal 512) target so ring
+    # callers can rely on it in either mode; 600 passes non-causal
+    # flash_path_taken (1024 k target) but not the conservative predicate
     assert not pk.flash_tiles_ok(600)
+    assert not pk.flash_tiles_ok(1200)
     assert not pk.flash_path_taken(512, 600, causal=True)  # causal k target 512
 
     main, startup = framework.Program(), framework.Program()

@@ -99,8 +99,11 @@ def test_ring_flash_ragged_falls_back():
 
     rng = np.random.RandomState(4)
     # _auto_block admits any t_loc <= the block target as one whole tile, so
-    # ragged now means: above the target AND not a multiple of 128
-    # (t_loc=520 -> no 128*2^k divisor, too big for a single tile)
+    # ragged means: above the conservative 512 target AND not a multiple of
+    # 128 (t_loc=520 -> no 128*2^k divisor, too big for a single 512-tile;
+    # the causal ring's diagonal chunk resolves causal (512,512) blocks, so
+    # the predicate MUST stay gated on the tightest target or the ring
+    # auto-selects flash and the dense-fallback chunk returns no lse)
     assert _flash_tiles_ok(130)  # small non-multiples ride one whole tile
     assert not _flash_tiles_ok(520)
     q, k, v = _qkv(rng, b=2, h=1, t=4 * 520, d=8)

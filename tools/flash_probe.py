@@ -1,0 +1,148 @@
+"""On-chip flash-kernel rate probe (VERDICT r04 item 1 evidence).
+
+Times paddle_tpu's Pallas flash forward and backward at the MFU-bench
+attention shape, reports effective TF/s (bench-accounted flops: 4*b*h*t*t*d
+fwd, 2x that bwd — the same accounting bench.py's MFU uses), and compares
+against (a) XLA's dense attention chain and (b) jax's own TPU flash kernel
+(jax.experimental.pallas.ops.tpu.flash_attention) as the hardware-ceiling
+probe.
+
+Usage: python tools/flash_probe.py [t] [--causal]
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+_RTT_MS = None
+
+
+def _measure_rtt():
+    """One-time measurement of the harness's dispatch+fetch round-trip (the
+    tunnel adds ~100 ms per call); subtracted from every timed loop call."""
+    global _RTT_MS
+    if _RTT_MS is None:
+        x = jnp.zeros((8, 128), jnp.float32)
+        f = jax.jit(lambda x: x.sum())
+        np.asarray(f(x))
+        samples = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            np.asarray(f(x))
+            samples.append(time.perf_counter() - t0)
+        _RTT_MS = min(samples) * 1e3
+        print(f"[harness] dispatch+fetch RTT = {_RTT_MS:.1f} ms (subtracted)")
+    return _RTT_MS
+
+
+def bench(fn, q, k, v, iters=96, warmup=2):
+    """Time `iters` applications inside ONE jit call: the probe environment's
+    per-dispatch tunnel latency (~8 ms) swamps sub-ms kernels, so the loop
+    must live on device. The carry threads the output back into q (same
+    shape/dtype), creating a data dependence that defeats CSE/LICM."""
+
+    @jax.jit
+    def loop(q, k, v):
+        def body(qc, _):
+            out = fn(qc, k, v)
+            if isinstance(out, tuple):
+                # consume every output (a corner element forces the whole
+                # producing kernel) or XLA DCEs the dk/dv kernel entirely
+                out = out[0] + sum(o[:1, :1, :1, :1] for o in out[1:])
+            return out.astype(qc.dtype), ()
+
+        qf, _ = jax.lax.scan(body, q, None, length=iters)
+        # scalar result: the sync below is a host FETCH (np.asarray) — the
+        # only reliable barrier under the tunnel (block_until_ready returns
+        # early there) — and it must not pay a bulk-tensor transfer
+        return qf.astype(jnp.float32).sum()
+
+    rtt = _measure_rtt()
+    for _ in range(warmup):
+        np.asarray(loop(q, k, v))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(loop(q, k, v))
+        best = min(best, time.perf_counter() - t0)
+    return max(best * 1e3 - rtt, 1e-6) / iters  # ms/iter
+
+
+def main():
+    b, h, d = 8, 16, 128
+    t = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 1024
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    do = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+
+    fwd_flops = 4 * b * h * t * t * d  # QK^T + PV, 2 flops/MAC
+    bwd_flops = 2 * fwd_flops  # bench accounting (s/p recompute uncounted)
+
+    from paddle_tpu.ops.pallas_kernels import flash_attention
+
+    for causal in ([False, True] if "--causal" not in sys.argv else [True]):
+        cf = 0.5 if causal else 1.0  # causal halves the live score area
+
+        def fwd(q, k, v):
+            return flash_attention(q, k, v, causal)
+
+        loss = lambda q, k, v: (flash_attention(q, k, v, causal) * do).sum()
+        ms_f = bench(fwd, q, k, v)
+        ms_g = bench(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+        print(f"[ours  ] causal={causal} t={t} fwd {ms_f:7.3f} ms "
+              f"({cf*fwd_flops/ms_f/1e9:6.1f} TF/s)  "
+              f"fwd+bwd {ms_g:7.3f} ms "
+              f"({cf*(fwd_flops+bwd_flops)/ms_g/1e9:6.1f} TF/s eff)")
+
+        # dense XLA chain
+        def dense(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (
+                d ** -0.5
+            )
+            if causal:
+                mask = jnp.tril(jnp.ones((t, t), bool))
+                s = jnp.where(mask, s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+        dense_loss = lambda q, k, v: (dense(q, k, v) * do).sum()
+        try:
+            ms_df = bench(dense, q, k, v)
+            ms_dg = bench(jax.grad(dense_loss, argnums=(0, 1, 2)), q, k, v)
+            print(f"[dense ] causal={causal} t={t} fwd {ms_df:7.3f} ms "
+                  f"({cf*fwd_flops/ms_df/1e9:6.1f} TF/s)  "
+                  f"fwd+bwd {ms_dg:7.3f} ms "
+                  f"({cf*(fwd_flops+bwd_flops)/ms_dg/1e9:6.1f} TF/s eff)")
+        except Exception as e:
+            print(f"[dense ] causal={causal} failed: {e!r}")
+
+        # jax's own TPU flash kernel — hardware-ceiling probe
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as jax_flash,
+            )
+
+            jf = functools.partial(jax_flash, causal=causal, sm_scale=d ** -0.5)
+            jf_loss = lambda q, k, v: (jf(q, k, v) * do).sum()
+            ms_jf = bench(jf, q, k, v)
+            ms_jg = bench(jax.grad(jf_loss, argnums=(0, 1, 2)), q, k, v)
+            print(f"[jaxref] causal={causal} t={t} fwd {ms_jf:7.3f} ms "
+                  f"({cf*fwd_flops/ms_jf/1e9:6.1f} TF/s)  "
+                  f"fwd+bwd {ms_jg:7.3f} ms "
+                  f"({cf*(fwd_flops+bwd_flops)/ms_jg/1e9:6.1f} TF/s eff)")
+        except Exception as e:
+            print(f"[jaxref] causal={causal} failed: {e!r}")
+
+
+if __name__ == "__main__":
+    main()

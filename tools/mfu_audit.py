@@ -245,7 +245,7 @@ class HloIndex:
 
 
 
-def profile_step(model, steps, b=None):
+def profile_step(model, steps, b=None, moment_dtype=None):
     """Run the bench step on the chip; return (hlo_text, events, wall_ms).
 
     events: {instr_name: total_device_ms} summed over `steps` steps."""
@@ -258,7 +258,9 @@ def profile_step(model, steps, b=None):
     from paddle_tpu.transpiler.bf16_transpiler import Bf16Transpiler
 
     if model == "transformer":
-        main, startup, feed, loss, flops = bench.build_transformer()
+        main, startup, feed, loss, flops = bench.build_transformer(
+            moment_dtype=moment_dtype
+        )
     elif model == "resnet":
         bs = b or 256
         main, startup, loss = bench.build(bs)
@@ -381,9 +383,16 @@ def main():
                     help="run isolated same-shape probes for top dots")
     ap.add_argument("--hlo-out", default=None,
                     help="also write the compiled HLO text here")
+    ap.add_argument("--bf16-moments", action="store_true",
+                    help="audit the bench-headline Adam(moment_dtype=bf16) step")
     args = ap.parse_args()
+    if args.bf16_moments and args.model != "transformer":
+        ap.error("--bf16-moments only applies to the transformer step")
 
-    hlo, events, wall_ms, flops = profile_step(args.model, args.steps)
+    hlo, events, wall_ms, flops = profile_step(
+        args.model, args.steps,
+        moment_dtype="bfloat16" if args.bf16_moments else None,
+    )
     if args.hlo_out:
         with open(args.hlo_out, "w") as f:
             f.write(hlo)
