@@ -435,10 +435,20 @@ def _pull_reader_steps(readers, steps_per_run):
     try:
         for _ in range(steps_per_run):
             d = {}
+            pulled = []  # (reader, batch) of this incomplete step
             for rd in readers:
-                d.update(rd.next_batch())
+                b = rd.next_batch()
+                pulled.append((rd, b))
+                d.update(b)
+            pulled = None  # step completed
             step_feeds.append(d)
     except EOFException:
+        # one reader of the group ended mid-step: the sibling batches
+        # already pulled for the INCOMPLETE step go back to their readers
+        # (they were never trained on), and the whole group defers the EOF
+        if pulled:
+            for rd, b in pulled:
+                rd.push_back(b)
         if not step_feeds:
             raise
         # tail consumed now; surface the EOF on the NEXT run
@@ -450,7 +460,10 @@ def _pull_reader_steps(readers, steps_per_run):
 def _started_readers(program):
     """Started py_readers of the program; raises the EOFException a previous
     partial multi-step pull deferred (its tail batches were trained on, so
-    the epoch end belongs to THIS call)."""
+    the epoch end belongs to THIS call). The program's readers are treated
+    as a UNIT: a deferred EOF on any of them ends the epoch for the group —
+    proceeding with the remaining readers would silently feed steps missing
+    the exhausted reader's slots."""
     from .py_reader import EOFException
 
     readers, deferred = [], False
@@ -460,7 +473,7 @@ def _started_readers(program):
             deferred = True
         elif rd.started:
             readers.append(rd)
-    if deferred and not readers:
+    if deferred:
         raise EOFException(
             "reader exhausted (tail consumed by the previous multi-step run)"
         )

@@ -34,14 +34,27 @@ __all__ = ["ParallelEnv", "Env", "prepare_context", "DataParallel"]
 
 
 class ParallelEnv:
-    """Rank layout (reference dygraph/parallel.py Env). In the SPMD model
-    one process spans many devices: nranks counts DEVICES in the data axis
-    (the reference counted processes), local_rank is the process index."""
+    """Rank layout (reference dygraph/parallel.py Env), with PROCESS
+    semantics for the reference's pair: `nranks` = process count and
+    `local_rank` = process index, so the standard per-rank data recipe
+    (`chunks[local_rank] of nranks`) composes correctly with DataParallel —
+    each process partitions the global data by process, and DataParallel
+    shards that chunk over the process's local devices. (An earlier layout
+    counted DEVICES in nranks while local_rank stayed the process index;
+    on one host that made the recipe always pick chunk 0 of n_devices and
+    silently train on 1/n of the data.) Device counts live on separate
+    attributes: `local_device_count` (this process) and
+    `data_parallel_degree` (devices in the data axis, = the divisor
+    DataParallel shards batches by)."""
 
     def __init__(self, devices=None):
         devices = devices if devices is not None else jax.devices()
-        self.nranks = len(devices)
+        self.nranks = jax.process_count()
         self.local_rank = jax.process_index()
+        self.local_device_count = len(
+            [d for d in devices if d.process_index == jax.process_index()]
+        ) or len(devices)
+        self.data_parallel_degree = len(devices)
         self.dev_id = devices[0].id
         self.current_endpoint = ""
         self.trainer_endpoints = []

@@ -43,16 +43,14 @@ def _bf16_safe_save(arr):
 
 
 def save_arrays(dirname, arrays):
-    """bf16-safe per-var np.save of a name->array dict, with the same
-    `<name>.npy` + `__dtypes__.json` layout load_vars reads. Shared with the
-    pserver checkpoint handler (distributed/listen_and_serv.py) so shard
-    checkpoints are restorable by the normal loaders."""
+    """bf16-safe per-var np.save of a name->array dict, with the layout
+    load_vars reads (`<name>.npy` + per-array `<name>.npy.dtype` sidecars).
+    Shared with the pserver checkpoint handler
+    (distributed/listen_and_serv.py) so shard checkpoints are restorable by
+    the normal loaders."""
     os.makedirs(dirname, exist_ok=True)
-    meta = {}
     for name, val in arrays.items():
         arr, orig_dtype = _bf16_safe_save(val)
-        if orig_dtype:
-            meta[name] = orig_dtype
         path = os.path.join(dirname, name + ".npy")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         # atomic write-then-rename: concurrent checkpointers may legally
@@ -65,21 +63,26 @@ def save_arrays(dirname, arrays):
         with open(tmp, "wb") as f:
             np.save(f, arr)
         os.replace(tmp, path)
-    if meta:
-        # per-writer dtype meta (merged by load_arrays/load_vars):
-        # concurrent shard checkpointers record DISJOINT bf16 vars, and a
-        # shared last-writer-wins __dtypes__.json would silently drop the
-        # losing shard's entries
-        meta_path = os.path.join(dirname, "__dtypes__.%d.json" % os.getpid())
-        tmp = meta_path + ".tmp"
+        # the dtype record travels WITH the array as a sidecar, so a later
+        # run reusing the directory can never resurrect a stale record (a
+        # shared or per-writer meta file outlives the save that wrote it:
+        # an f32 re-save of a var a previous run stored as bf16 would
+        # restore silently down-cast). Writers of the same var race only
+        # per-var and in the same direction as the .npy itself.
+        side = path + ".dtype"
+        tmp = "%s.tmp.%d" % (side, os.getpid())
         with open(tmp, "w") as f:
-            json.dump(meta, f)
-        os.replace(tmp, meta_path)
+            f.write(orig_dtype or "")  # empty = native dtype, and the
+            # sidecar's presence shadows any legacy __dtypes__*.json entry
+            # a previous run left for this name
+        os.replace(tmp, side)
 
 
 def _load_dtype_meta(dirname):
-    """Merge every `__dtypes__*.json` in dirname (one per concurrent
-    checkpoint writer — see save_arrays) into a single name->dtype map."""
+    """Merge every legacy `__dtypes__*.json` in dirname into a name->dtype
+    map. Current saves record dtypes as per-array `<name>.npy.dtype`
+    sidecars (checked first by _stored_dtype); the merged metas remain
+    readable for checkpoints written by earlier layouts."""
     meta = {}
     try:
         names = sorted(os.listdir(dirname))
@@ -90,6 +93,17 @@ def _load_dtype_meta(dirname):
             with open(os.path.join(dirname, fname)) as f:
                 meta.update(json.load(f))
     return meta
+
+
+def _stored_dtype(dirname, name, meta):
+    """Recorded save-dtype for `<dirname>/<name>.npy`: the sidecar wins
+    (written/removed atomically beside the array), legacy metas otherwise."""
+    side = os.path.join(dirname, name + ".npy.dtype")
+    try:
+        with open(side) as f:
+            return f.read().strip() or None
+    except OSError:
+        return meta.get(name)
 
 
 def load_arrays(dirname):
@@ -111,7 +125,7 @@ def load_arrays(dirname):
             # subdirs); reconstruct the name relative to dirname
             name = os.path.relpath(path, dirname)[: -len(".npy")]
             arr = np.load(path)
-            if meta.get(name) == "bfloat16":
+            if _stored_dtype(dirname, name, meta) == "bfloat16":
                 arr = jnp.asarray(arr, dtype=jnp.bfloat16)
             out[name] = arr
     return out
@@ -149,9 +163,10 @@ def save_vars(
                 meta[name] = orig_dtype
             combined[name] = arr
         np.savez(os.path.join(dirname, filename), **combined)
-        if meta:
-            with open(os.path.join(dirname, "__dtypes__.json"), "w") as f:
-                json.dump(meta, f)
+        # always rewrite (even empty): an earlier save's meta left in place
+        # would apply stale dtypes to a later all-f32 save of the same file
+        with open(os.path.join(dirname, "__dtypes__.json"), "w") as f:
+            json.dump(meta, f)
 
 
 def _is_param(v):
@@ -191,18 +206,29 @@ def load_vars(
     if vars is None:
         vars = [v for v in program.list_vars() if predicate is None or predicate(v)]
     scope = global_scope()
-    meta = _load_dtype_meta(dirname)
     combined = None
     if filename is not None:
         combined = np.load(os.path.join(dirname, filename + (".npz" if not filename.endswith(".npz") else "")))
+        # the combined save co-writes exactly __dtypes__.json (always, even
+        # empty); merging stray per-PID metas from an earlier per-var run
+        # here would resurrect stale dtype records
+        try:
+            with open(os.path.join(dirname, "__dtypes__.json")) as f:
+                meta = json.load(f)
+        except OSError:
+            meta = {}
+    else:
+        meta = _load_dtype_meta(dirname)
     for v in vars:
         name = v.name if isinstance(v, Variable) else str(v)
         if combined is not None:
             arr = combined[name]
+            if meta.get(name) == "bfloat16":
+                arr = jnp.asarray(arr, dtype=jnp.bfloat16)
         else:
             arr = np.load(os.path.join(dirname, name + ".npy"))
-        if meta.get(name) == "bfloat16":
-            arr = jnp.asarray(arr, dtype=jnp.bfloat16)
+            if _stored_dtype(dirname, name, meta) == "bfloat16":
+                arr = jnp.asarray(arr, dtype=jnp.bfloat16)
         scope.set_var(name, jnp.asarray(arr))
 
 

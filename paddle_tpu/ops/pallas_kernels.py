@@ -153,14 +153,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k, causal,
     acc, m, l = jax.lax.fori_loop(0, nk_needed, body, init)
     o_ref[...] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
     if lse_ref is not None:
-        # logsumexp residual for the flash backward, broadcast across a
-        # 128-lane dim (Mosaic's minimum tile width — the same residual
-        # layout jax's official TPU flash kernel uses). Fully-masked rows
-        # get a finite sentinel; their p = exp(-inf - lse) is 0 either way.
+        # logsumexp residual for the flash backward, PACKED as a (1, t_q)
+        # lane-major row per (b*h) — the earlier 128-lane broadcast layout
+        # cost ~67 MB of HBM write+read per bench attention layer where
+        # this is ~0.5 MB (the relayout from the row-reduction's sublane
+        # vector is a cheap in-register transpose). Fully-masked rows get
+        # a finite sentinel; their p = exp(-inf - lse) is 0 either way.
         lse = jnp.where(m == -jnp.inf, 0.0, m + jnp.log(jnp.maximum(l, 1e-20)))
-        lse_ref[...] = jnp.broadcast_to(
-            lse[:, None], lse_ref.shape
-        ).astype(lse_ref.dtype)
+        lse_ref[0, pl.ds(qi * block_q, block_q)] = lse.astype(lse_ref.dtype)
 
 
 def _flash_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
@@ -230,9 +230,7 @@ def _flash_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
         )
         if with_lse:
             lse = jnp.where(m == -jnp.inf, 0.0, m + jnp.log(jnp.maximum(l, 1e-20)))
-            lse_ref[...] = jnp.broadcast_to(
-                lse[:, None], lse_ref.shape
-            ).astype(lse_ref.dtype)
+            lse_ref[0, pl.ds(qi * block_q, block_q)] = lse.astype(lse_ref.dtype)
 
 
 def _flash_forward_streamed(q3, k3, v3, causal, sm_scale, block_q, block_k,
@@ -243,9 +241,9 @@ def _flash_forward_streamed(q3, k3, v3, causal, sm_scale, block_q, block_k,
     out_shapes = [jax.ShapeDtypeStruct((bh, tq, d), out_dtype)]
     out_specs = [pl.BlockSpec((None, block_q, d), lambda bh, qi, ki: (bh, qi, 0))]
     if with_lse:
-        out_shapes.append(jax.ShapeDtypeStruct((bh, tq, _LANES), jnp.float32))
+        out_shapes.append(jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32))
         out_specs.append(
-            pl.BlockSpec((None, block_q, _LANES), lambda bh, qi, ki: (bh, qi, 0))
+            pl.BlockSpec((None, 1, tq), lambda bh, qi, ki: (bh, 0, 0))
         )
     kernel = functools.partial(
         _flash_kernel_streamed,
@@ -335,22 +333,24 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         )
         if with_lse:
             out, lse = res
-            return out.reshape(b, h, tq, d), lse[..., 0].reshape(b, h, tq)
+            return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
         return res.reshape(b, h, tq, d)
-    if tq >= 4096:
-        # same VMEM clamp as the fused backward: (1024, block_k) f32
-        # score/probability temporaries overflow once the resident K/V
-        # slabs reach t=4096 (compile-checked on chip); 512 holds to 8192
+    if max(tq, tk) >= 4096:
+        # same VMEM clamp as the fused backward: the (1024, block_k) f32
+        # score/probability temporaries + resident K/V slabs overflow VMEM
+        # once EITHER side reaches t=4096 (the slabs scale with tk, the
+        # temporaries with block_q*block_k — compile-checked on chip,
+        # including asymmetric tq=1024/tk=4096); 512 holds through 8192
         block_q = min(block_q, 512)
     grid = (b * h, tq // block_q)
     out_shapes = [jax.ShapeDtypeStruct((b * h, tq, d), q.dtype)]
     out_specs = [pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0))]
     if with_lse:
         out_shapes.append(
-            jax.ShapeDtypeStruct((b * h, tq, _LANES), jnp.float32)
+            jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32)
         )
         out_specs.append(
-            pl.BlockSpec((None, block_q, _LANES), lambda bh, qi: (bh, qi, 0))
+            pl.BlockSpec((None, 1, tq), lambda bh, qi: (bh, 0, 0))
         )
     res = pl.pallas_call(
         functools.partial(
@@ -373,7 +373,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     )(q3, k3, v3)
     if with_lse:
         out, lse = res
-        return out.reshape(b, h, tq, d), lse[..., 0].reshape(b, h, tq)
+        return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
     return res.reshape(b, h, tq, d)
 
 
@@ -411,7 +411,7 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dk, dv = carry
         q_blk = q_ref[pl.ds(qi * block_q, block_q), :]
         do_blk = do_ref[pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[pl.ds(qi * block_q, block_q), 0].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
         # delta = rowsum(dO * O) computed here from the saved forward output
         # rather than as an XLA prologue: the prologue form writes + re-reads
         # a 128-lane-broadcast f32 tensor per layer (~134 MB of HBM traffic)
@@ -491,10 +491,11 @@ def _flash_bwd_dq_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _step():
+        block_q_ = q_ref.shape[0]
         q = q_ref[...]
         do = do_ref[...]
-        lse = lse_ref[..., 0].astype(jnp.float32)
-        delta = delta_ref[..., 0].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q_, block_q_)].astype(jnp.float32)
+        delta = delta_ref[0, pl.ds(qi * block_q_, block_q_)].astype(jnp.float32)
         k_blk = k_ref[...]
         v_blk = v_ref[...]
         s = jax.lax.dot_general(
@@ -548,10 +549,11 @@ def _flash_bwd_dkv_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _step():
+        block_q_ = q_ref.shape[0]
         q_blk = q_ref[...]
         do_blk = do_ref[...]
-        lse = lse_ref[..., 0].astype(jnp.float32)
-        delta = delta_ref[..., 0].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q_, block_q_)].astype(jnp.float32)
+        delta = delta_ref[0, pl.ds(qi * block_q_, block_q_)].astype(jnp.float32)
         k_blk = k_ref[...]
         v_blk = v_ref[...]
         s = jax.lax.dot_general(
@@ -593,7 +595,7 @@ def _flash_backward_streamed(q3, k3, v3, do3, lse3, delta, causal, sm_scale,
     tk = k3.shape[1]
     q_spec = pl.BlockSpec((None, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
     k_spec = pl.BlockSpec((None, block_k, d), lambda bh, qi, ki: (bh, ki, 0))
-    lane_q = pl.BlockSpec((None, block_q, _LANES), lambda bh, qi, ki: (bh, qi, 0))
+    lane_q = pl.BlockSpec((None, 1, tq), lambda bh, qi, ki: (bh, 0, 0))
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_streamed,
@@ -609,7 +611,7 @@ def _flash_backward_streamed(q3, k3, v3, do3, lse3, delta, causal, sm_scale,
 
     kq_spec = pl.BlockSpec((None, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
     kk_spec = pl.BlockSpec((None, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
-    klane_q = pl.BlockSpec((None, block_q, _LANES), lambda bh, ki, qi: (bh, qi, 0))
+    klane_q = pl.BlockSpec((None, 1, tq), lambda bh, ki, qi: (bh, 0, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_streamed,
@@ -643,9 +645,7 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
     k3 = k.reshape(b * h, tk, d)
     v3 = v.reshape(b * h, tk, d)
     do3 = dout.reshape(b * h, tq, d)
-    lse3 = jnp.broadcast_to(
-        lse.reshape(b * h, tq)[..., None], (b * h, tq, _LANES)
-    )
+    lse3 = lse.reshape(b * h, 1, tq)
 
     # the fused kernel needs whole-side VMEM residency (breaks past ~8k
     # tokens) and materializes an (nk, tq, d) dQ-partials HBM temporary —
@@ -655,11 +655,9 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
         _resident_ok(tk, d, k.dtype.itemsize)
         and _resident_ok(tq, d, q.dtype.itemsize)
     ):
-        delta = jnp.broadcast_to(
-            jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-            .reshape(b * h, tq)[..., None],
-            (b * h, tq, _LANES),
-        )
+        delta = jnp.sum(
+            dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+        ).reshape(b * h, 1, tq)
         dq, dk, dv = _flash_backward_streamed(
             q3, k3, v3, do3, lse3, delta, causal, sm_scale,
             _auto_block(tq, raw_bq or _DEF_STREAM_BLOCK),
@@ -672,10 +670,11 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
             dv.reshape(b, h, tk, d),
         )
 
-    if tq >= 4096:
+    if max(tq, tk) >= 4096:
         # the fused kernel's f32 score/probability temporaries at
-        # block_q=1024 overflow VMEM once the resident q/do/o slabs reach
-        # t=4096 (compile-checked on chip); 512 holds through t=8192
+        # block_q=1024 overflow VMEM once the resident slabs (q/do/o with
+        # tq, K/V with tk) reach t=4096 (compile-checked on chip); 512
+        # holds through t=8192
         block_q = min(block_q, 512)
     nk = tk // block_k
     dk, dv, dqp = pl.pallas_call(
@@ -693,7 +692,7 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((None, tq, d), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((None, tq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, tq, _LANES), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, 1, tq), lambda bh, ki: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),

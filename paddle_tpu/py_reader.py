@@ -172,6 +172,11 @@ class PyReader:
             self._thread.join(timeout=5)
         self._started = False
         self._queue = None
+        # pushed-back batches are staged state too: a batch returned by the
+        # executor's mid-step-EOF pushback must not leak into the next
+        # epoch (or a new decorated dataset)
+        if getattr(self, "_pushed_back", None):
+            self._pushed_back.clear()
         self._thread = None
         self._stop = None
         self._eof_deferred = False
@@ -179,6 +184,9 @@ class PyReader:
     def next_batch(self):
         if not self._started:
             raise RuntimeError("PyReader not started")
+        pushed = getattr(self, "_pushed_back", None)
+        if pushed:
+            return pushed.popleft()
         item = self._queue.get()
         if isinstance(item, _FeederError):
             self._started = False
@@ -187,6 +195,17 @@ class PyReader:
             self._started = False
             raise EOFException("reader exhausted")
         return item
+
+    def push_back(self, batch):
+        """Return a consumed batch to the FRONT of the queue. Used by the
+        executor's multi-reader step assembly: when a sibling reader hits
+        EOF mid-step, batches already pulled from the other readers for
+        that incomplete step are pushed back rather than dropped."""
+        import collections
+
+        if not hasattr(self, "_pushed_back"):
+            self._pushed_back = collections.deque()
+        self._pushed_back.appendleft(batch)
 
     def __call__(self):  # iterate batches
         try:

@@ -58,7 +58,12 @@ _TRAIN_ISLANDS = frozenset(["lookup_table"])
 # their (recomputed) bodies into every consumer fusion, which measured
 # SLOWER than the island casts (round-4 audit: +0.6 ms on each of 17
 # per-layer dW+Adam fusions).
-_TRAIN_KEEP_BF16 = frozenset(["softmax_with_cross_entropy"])
+# exact-type member: lookup_table_grad stays bf16 (its explicit lowering
+# scatters in the cotangent dtype and reads the master table for shape
+# only) while the lookup_table FORWARD stays an island (it reads the f32
+# master rows directly — casting the whole table down per step to gather a
+# few rows would be pure waste)
+_TRAIN_KEEP_BF16 = frozenset(["softmax_with_cross_entropy", "lookup_table_grad"])
 
 
 def _role(op):
@@ -93,6 +98,8 @@ class Bf16Transpiler:
     # -- shared -----------------------------------------------------------
 
     def _is_island(self, op_type, extra=frozenset(), keep=frozenset()):
+        if op_type in keep:  # exact-type keeps override the base-name rule
+            return False
         base = op_type[:-5] if op_type.endswith("_grad") else op_type
         return base not in keep and (base in self.blacklist or base in extra)
 

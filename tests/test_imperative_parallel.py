@@ -66,10 +66,12 @@ def _train(steps=6, parallel=False, seed=3):
 def test_parallel_env_reports_mesh():
     n = len(jax.devices())
     env = imperative.ParallelEnv()
-    assert env.nranks == n
+    assert env.nranks == jax.process_count()
     assert env.local_rank == jax.process_index()
+    assert env.data_parallel_degree == n
+    assert env.local_device_count == n
     strategy = imperative.prepare_context()
-    assert strategy.nranks == n
+    assert strategy.nranks == jax.process_count()
 
 
 def test_dataparallel_matches_single_device():

@@ -139,3 +139,42 @@ def test_pyreader_compact_wire_bf16():
         (want,) = exe.run(main, feed={"x": x}, fetch_list=[loss.name])
     # bf16 quantization of the input is the only difference
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=1e-3)
+
+
+def test_multireader_midstep_eof_pushback_and_group_eof():
+    """When one reader of a program's reader group ends mid-step during a
+    multi-step pull, sibling batches already pulled for the incomplete step
+    are pushed back (not dropped), and the NEXT pull raises EOF for the
+    whole group instead of proceeding with feeds missing the exhausted
+    reader's slots."""
+    import pytest
+
+    from paddle_tpu.executor import _pull_reader_steps, _started_readers
+    from paddle_tpu.py_reader import EOFException, PyReader
+
+    def make(name, n):
+        rd = PyReader([name], capacity=8, return_device_arrays=False)
+        rd.decorate_tensor_provider(
+            lambda n=n, name=name: (
+                {name: np.full((2, 3), i, "float32")} for i in range(n)
+            )
+        )
+        rd.start()
+        return rd
+
+    ra, rb = make("a", 5), make("b", 3)  # b exhausts first
+    feed, k = _pull_reader_steps([ra, rb], 2)
+    assert k == 2 and feed["a"].shape == (2, 2, 3)
+    # second pull: step 0 ok (a=2,b=2); step 1: a=3 pulled, then b EOFs ->
+    # a's batch 3 must be pushed back, k=1 tail returned, EOF deferred
+    feed, k = _pull_reader_steps([ra, rb], 2)
+    assert k == 1
+    assert float(np.asarray(feed["a"])[0, 0, 0]) == 2.0
+
+    class P:  # program stub carrying the reader group
+        _py_readers = [ra, rb]
+
+    with pytest.raises(EOFException):
+        _started_readers(P())
+    # the pushed-back batch survives for the next epoch's consumer
+    assert float(np.asarray(ra.next_batch()["a"])[0, 0]) == 3.0

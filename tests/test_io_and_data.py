@@ -302,3 +302,52 @@ def test_dlpack_interop_with_torch():
     # TPU-resident (or any non-DLPack-device) values stage via host
     t2 = torch.utils.dlpack.from_dlpack(to_dlpack(np.float32([1, 2])))
     np.testing.assert_array_equal(t2.numpy(), [1, 2])
+
+
+def test_resaved_f32_var_not_downcast_by_stale_dtype_meta():
+    """A directory reused across runs must not resurrect an earlier run's
+    bf16 dtype record: run A saves var as bf16, run B (different writer)
+    re-saves the same var as f32 — the restore must be exact f32, not a
+    silent bf16 round-trip (the r04 advisor repro: 1.001 restored as 1.0).
+    Simulated by writing a legacy per-PID meta naming the var, as a
+    different-PID writer would have left behind."""
+    import json
+
+    from paddle_tpu.io import load_arrays, save_arrays
+
+    with tempfile.TemporaryDirectory() as d:
+        # run A: var saved as bf16 (sidecar + a legacy meta another writer
+        # could have left)
+        import jax.numpy as jnp
+
+        save_arrays(d, {"w": jnp.asarray([1.0009765625], jnp.bfloat16)})
+        with open(os.path.join(d, "__dtypes__.12345.json"), "w") as f:
+            json.dump({"w": "bfloat16"}, f)
+        # run B: same var re-saved as f32
+        val = np.asarray([1.001], "float32")
+        save_arrays(d, {"w": val})
+        got = load_arrays(d)["w"]
+        assert np.asarray(got).dtype == np.float32
+        np.testing.assert_array_equal(np.asarray(got), val)
+
+
+def test_sidecar_dtype_round_trips_bf16():
+    """bf16 vars still restore as bf16 through the sidecar records, and a
+    legacy directory (meta only, no sidecar) stays readable."""
+    import json
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.io import load_arrays, save_arrays
+
+    with tempfile.TemporaryDirectory() as d:
+        save_arrays(d, {"a/b": jnp.asarray([2.5, 3.5], jnp.bfloat16)})
+        assert os.path.exists(os.path.join(d, "a", "b.npy.dtype"))
+        got = load_arrays(d)["a/b"]
+        assert "bfloat16" in str(np.asarray(got).dtype) or got.dtype == jnp.bfloat16
+    with tempfile.TemporaryDirectory() as d:
+        np.save(os.path.join(d, "w.npy"), np.asarray([1.5], "float32"))
+        with open(os.path.join(d, "__dtypes__.json"), "w") as f:
+            json.dump({"w": "bfloat16"}, f)
+        got = load_arrays(d)["w"]
+        assert got.dtype == jnp.bfloat16

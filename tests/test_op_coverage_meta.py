@@ -151,3 +151,53 @@ def test_every_lowered_op_is_directly_tested_or_waived():
         assert os.path.exists(os.path.join(HERE, test_file)), (
             "waiver for %r points at missing file %s" % (op, test_file)
         )
+
+
+def test_tpu_tolerance_policy_bites_and_classifies():
+    """The TPU-lane tolerance policy must (a) classify lowerings correctly
+    from their traced jaxpr — matmul crosses the MXU, elementwise does not —
+    and (b) actually BITE: a deliberately-wrong elementwise reference at an
+    error a blanket 1000x scale would have absorbed must FAIL check_output
+    under the non-MXU bar (VERDICT r04 item 4's sanity criterion)."""
+    import pytest
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import op_test as ot
+
+    class _Exp(ot.OpTest):
+        def runTest(self):  # pragma: no cover - built manually
+            pass
+
+        def setUp(self, wrong=0.0):
+            self.op_type = "exp"
+            x = np.random.uniform(0.1, 1, (4, 8)).astype("float32")
+            self.inputs = {"X": x}
+            self.outputs = {"Out": np.exp(x) + wrong}
+
+    class _Mul(ot.OpTest):
+        def runTest(self):  # pragma: no cover - built manually
+            pass
+
+        def setUp(self):
+            self.op_type = "mul"
+            x = np.random.uniform(-1, 1, (4, 6)).astype("float32")
+            y = np.random.uniform(-1, 1, (6, 5)).astype("float32")
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": x @ y}
+
+    ot.OpTest.setUpClass()
+    exp = _Exp(); exp.setUp()
+    mul = _Mul(); mul.setUp()
+    assert not exp._crosses_mxu(exp._build()[0]), "exp misclassified as MXU"
+    assert mul._crosses_mxu(mul._build()[0]), "mul misclassified as non-MXU"
+
+    orig = ot._TOL_SCALE
+    ot._TOL_SCALE = 1000.0
+    try:
+        exp.setUp()
+        exp.check_output(atol=1e-3, rtol=1e-3)  # honest reference passes
+        exp.setUp(wrong=5e-3)  # inside the old vacuous atol=1.0, outside 1e-3
+        with pytest.raises(AssertionError):
+            exp.check_output(atol=1e-3, rtol=1e-3)
+    finally:
+        ot._TOL_SCALE = orig
