@@ -91,7 +91,7 @@ def _attention_reference(q, k, v, causal, sm_scale):
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k, causal,
-                  sm_scale, q_block_idx_axis, t_q_total):
+                  sm_scale, q_block_idx_axis, t_q_total, lse_packed=True):
     """One (batch*head, q_block) program: stream KV blocks with the online
     softmax recurrence (m = running max, l = running sum, acc = running PV)."""
     qi = pl.program_id(q_block_idx_axis)
@@ -160,12 +160,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k, causal,
         # vector is a cheap in-register transpose). Fully-masked rows get
         # a finite sentinel; their p = exp(-inf - lse) is 0 either way.
         lse = jnp.where(m == -jnp.inf, 0.0, m + jnp.log(jnp.maximum(l, 1e-20)))
-        lse_ref[0, pl.ds(qi * block_q, block_q)] = lse.astype(lse_ref.dtype)
+        if lse_packed:
+            lse_ref[0, pl.ds(qi * block_q, block_q)] = lse.astype(lse_ref.dtype)
+        else:
+            # sub-128-lane t: Mosaic cannot vector-store partial lanes, so
+            # tiny shapes keep the 128-lane broadcast residual layout
+            lse_ref[...] = jnp.broadcast_to(
+                lse[:, None], lse_ref.shape
+            ).astype(lse_ref.dtype)
 
 
 def _flash_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
                            m_ref, l_ref, *, causal, sm_scale, t_q_total,
-                           t_k_total, with_lse):
+                           t_k_total, with_lse, lse_packed=True):
     """Long-context forward: grid (bh, q_blocks, k_blocks) with K/V streamed
     through the innermost grid dim, so VMEM holds one (block_q, d) query tile
     plus one (block_k, d) K/V tile regardless of t — the whole-KV-resident
@@ -230,7 +237,14 @@ def _flash_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
         )
         if with_lse:
             lse = jnp.where(m == -jnp.inf, 0.0, m + jnp.log(jnp.maximum(l, 1e-20)))
-            lse_ref[0, pl.ds(qi * block_q, block_q)] = lse.astype(lse_ref.dtype)
+            if lse_packed:
+                lse_ref[0, pl.ds(qi * block_q, block_q)] = lse.astype(
+                    lse_ref.dtype
+                )
+            else:  # sub-128-lane t_q: see _flash_kernel's note
+                lse_ref[...] = jnp.broadcast_to(
+                    lse[:, None], lse_ref.shape
+                ).astype(lse_ref.dtype)
 
 
 def _flash_forward_streamed(q3, k3, v3, causal, sm_scale, block_q, block_k,
@@ -238,13 +252,24 @@ def _flash_forward_streamed(q3, k3, v3, causal, sm_scale, block_q, block_k,
     bh, tq, d = q3.shape
     tk = k3.shape[1]
     grid = (bh, tq // block_q, tk // block_k)
+    packed = tq % _LANES == 0
     out_shapes = [jax.ShapeDtypeStruct((bh, tq, d), out_dtype)]
     out_specs = [pl.BlockSpec((None, block_q, d), lambda bh, qi, ki: (bh, qi, 0))]
     if with_lse:
-        out_shapes.append(jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32))
-        out_specs.append(
-            pl.BlockSpec((None, 1, tq), lambda bh, qi, ki: (bh, 0, 0))
-        )
+        if packed:
+            out_shapes.append(jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32))
+            out_specs.append(
+                pl.BlockSpec((None, 1, tq), lambda bh, qi, ki: (bh, 0, 0))
+            )
+        else:
+            out_shapes.append(
+                jax.ShapeDtypeStruct((bh, tq, _LANES), jnp.float32)
+            )
+            out_specs.append(
+                pl.BlockSpec(
+                    (None, block_q, _LANES), lambda bh, qi, ki: (bh, qi, 0)
+                )
+            )
     kernel = functools.partial(
         _flash_kernel_streamed,
         causal=causal,
@@ -252,6 +277,7 @@ def _flash_forward_streamed(q3, k3, v3, causal, sm_scale, block_q, block_k,
         t_q_total=tq,
         t_k_total=tk,
         with_lse=with_lse,
+        lse_packed=packed,
     )
     if not with_lse:
         kernel = functools.partial(_no_lse_adapter, kernel)
@@ -333,6 +359,8 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         )
         if with_lse:
             out, lse = res
+            if tq % _LANES:
+                lse = lse[..., 0]
             return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
         return res.reshape(b, h, tq, d)
     if max(tq, tk) >= 4096:
@@ -343,15 +371,20 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         # including asymmetric tq=1024/tk=4096); 512 holds through 8192
         block_q = min(block_q, 512)
     grid = (b * h, tq // block_q)
+    packed = tq % _LANES == 0  # see _flash_kernel's sub-128-lane note
     out_shapes = [jax.ShapeDtypeStruct((b * h, tq, d), q.dtype)]
     out_specs = [pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0))]
     if with_lse:
-        out_shapes.append(
-            jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32)
-        )
-        out_specs.append(
-            pl.BlockSpec((None, 1, tq), lambda bh, qi: (bh, 0, 0))
-        )
+        if packed:
+            out_shapes.append(jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32))
+            out_specs.append(pl.BlockSpec((None, 1, tq), lambda bh, qi: (bh, 0, 0)))
+        else:
+            out_shapes.append(
+                jax.ShapeDtypeStruct((b * h, tq, _LANES), jnp.float32)
+            )
+            out_specs.append(
+                pl.BlockSpec((None, block_q, _LANES), lambda bh, qi: (bh, qi, 0))
+            )
     res = pl.pallas_call(
         functools.partial(
             _flash_kernel,
@@ -360,6 +393,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
             sm_scale=sm_scale,
             q_block_idx_axis=1,
             t_q_total=tq,
+            lse_packed=packed,
         ),
         grid=grid,
         in_specs=[
@@ -373,6 +407,8 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
     )(q3, k3, v3)
     if with_lse:
         out, lse = res
+        if not packed:
+            lse = lse[..., 0]
         return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
     return res.reshape(b, h, tq, d)
 
@@ -388,7 +424,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
 
 def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                             dk_ref, dv_ref, dqp_ref, *, block_q, causal,
-                            sm_scale, t_q_total):
+                            sm_scale, t_q_total, lse_packed=True):
     """Fused resident backward: one (bh, k_block) program computes dK and dV
     for its K block AND this K block's partial contribution to every dQ row
     (summed over k blocks by XLA outside). The two-kernel form recomputes the
@@ -411,7 +447,10 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dk, dv = carry
         q_blk = q_ref[pl.ds(qi * block_q, block_q), :]
         do_blk = do_ref[pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
+        if lse_packed:
+            lse = lse_ref[0, pl.ds(qi * block_q, block_q)].astype(jnp.float32)
+        else:
+            lse = lse_ref[pl.ds(qi * block_q, block_q), 0].astype(jnp.float32)
         # delta = rowsum(dO * O) computed here from the saved forward output
         # rather than as an XLA prologue: the prologue form writes + re-reads
         # a 128-lane-broadcast f32 tensor per layer (~134 MB of HBM traffic)
@@ -470,7 +509,7 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
 def _flash_bwd_dq_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            dq_ref, dq_acc, *, causal, sm_scale, t_q_total,
-                           t_k_total):
+                           t_k_total, lse_packed=True):
     """Streamed dQ: grid (bh, q_blocks, k_blocks); K/V tiles ride the inner
     grid dim, dQ accumulates in f32 scratch and lands on the last k step."""
     qi = pl.program_id(1)
@@ -494,8 +533,14 @@ def _flash_bwd_dq_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         block_q_ = q_ref.shape[0]
         q = q_ref[...]
         do = do_ref[...]
-        lse = lse_ref[0, pl.ds(qi * block_q_, block_q_)].astype(jnp.float32)
-        delta = delta_ref[0, pl.ds(qi * block_q_, block_q_)].astype(jnp.float32)
+        if lse_packed:
+            lse = lse_ref[0, pl.ds(qi * block_q_, block_q_)].astype(jnp.float32)
+            delta = delta_ref[0, pl.ds(qi * block_q_, block_q_)].astype(
+                jnp.float32
+            )
+        else:  # per-q-block 128-lane broadcast layout (sub-128-lane t_q)
+            lse = lse_ref[..., 0].astype(jnp.float32)
+            delta = delta_ref[..., 0].astype(jnp.float32)
         k_blk = k_ref[...]
         v_blk = v_ref[...]
         s = jax.lax.dot_general(
@@ -526,7 +571,7 @@ def _flash_bwd_dq_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                             dk_ref, dv_ref, dk_acc, dv_acc, *, causal,
-                            sm_scale, t_q_total, t_k_total):
+                            sm_scale, t_q_total, t_k_total, lse_packed=True):
     """Streamed dK/dV: grid (bh, k_blocks, q_blocks); Q/dO/lse/delta tiles
     ride the inner grid dim, dK/dV accumulate in f32 scratch."""
     ki = pl.program_id(1)
@@ -552,8 +597,14 @@ def _flash_bwd_dkv_streamed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         block_q_ = q_ref.shape[0]
         q_blk = q_ref[...]
         do_blk = do_ref[...]
-        lse = lse_ref[0, pl.ds(qi * block_q_, block_q_)].astype(jnp.float32)
-        delta = delta_ref[0, pl.ds(qi * block_q_, block_q_)].astype(jnp.float32)
+        if lse_packed:
+            lse = lse_ref[0, pl.ds(qi * block_q_, block_q_)].astype(jnp.float32)
+            delta = delta_ref[0, pl.ds(qi * block_q_, block_q_)].astype(
+                jnp.float32
+            )
+        else:
+            lse = lse_ref[..., 0].astype(jnp.float32)
+            delta = delta_ref[..., 0].astype(jnp.float32)
         k_blk = k_ref[...]
         v_blk = v_ref[...]
         s = jax.lax.dot_general(
@@ -593,13 +644,19 @@ def _flash_backward_streamed(q3, k3, v3, do3, lse3, delta, causal, sm_scale,
                              block_q, block_k, interpret, out_dtypes):
     bh, tq, d = q3.shape
     tk = k3.shape[1]
+    packed = tq % _LANES == 0  # lse3/delta arrive in the matching layout
     q_spec = pl.BlockSpec((None, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
     k_spec = pl.BlockSpec((None, block_k, d), lambda bh, qi, ki: (bh, ki, 0))
-    lane_q = pl.BlockSpec((None, 1, tq), lambda bh, qi, ki: (bh, 0, 0))
+    lane_q = (
+        pl.BlockSpec((None, 1, tq), lambda bh, qi, ki: (bh, 0, 0))
+        if packed
+        else pl.BlockSpec((None, block_q, _LANES), lambda bh, qi, ki: (bh, qi, 0))
+    )
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_streamed,
             causal=causal, sm_scale=sm_scale, t_q_total=tq, t_k_total=tk,
+            lse_packed=packed,
         ),
         grid=(bh, tq // block_q, tk // block_k),
         in_specs=[q_spec, k_spec, k_spec, q_spec, lane_q, lane_q],
@@ -611,11 +668,16 @@ def _flash_backward_streamed(q3, k3, v3, do3, lse3, delta, causal, sm_scale,
 
     kq_spec = pl.BlockSpec((None, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
     kk_spec = pl.BlockSpec((None, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
-    klane_q = pl.BlockSpec((None, 1, tq), lambda bh, ki, qi: (bh, 0, 0))
+    klane_q = (
+        pl.BlockSpec((None, 1, tq), lambda bh, ki, qi: (bh, 0, 0))
+        if packed
+        else pl.BlockSpec((None, block_q, _LANES), lambda bh, ki, qi: (bh, qi, 0))
+    )
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_streamed,
             causal=causal, sm_scale=sm_scale, t_q_total=tq, t_k_total=tk,
+            lse_packed=packed,
         ),
         grid=(bh, tk // block_k, tq // block_q),
         in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, klane_q, klane_q],
@@ -645,7 +707,13 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
     k3 = k.reshape(b * h, tk, d)
     v3 = v.reshape(b * h, tk, d)
     do3 = dout.reshape(b * h, tq, d)
-    lse3 = lse.reshape(b * h, 1, tq)
+    packed = tq % _LANES == 0  # matches the forward's residual layout rule
+    if packed:
+        lse3 = lse.reshape(b * h, 1, tq)
+    else:
+        lse3 = jnp.broadcast_to(
+            lse.reshape(b * h, tq)[..., None], (b * h, tq, _LANES)
+        )
 
     # the fused kernel needs whole-side VMEM residency (breaks past ~8k
     # tokens) and materializes an (nk, tq, d) dQ-partials HBM temporary —
@@ -657,7 +725,13 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
     ):
         delta = jnp.sum(
             dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-        ).reshape(b * h, 1, tq)
+        )
+        if packed:
+            delta = delta.reshape(b * h, 1, tq)
+        else:  # must mirror lse3's layout — the kernels' specs follow it
+            delta = jnp.broadcast_to(
+                delta.reshape(b * h, tq)[..., None], (b * h, tq, _LANES)
+            )
         dq, dk, dv = _flash_backward_streamed(
             q3, k3, v3, do3, lse3, delta, causal, sm_scale,
             _auto_block(tq, raw_bq or _DEF_STREAM_BLOCK),
@@ -684,6 +758,7 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
             causal=causal,
             sm_scale=sm_scale,
             t_q_total=tq,
+            lse_packed=packed,
         ),
         grid=(b * h, nk),
         in_specs=[
@@ -692,7 +767,11 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((None, tq, d), lambda bh, ki: (bh, 0, 0)),
             pl.BlockSpec((None, tq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((None, 1, tq), lambda bh, ki: (bh, 0, 0)),
+            (
+                pl.BlockSpec((None, 1, tq), lambda bh, ki: (bh, 0, 0))
+                if packed
+                else pl.BlockSpec((None, tq, _LANES), lambda bh, ki: (bh, 0, 0))
+            ),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda bh, ki: (bh, ki, 0)),

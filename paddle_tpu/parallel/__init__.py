@@ -14,10 +14,13 @@ here onto jax.sharding over a device Mesh:
 
 from .mesh import MeshConfig, make_mesh
 from .multihost import init_distributed
+from .pipeline import gpipe, gpipe_spmd
 from .ring_attention import ring_attention
 from . import collectives
 
 __all__ = [
+    "gpipe",
+    "gpipe_spmd",
     "MeshConfig",
     "make_mesh",
     "init_distributed",

@@ -1,6 +1,8 @@
 """Flash-attention Pallas kernel vs the dense XLA reference (the OpTest
 numerics contract for the hand-tuned kernel tier, SURVEY.md §7.9)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -8,6 +10,15 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.ops.pallas_kernels import _attention_reference, flash_attention
+
+# On the real chip (scripts/optest_tpu.py lane) the f32-input comparisons
+# against the numpy/dense reference need the MXU-noise bar: default-precision
+# f32 dots execute as fast bf16 passes (~2^-9 relative per product, sqrt(K)
+# absolute cancellation noise) — the same policy as op_test.py's
+# MXU-crossing tolerance scale. CPU interpret mode keeps the tight bar.
+_ON_TPU = os.environ.get("PADDLE_OPTEST_PLACE", "cpu").lower() == "tpu"
+_RTOL = 2e-2 if _ON_TPU else 2e-4
+_ATOL = 2e-2 if _ON_TPU else 2e-5
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -19,7 +30,7 @@ def test_flash_matches_dense(causal):
     v = jnp.asarray(rng.randn(b, h, t, d).astype("float32"))
     out = flash_attention(q, k, v, causal, None, 128, 128)
     ref = _attention_reference(q, k, v, causal, d**-0.5)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=_RTOL, atol=_ATOL)
 
 
 def test_flash_grads_match_dense():
@@ -38,7 +49,7 @@ def test_flash_grads_match_dense():
     g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for gf, gd in zip(g_flash, g_dense):
-        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd), rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd), rtol=_RTOL, atol=_ATOL)
 
 
 def test_ragged_tail_falls_back():
@@ -46,7 +57,7 @@ def test_ragged_tail_falls_back():
     q = jnp.asarray(rng.randn(1, 1, 100, 16).astype("float32"))  # 100 % 128 != 0
     out = flash_attention(q, q, q, False)
     ref = _attention_reference(q, q, q, False, 16**-0.5)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=_RTOL, atol=_ATOL)
 
 
 def test_flash_attention_graph_op():
@@ -77,7 +88,7 @@ def test_flash_attention_graph_op():
     ref = _attention_reference(
         jnp.asarray(qkv[0]), jnp.asarray(qkv[1]), jnp.asarray(qkv[2]), True, 16**-0.5
     )
-    np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=_RTOL, atol=_ATOL)
 
 
 def test_multi_head_attention_flash_path_trains():
@@ -239,4 +250,6 @@ def test_lse_declaration_mirrors_lowering_decision():
         jnp.asarray(feed["fq"]), jnp.asarray(feed["fk"]), jnp.asarray(feed["fv"]),
         False, 8 ** -0.5,
     )
-    np.testing.assert_allclose(got, np.asarray(want), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(
+        got, np.asarray(want), rtol=max(_RTOL, 2e-3), atol=max(_ATOL, 2e-3)
+    )
