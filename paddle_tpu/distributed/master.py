@@ -22,8 +22,12 @@ import os
 import socket
 import threading
 import time
+import warnings
 
 from .. import native
+from ..resilience import faults as _faults
+from ..resilience import health as _health
+from ..resilience.retry import DeadlineExceeded, RetryPolicy
 
 __all__ = ["Master", "MasterClient"]
 
@@ -69,8 +73,7 @@ class Master:
         self._closed = False
         self._recovered = False
         if snapshot_path and os.path.exists(snapshot_path):
-            self._recover()
-            self._recovered = True
+            self._recovered = self._recover()
 
     # ------------------------------ dataset -------------------------------
 
@@ -106,18 +109,41 @@ class Master:
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(state, f)
+        # crash point between write and rename (resilience fault injection):
+        # a master dying here leaves only the .tmp — the committed snapshot
+        # is still whole, which is what _recover depends on
+        _faults.crash("snapshot_crash", self.snapshot_path)
         os.replace(tmp, self.snapshot_path)  # atomic, like etcd txn
 
     def _recover(self):
-        with open(self.snapshot_path) as f:
-            state = json.load(f)
-        self._next_id = state["next_id"]
-        for spec in state["todo"]:
-            t = _Task(spec["id"], spec["path"], spec["begin"], spec["end"])
-            t.failures = spec.get("failures", 0)
-            self.todo.append(t)
-        self.done = state["done"]
-        self.discarded = state["discarded"]
+        """Rebuild state from the snapshot. A corrupt/truncated snapshot
+        (torn disk, a crash that outran the atomic rename discipline of an
+        older layout) must not kill the master: start fresh with a warning —
+        re-partitioning the dataset re-trains some shards, losing the whole
+        job loses all of them. Returns True iff state was recovered."""
+        try:
+            with open(self.snapshot_path) as f:
+                state = json.load(f)
+            next_id = state["next_id"]
+            todo = []
+            for spec in state["todo"]:
+                t = _Task(spec["id"], spec["path"], spec["begin"], spec["end"])
+                t.failures = spec.get("failures", 0)
+                todo.append(t)
+            done = state["done"]
+            discarded = state["discarded"]
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            _health.incr("master_snapshot_corrupt")
+            warnings.warn(
+                "master snapshot %s unreadable (%r); starting fresh"
+                % (self.snapshot_path, e)
+            )
+            return False
+        self._next_id = next_id
+        self.todo = todo
+        self.done = done
+        self.discarded = discarded
+        return True
 
     # ----------------------------- scheduling -----------------------------
 
@@ -193,6 +219,12 @@ class Master:
             f = conn.makefile("rw")
             for line in f:
                 resp = self._handle(json.loads(line))
+                if _faults.fires("master_conn_drop"):
+                    # injected worker-facing failure: the request WAS handled
+                    # but the reply is lost (the realistic half-failure — a
+                    # dropped get_task reply leaves the task pending until
+                    # the timeout re-queues it); client reconnect-retries
+                    return
                 f.write(json.dumps(resp) + "\n")
                 f.flush()
         except (OSError, ValueError):
@@ -209,19 +241,76 @@ class Master:
 
 
 class MasterClient:
-    """Trainer-side client (reference python/paddle/v2/master/client.py)."""
+    """Trainer-side client (reference python/paddle/v2/master/client.py).
 
-    def __init__(self, endpoint, timeout=60.0):
+    Calls run under the unified RetryPolicy with reconnect: a master restart
+    or a dropped connection is retried with backoff instead of killing the
+    trainer. `op_timeout` bounds each connect/read (a HUNG master surfaces
+    as a typed DeadlineExceeded), while `timeout` is the OVERALL retry
+    budget per call — the two deadlines are deliberately distinct.
+
+    Retry safety: every master op is either read-only (stats), idempotent
+    (task_finished/task_failed re-apply as no-ops once the task left
+    pending), or self-healing (a get_task whose reply is lost re-queues via
+    the task timeout) — so blanket retry is correct here, unlike the RPC
+    variable-send path."""
+
+    def __init__(self, endpoint, timeout=60.0, op_timeout=10.0, max_attempts=5):
         host, _, port = endpoint.rpartition(":")
-        self._conn = socket.create_connection((host, int(port)), timeout=timeout)
-        self._f = self._conn.makefile("rw")
+        self._addr = (host, int(port))
+        self._op_timeout = op_timeout
+        self._conn = None
+        self._f = None
         self._lock = threading.Lock()
+        self._retry = RetryPolicy(
+            max_attempts=max_attempts,
+            base_delay=0.05,
+            max_delay=1.0,
+            deadline=timeout,
+        )
+        self._connect()  # fail fast on a wrong endpoint, like before
+
+    def _connect(self):
+        self._conn = socket.create_connection(self._addr, timeout=self._op_timeout)
+        self._f = self._conn.makefile("rw")
+
+    def _drop_conn(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+        self._conn = None
+        self._f = None
 
     def _call(self, req):
-        with self._lock:
-            self._f.write(json.dumps(req) + "\n")
-            self._f.flush()
-            return json.loads(self._f.readline())
+        line = json.dumps(req) + "\n"
+
+        def attempt():
+            with self._lock:
+                if self._f is None:
+                    self._connect()
+                try:
+                    self._f.write(line)
+                    self._f.flush()
+                    resp = self._f.readline()
+                except socket.timeout as e:
+                    self._drop_conn()
+                    raise DeadlineExceeded(
+                        "master %s:%d: no reply within %.1fs"
+                        % (self._addr + (self._op_timeout,))
+                    ) from e
+                except OSError:
+                    self._drop_conn()
+                    raise
+                if not resp:  # EOF: master closed/dropped the connection
+                    self._drop_conn()
+                    raise ConnectionError("master closed connection")
+                return json.loads(resp)
+
+        return self._retry.call(
+            attempt, on_retry=lambda _a, _e: _health.incr("master_retries")
+        )
 
     def get_task(self, wait_s=0.2):
         """Blocks until a task is available; returns None when the dataset is
@@ -244,7 +333,5 @@ class MasterClient:
         return self._call({"op": "stats"})
 
     def close(self):
-        try:
-            self._conn.close()
-        except OSError:
-            pass
+        with self._lock:
+            self._drop_conn()

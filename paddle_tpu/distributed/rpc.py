@@ -29,6 +29,10 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..resilience import faults as _faults
+from ..resilience import health as _health
+from ..resilience.retry import DeadlineExceeded, FatalError, RetryPolicy
+
 __all__ = ["RPCClient", "RPCServer", "serialize_var", "read_frame"]
 
 SEND_VAR = 1
@@ -94,6 +98,22 @@ def read_frame(sock):
     return kind, trainer_id, name, arr
 
 
+class NonIdempotentError(FatalError):
+    """A mutating frame failed after its bytes may have reached the server:
+    resending could double-apply a gradient or double-count a barrier.
+    Subclassed below with the concrete failure type mixed in, so callers
+    keep catching ConnectionError/TimeoutError while RetryPolicy (for which
+    FatalError is fatal) never resends."""
+
+
+class _NonIdempotentConnError(NonIdempotentError, ConnectionError):
+    pass
+
+
+class _NonIdempotentDeadline(NonIdempotentError, DeadlineExceeded):
+    pass
+
+
 class RPCClient:
     """One per trainer process (reference rpc_client.h singleton GetInstance).
     Maintains one persistent connection per endpoint; async ops run on a
@@ -155,48 +175,73 @@ class RPCClient:
         except OSError:
             pass
 
-    def _rpc(self, endpoint, frame, want_reply):
-        """One request/response, with reconnect-and-retry on connection
-        failure (reference grpc_client.cc FLAGS_max_retry + FLAGS_rpc_deadline:
-        a pserver restarting mid-training must not kill the trainer).
-
-        Retry policy respects idempotency: GET-style calls (want_reply) are
-        repeatable; mutating frames (SEND_VAR, barriers) are retried only
-        while the failure is at the CONNECT stage — once bytes may have
-        reached the server, a resend could double-apply a gradient or
-        double-count a barrier, so the error surfaces instead."""
+    def _retry_policy(self):
+        """Unified retry policy (resilience.retry): attempts from
+        FLAGS_rpc_max_retry, overall budget FLAGS_rpc_deadline (the reference
+        grpc_client.cc pair), exponential backoff + jitter between attempts."""
         from .. import flags as _flags
 
-        retries = int(_flags.get_flags("rpc_max_retry")["rpc_max_retry"])
-        last_err = None
-        for attempt in range(retries + 1):
-            try:
-                sock, lock = self._sock(endpoint)
-            except OSError as e:
-                last_err = e  # nothing sent: always safe to retry
-                if attempt < retries:
-                    time.sleep(min(0.2 * 2**attempt, 2.0))
-                continue
+        fl = _flags.get_flags(["rpc_max_retry", "rpc_deadline"])
+        return RetryPolicy(
+            max_attempts=int(fl["rpc_max_retry"]) + 1,
+            base_delay=0.05,
+            max_delay=2.0,
+            deadline=float(fl["rpc_deadline"]),
+        )
+
+    def _rpc(self, endpoint, frame, want_reply):
+        """One request/response under the unified RetryPolicy: reconnect and
+        retry on connection failure (a pserver restarting mid-training must
+        not kill the trainer).
+
+        Idempotency contract (unchanged from the hand-rolled loop this
+        replaces): GET-style calls (want_reply) are repeatable; mutating
+        frames (SEND_VAR, barriers) are retried only while the failure is at
+        the CONNECT stage — once bytes may have reached the server, a resend
+        could double-apply a gradient, so a fatal NonIdempotentError
+        surfaces instead. Within one attempt, FLAGS_rpc_op_deadline bounds
+        the reply wait so a HUNG peer becomes a typed DeadlineExceeded
+        rather than an indefinite block on _recv_exact."""
+        from .. import flags as _flags
+
+        op_deadline = float(_flags.get_flags("rpc_op_deadline")["rpc_op_deadline"])
+
+        def attempt():
+            # connect stage — nothing sent yet, every failure is retryable
+            # (OSError from _sock propagates as-is); injected faults land
+            # here too so they are survivable for every frame kind
+            sock, lock = self._sock(endpoint)
+            if _faults.fires("rpc_drop"):
+                self._drop_sock(endpoint, sock)
+                raise ConnectionResetError("injected rpc_drop to %s" % endpoint)
+            _faults.delay("rpc_delay")
             try:
                 with lock:
+                    sock.settimeout(op_deadline)
                     sock.sendall(frame)
+                    # GETs read the VAR_REPLY; sends read the ACK that keeps
+                    # them flow-controlled
+                    kind, _, _name, arr = read_frame(sock)
                     if want_reply:
-                        kind, _, name, arr = read_frame(sock)
                         return arr if kind == VAR_REPLY else None
-                    kind, *_ = read_frame(sock)  # ACK keeps sends flow-controlled
                     return None
+            except socket.timeout as e:
+                self._drop_sock(endpoint, sock)
+                msg = "rpc to %s: no reply within %.1fs" % (endpoint, op_deadline)
+                if not want_reply:
+                    raise _NonIdempotentDeadline(msg) from e
+                raise DeadlineExceeded(msg) from e
             except (OSError, EOFError) as e:
-                last_err = e
                 self._drop_sock(endpoint, sock)
                 if not want_reply:
-                    raise ConnectionError(
+                    raise _NonIdempotentConnError(
                         "rpc to %s failed after send may have been delivered "
                         "(not retried: non-idempotent): %r" % (endpoint, e)
-                    )
-                if attempt < retries:
-                    time.sleep(min(0.2 * 2**attempt, 2.0))
-        raise ConnectionError(
-            "rpc to %s failed after %d retries: %r" % (endpoint, retries, last_err)
+                    ) from e
+                raise
+
+        return self._retry_policy().call(
+            attempt, on_retry=lambda _a, _e: _health.incr("rpc_retries")
         )
 
     # --- async API (reference rpc_client.h:36-79) ---

@@ -517,6 +517,49 @@ def _stack_feed_steps(feed_list):
     return out
 
 
+def _all_finite(values):
+    """True iff every floating array in `values` is fully finite. One stacked
+    device reduce + a single host sync (same trick as FLAGS_check_nan_inf)."""
+    flags_ = [
+        jnp.isfinite(a).all()
+        for v in values
+        for a in (jnp.asarray(v),)
+        if jnp.issubdtype(a.dtype, jnp.floating)
+    ]
+    return (not flags_) or bool(jnp.stack(flags_).all())
+
+
+def _poison_nan(feed_arrays):
+    """`nan_grad` fault payload: overwrite the first floating feed with NaN,
+    which propagates through loss -> grads -> every updated parameter — the
+    realistic shape of a bad-numerics step. Returns (feed, poison_after);
+    poison_after=True means no float feed existed (int-only models), so the
+    caller poisons the updated state after the run instead."""
+    out = dict(feed_arrays)
+    for name in sorted(out):
+        a = out[name]
+        if not jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+            continue
+        if isinstance(a, jax.Array):
+            out[name] = jnp.full_like(a, jnp.nan)
+        else:
+            out[name] = np.full_like(np.asarray(a), np.nan)
+        return out, False
+    return out, True
+
+
+def _poison_scope_state(scope, mut_names):
+    """Fallback nan_grad payload: NaN the first floating mutated persistable
+    (post-run), so the guard still sees a poisoned step."""
+    for name in sorted(mut_names):
+        v = scope.vars.get(name)
+        if v is not None:
+            a = jnp.asarray(v)
+            if jnp.issubdtype(a.dtype, jnp.floating):
+                scope.vars[name] = jnp.full_like(a, jnp.nan)
+                return
+
+
 class _SegmentedBlock:
     """A block containing host ops (RPC send/recv, listen_and_serv — the
     reference's non-kernel OperatorBase ops), executed as alternating XLA
@@ -764,12 +807,45 @@ class Executor:
 
         from . import flags as _flags
 
+        # --- resilience: NaN injection + step guard (docs/resilience.md) ---
+        # only runs that mutate persistable state count as training steps;
+        # startup/eval programs pass through untouched
+        mut_names = getattr(compiled, "mut_names", ()) or ()
+        poison_after = False
+        guard_snapshot = None
+        if mut_names:
+            from .resilience import faults as _faults
+
+            if _faults.fires("nan_grad"):
+                feed_arrays, poison_after = _poison_nan(feed_arrays)
+            if _flags.get_flags("resilience_nan_guard")["resilience_nan_guard"]:
+                # host copies taken BEFORE the step: the donated in-place
+                # update invalidates the old device buffers, so these copies
+                # are the only way back when the step turns out poisoned
+                guard_snapshot = {
+                    n: np.asarray(scope.vars[n])
+                    for n in mut_names
+                    if scope.vars.get(n) is not None
+                }
+
         with _prof.RecordEvent("run/block0"):
             fetches = compiled(scope, feed_arrays)
             if _prof.is_profiling() or _flags.get_flags("benchmark")["benchmark"]:
                 # reference FLAGS_benchmark: wait so host timing is real step
                 # time (operator.cc:769 dev_ctx->Wait)
                 fetches = [jax.block_until_ready(f) for f in fetches]
+
+        nan_ok = False
+        if poison_after:
+            # integer-only feeds can't carry the injected NaN through the
+            # loss; poison the updated state directly instead
+            _poison_scope_state(scope, mut_names)
+        if guard_snapshot is not None:
+            watched = list(fetches) + [
+                scope.vars[n] for n in mut_names if scope.vars.get(n) is not None
+            ]
+            if not _all_finite(watched):
+                nan_ok = self._skip_nan_step(scope, guard_snapshot)
         # correlation seed for profiler.device_op_profile: the block + feed
         # AVALS of the latest run (abstract shapes only — storing the
         # concrete arrays would pin a whole batch of device memory), from
@@ -785,7 +861,9 @@ class Executor:
                     for n, a in feed_arrays.items()
                 },
             )
-        return self._finish_run(compiled, scope, fetch_names, fetches, return_numpy)
+        return self._finish_run(
+            compiled, scope, fetch_names, fetches, return_numpy, nan_ok=nan_ok
+        )
 
     def compiled_hlo(self):
         """Post-optimization HLO text of the most recently run compiled
@@ -810,12 +888,44 @@ class Executor:
         lowered = compiled.jitted.lower(feed_avals, ro, mut, scope.rng_key)
         return lowered.compile().as_text()
 
+    def _skip_nan_step(self, scope, snapshot):
+        """The NaN/Inf step guard tripped: roll the mutated persistables back
+        to their pre-step values, decay any loss-scale / learning-rate vars
+        (graceful degradation — repeated NaNs usually mean the scale or lr is
+        too hot), and count the event. The run then returns the poisoned
+        fetches to the caller, but the MODEL state is as if the step never
+        happened, so training continues."""
+        import jax.numpy as jnp
+
+        from . import flags as _flags
+        from .resilience import health as _health
+
+        for name, saved in snapshot.items():
+            scope.vars[name] = jnp.asarray(saved)
+        decay = float(
+            _flags.get_flags("resilience_lr_decay")["resilience_lr_decay"]
+        )
+        decayed = 0
+        for name, val in list(scope.vars.items()):
+            base = name.rsplit("/", 1)[-1]
+            if val is not None and (
+                base.startswith("learning_rate") or "loss_scaling" in base
+            ):
+                scope.vars[name] = jnp.asarray(val) * decay
+                decayed += 1
+        if decayed:
+            _health.incr("lr_decays", decayed)
+        _health.incr("nan_steps_skipped")
+        return True
+
     @staticmethod
-    def _finish_run(compiled, scope, fetch_names, fetches, return_numpy):
-        """Shared run tail: FLAGS_check_nan_inf scan + numpy conversion."""
+    def _finish_run(compiled, scope, fetch_names, fetches, return_numpy, nan_ok=False):
+        """Shared run tail: FLAGS_check_nan_inf scan + numpy conversion.
+        nan_ok: the resilience guard already handled this step's NaNs (state
+        rolled back) — don't let the check_nan_inf scan abort over them."""
         from . import flags as _flags
 
-        if _flags.get_flags("check_nan_inf")["check_nan_inf"]:
+        if not nan_ok and _flags.get_flags("check_nan_inf")["check_nan_inf"]:
             # reference FLAGS_check_nan_inf (operator.cc:778): finiteness
             # reduces ON DEVICE into one stacked scalar (a single host sync
             # per step); only when it trips does the per-var rescan run to

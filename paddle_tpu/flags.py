@@ -15,6 +15,17 @@ Honored flags:
   timing brackets real step time (reference operator.cc:769 FLAGS_benchmark).
 - rpc_max_retry / rpc_deadline: socket RPC reconnect-retry count and call
   timeout (reference grpc_client.cc FLAGS_max_retry / FLAGS_rpc_deadline).
+- rpc_op_deadline: per-operation connect/read deadline (seconds) inside one
+  RPC attempt — a hung peer surfaces as a typed resilience.DeadlineExceeded
+  instead of blocking forever; rpc_deadline remains the OVERALL retry budget.
+- resilience_nan_guard: executor skips a training step whose fetches/updated
+  state went NaN/Inf — restores the pre-step state, decays the loss scale /
+  learning rate by resilience_lr_decay, and counts the event in
+  resilience.health instead of crashing (docs/resilience.md).
+- resilience_lr_decay: multiplicative decay the NaN guard applies to
+  loss-scale / learning-rate vars on each skipped step.
+- dist_init_max_retry: retry attempts for the multi-host rendezvous
+  (parallel/multihost.py init_distributed) before surfacing the error.
 - profile_ops: while the profiler is on, run blocks op-by-op EAGERLY with a
   device sync per op, so the profiler table attributes time per op type —
   the reference's per-op RecordEvent tables (operator.cc:157). Slower and
@@ -37,6 +48,10 @@ _DEFAULTS = {
     "cpu_deterministic": False,
     "rpc_max_retry": 3,
     "rpc_deadline": 120.0,
+    "rpc_op_deadline": 30.0,
+    "resilience_nan_guard": False,
+    "resilience_lr_decay": 0.5,
+    "dist_init_max_retry": 3,
     "profile_ops": False,
 }
 

@@ -48,7 +48,14 @@ def save_arrays(dirname, arrays):
     Shared with the pserver checkpoint handler
     (distributed/listen_and_serv.py) so shard checkpoints are restorable by
     the normal loaders."""
+    from .resilience import faults as _faults
+
     os.makedirs(dirname, exist_ok=True)
+    # crash-point decision drawn ONCE per save call (so a fault plan's
+    # `ckpt_crash:step=N` counts whole checkpoints, not files); it fires
+    # below between the first tmp write and its rename — the torn state
+    # load_latest_valid must skip
+    crash_now = _faults.fires("ckpt_crash")
     for name, val in arrays.items():
         arr, orig_dtype = _bf16_safe_save(val)
         path = os.path.join(dirname, name + ".npy")
@@ -62,6 +69,10 @@ def save_arrays(dirname, arrays):
         tmp = "%s.tmp.%d" % (path, os.getpid())
         with open(tmp, "wb") as f:
             np.save(f, arr)
+        if crash_now:
+            # injected mid-commit death: the tmp exists, the rename never
+            # happens — exactly the window a real crash hits
+            raise _faults.InjectedFault("ckpt_crash during save of %r" % path)
         os.replace(tmp, path)
         # the dtype record travels WITH the array as a sidecar, so a later
         # run reusing the directory can never resurrect a stale record (a

@@ -37,9 +37,26 @@ def init_distributed(
         # single host — nothing to rendezvous
         _initialized = True
         return
-    jax.distributed.initialize(
+    # the rendezvous is the single most preemption-exposed moment of a
+    # multi-host job (the coordinator pod may come up seconds after the
+    # workers); retry under the unified policy instead of dying on the
+    # first connection refusal (FLAGS_dist_init_max_retry)
+    from .. import flags as _flags
+    from ..resilience import health as _health
+    from ..resilience.retry import RetryPolicy
+
+    attempts = int(_flags.get_flags("dist_init_max_retry")["dist_init_max_retry"]) + 1
+    policy = RetryPolicy(
+        max_attempts=attempts,
+        base_delay=0.5,
+        max_delay=5.0,
+        retryable=(RuntimeError, ConnectionError, OSError),
+    )
+    policy.call(
+        jax.distributed.initialize,
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        on_retry=lambda _a, _e: _health.incr("dist_init_retries"),
     )
     _initialized = True

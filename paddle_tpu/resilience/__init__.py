@@ -1,0 +1,49 @@
+"""Fault-tolerant training runtime.
+
+Reference analog: the reference system's resilience was spread across a Go
+master (go/master/service.go task re-queue + etcd snapshots), the gRPC layer
+(grpc_client.cc FLAGS_max_retry / FLAGS_rpc_deadline), and ad-hoc checkpoint
+save ops. Here it is one subsystem with four pieces:
+
+- faults:     deterministic, seeded fault injection (PADDLE_TPU_FAULTS env)
+              with hook points in rpc/master/io/executor — CI proves the
+              failure paths continuously instead of hoping.
+- retry:      one RetryPolicy (bounded attempts, exponential backoff +
+              jitter, overall deadline, typed retryable-vs-fatal errors)
+              shared by RPCClient, MasterClient and multihost init.
+- checkpoint: manifest-based crash-safe checkpoints (per-file checksums,
+              atomic MANIFEST.json commit last, keep-last-N GC,
+              load_latest_valid skips torn checkpoints) + resume_or_init.
+- health:     process-wide counters for degraded-but-alive events (skipped
+              NaN steps, rpc retries, requeued tasks) so "survived" is
+              observable, not silent.
+
+See docs/resilience.md for the fault spec syntax and the recipe for making
+a new subsystem injectable.
+"""
+
+from . import checkpoint, faults, health, retry  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    load_latest_valid,
+    resume_or_init,
+    save_checkpoint,
+    snapshot_persistables,
+)
+from .faults import FaultPlan, InjectedFault  # noqa: F401
+from .retry import DeadlineExceeded, FatalError, RetryPolicy  # noqa: F401
+
+__all__ = [
+    "FaultPlan",
+    "InjectedFault",
+    "RetryPolicy",
+    "DeadlineExceeded",
+    "FatalError",
+    "save_checkpoint",
+    "load_latest_valid",
+    "resume_or_init",
+    "snapshot_persistables",
+    "faults",
+    "retry",
+    "checkpoint",
+    "health",
+]

@@ -1,0 +1,194 @@
+"""Crash-safe, manifest-based checkpoints.
+
+Layout (one directory per checkpoint under a common root):
+
+    <root>/ckpt-00000012/
+        <var>.npy            tensor payloads (io.save_arrays layout, so a
+        <var>.npy.dtype      checkpoint is also readable by io.load_arrays)
+        MANIFEST.json        {"version": 1, "step": 12,
+                              "files": {"<rel>": {"sha256": ..., "size": ...}}}
+
+Commit discipline: tensor files land first (each one atomically via
+write-temp-then-rename, io.save_arrays), the MANIFEST is written atomically
+LAST. A crash at any point leaves either a previous complete checkpoint
+untouched, or a manifest-less / checksum-mismatched directory that
+load_latest_valid skips. This is the same ordering the reference's etcd
+master snapshot relied on (go/master/service.go:166-207: state blob committed
+in one txn), generalized to a directory of tensors.
+
+`resume_or_init` is the trainer-loop entry: run the startup program, then
+overlay the latest valid checkpoint if one exists.
+"""
+
+import hashlib
+import json
+import os
+import re
+import shutil
+import warnings
+
+import numpy as np
+
+from . import faults, health
+
+__all__ = [
+    "save_checkpoint",
+    "load_latest_valid",
+    "latest_valid_dir",
+    "resume_or_init",
+    "snapshot_persistables",
+    "verify_checkpoint",
+]
+
+MANIFEST = "MANIFEST.json"
+_CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+
+
+def _sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _list_checkpoints(root):
+    """[(step, dirpath)] newest first."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def save_checkpoint(root, arrays, step, keep_last=3):
+    """Write `arrays` (name -> array) as checkpoint `step` under `root`;
+    returns the checkpoint directory. Old checkpoints beyond the newest
+    `keep_last` are deleted AFTER the new manifest commits, so GC can never
+    leave fewer than one valid checkpoint behind."""
+    ckpt_dir = os.path.join(root, "ckpt-%08d" % step)
+    if os.path.isdir(ckpt_dir):
+        # a previous crashed/duplicate attempt at this step: rewrite cleanly
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    from .. import io as fluid_io
+
+    fluid_io.save_arrays(ckpt_dir, arrays)  # carries the ckpt_crash hook
+    files = {}
+    for dirpath, _dirs, fnames in os.walk(ckpt_dir):
+        for fname in sorted(fnames):
+            if fname == MANIFEST or ".tmp." in fname:
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, ckpt_dir)
+            files[rel] = {"sha256": _sha256(path), "size": os.path.getsize(path)}
+    faults.crash("manifest_crash", ckpt_dir)
+    manifest = {"version": 1, "step": int(step), "files": files}
+    tmp = os.path.join(ckpt_dir, "%s.tmp.%d" % (MANIFEST, os.getpid()))
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(ckpt_dir, MANIFEST))
+    if keep_last and keep_last > 0:
+        for _s, old in _list_checkpoints(root)[keep_last:]:
+            shutil.rmtree(old, ignore_errors=True)
+    return ckpt_dir
+
+
+def verify_checkpoint(ckpt_dir):
+    """True iff the manifest exists and every listed file matches its
+    recorded size + sha256 (torn/partial checkpoints fail here)."""
+    try:
+        with open(os.path.join(ckpt_dir, MANIFEST)) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (OSError, ValueError, KeyError):
+        return False
+    for rel, meta in files.items():
+        path = os.path.join(ckpt_dir, rel)
+        try:
+            if os.path.getsize(path) != meta["size"]:
+                return False
+            if _sha256(path) != meta["sha256"]:
+                return False
+        except (OSError, KeyError):
+            return False
+    return True
+
+
+def latest_valid_dir(root):
+    """Newest checkpoint dir that verifies, or None. Invalid candidates are
+    counted + warned, never raised over — that is the point."""
+    for step, ckpt_dir in _list_checkpoints(root):
+        if verify_checkpoint(ckpt_dir):
+            return step, ckpt_dir
+        health.incr("ckpt_skipped_invalid")
+        warnings.warn(
+            "skipping invalid/torn checkpoint %s (no manifest or checksum "
+            "mismatch)" % ckpt_dir
+        )
+    return None
+
+
+def load_latest_valid(root):
+    """(step, name->array) of the newest consistent checkpoint, or None."""
+    found = latest_valid_dir(root)
+    if found is None:
+        return None
+    step, ckpt_dir = found
+    from .. import io as fluid_io
+
+    return step, fluid_io.load_arrays(ckpt_dir)
+
+
+def snapshot_persistables(program, scope=None):
+    """Host-side name->array snapshot of the program's persistable state
+    (params, optimizer accumulators, lr) — the checkpointable set. Gradient
+    staging names (`*@GRAD` etc.) are transient and skipped, like
+    save_persistables. np.asarray copies to host NOW, so a later donated
+    in-place step cannot mutate the snapshot."""
+    from ..executor import global_scope
+    from ..io import _is_persistable
+
+    scope = scope or global_scope()
+    out = {}
+    for v in program.list_vars():
+        if not _is_persistable(v) or "@" in v.name:
+            continue
+        val = scope.find_var(v.name)
+        if val is not None:
+            out[v.name] = np.asarray(val)
+    return out
+
+
+def resume_or_init(exe, startup_program, root, scope=None, program=None):
+    """Trainer-loop entry: run the startup program, then overlay the latest
+    valid checkpoint from `root` (if any) onto the scope. Returns the number
+    of completed steps recorded in that checkpoint — 0 for a fresh start —
+    i.e. the index the training loop resumes from.
+
+    `program` optionally restricts the restore to names that program knows
+    (a checkpoint written by a wider program must not leak foreign vars
+    into this scope)."""
+    import jax.numpy as jnp
+
+    from ..executor import global_scope
+
+    exe.run(startup_program)
+    found = load_latest_valid(root)
+    if found is None:
+        return 0
+    step, arrays = found
+    scope = scope or global_scope()
+    allowed = None
+    if program is not None:
+        allowed = {v.name for v in program.list_vars()}
+    for name, arr in arrays.items():
+        if allowed is None or name in allowed:
+            scope.set_var(name, jnp.asarray(arr))
+    health.incr("resumed_from_checkpoint")
+    return step

@@ -1,0 +1,44 @@
+"""Process-wide health counters for degraded-but-alive events.
+
+Surviving a fault silently is almost as bad as dying from it: operators need
+to see that a run skipped 3 NaN steps and retried 40 RPCs. Counters are a
+plain thread-safe name->int map; runners print `snapshot()` at exit (the
+dist test runners emit it as a HEALTH json line).
+
+Well-known counter names (incremented by the wired hook points):
+  nan_steps_skipped   executor NaN/Inf step guard fired
+  lr_decays           guard decayed the learning rate / loss scale
+  rpc_retries         RPCClient retried a call
+  master_retries      MasterClient retried a call
+  dist_init_retries   multihost.init_distributed retried the rendezvous
+  master_snapshot_corrupt   Master started fresh over a bad snapshot
+  ckpt_skipped_invalid      load_latest_valid skipped a torn checkpoint
+"""
+
+import threading
+
+__all__ = ["incr", "get", "snapshot", "reset"]
+
+_lock = threading.Lock()
+_counters = {}
+
+
+def incr(name, n=1):
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+        return _counters[name]
+
+
+def get(name):
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def snapshot():
+    with _lock:
+        return dict(_counters)
+
+
+def reset():
+    with _lock:
+        _counters.clear()

@@ -1,0 +1,105 @@
+"""Unified retry policy: bounded attempts, exponential backoff with jitter,
+an overall deadline, and typed retryable-vs-fatal errors.
+
+Reference analog: grpc_client.cc retried every RPC FLAGS_max_retry times
+under FLAGS_rpc_deadline; the master client and the NCCL-id rendezvous each
+had their own ad-hoc loops. Here one policy object expresses all of them:
+
+    policy = RetryPolicy(max_attempts=4, base_delay=0.1, deadline=120.0)
+    reply = policy.call(send_once)
+
+Error typing contract:
+- FatalError (or anything in `fatal`) aborts immediately — e.g. an RPC whose
+  bytes may already have reached the server must not be resent.
+- DeadlineExceeded is a TimeoutError: a hung peer surfaces as a typed,
+  catchable error instead of an indefinite block.
+- anything in `retryable` is retried until attempts or the deadline run out,
+  then the LAST error is re-raised (types survive: callers still catch
+  ConnectionError/TimeoutError exactly as before).
+"""
+
+import time
+from random import Random
+
+__all__ = ["RetryPolicy", "DeadlineExceeded", "FatalError"]
+
+
+class FatalError(Exception):
+    """Never retried. Wrap a cause with `FatalError(str(e))` + `from e`, or
+    list domain exception types in RetryPolicy(fatal=...)."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """A connect/read deadline or an overall retry deadline expired.
+    TimeoutError => also an OSError, so pre-existing `except OSError`
+    cleanup paths keep working."""
+
+
+class RetryPolicy:
+    """One retryable call: `policy.call(fn)` runs fn up to max_attempts
+    times, sleeping base_delay * multiplier**i (capped at max_delay, +/- a
+    jitter fraction) between attempts, never past `deadline` seconds total.
+
+    `seed` makes the jitter sequence deterministic (resilience tests);
+    `sleep` is injectable for zero-wall-clock unit tests.
+    """
+
+    def __init__(
+        self,
+        max_attempts=4,
+        base_delay=0.1,
+        max_delay=2.0,
+        multiplier=2.0,
+        jitter=0.25,
+        deadline=None,
+        retryable=(ConnectionError, TimeoutError, OSError, EOFError),
+        fatal=(FatalError,),
+        seed=None,
+        sleep=time.sleep,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self.deadline = deadline
+        self.retryable = tuple(retryable)
+        self.fatal = tuple(fatal)
+        self._rng = Random(seed)
+        self._sleep = sleep
+
+    def backoff(self, attempt):
+        """Delay before retrying after 0-based `attempt` (jittered)."""
+        d = min(self.base_delay * (self.multiplier ** attempt), self.max_delay)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(d, 0.0)
+
+    def call(self, fn, *args, on_retry=None, **kwargs):
+        """Run fn(*args, **kwargs) under this policy. `on_retry(attempt, err)`
+        is invoked before each backoff sleep (logging/metrics hook)."""
+        start = time.monotonic()
+        last = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args, **kwargs)
+            except self.fatal:
+                raise
+            except self.retryable as e:
+                last = e
+                if attempt + 1 >= self.max_attempts:
+                    break
+                pause = self.backoff(attempt)
+                if self.deadline is not None:
+                    remaining = self.deadline - (time.monotonic() - start)
+                    if remaining <= pause:
+                        raise DeadlineExceeded(
+                            "retry deadline %.1fs exhausted after %d attempts"
+                            % (self.deadline, attempt + 1)
+                        ) from e
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                self._sleep(pause)
+        raise last

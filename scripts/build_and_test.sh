@@ -41,6 +41,18 @@ for ((s = 0; s < NSHARDS; s++)); do
     fi
 done
 
+echo "== fault-injection smoke (resilience; docs/resilience.md) =="
+# the MNIST book test must converge with its 10th training step poisoned
+# (nan_grad, skipped by FLAGS_resilience_nan_guard), and the 2-trainer
+# cluster must complete with ~8% of RPC attempts dropped and retried under
+# the unified policy
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    FLAGS_resilience_nan_guard=1 \
+    PADDLE_TPU_FAULTS="nan_grad:step=10,rpc_drop:0.05@seed=7" \
+    python -m pytest -q \
+        tests/test_mnist.py::test_mnist_lenet_converges \
+        tests/test_resilience.py::test_cluster_completes_under_seeded_rpc_drop
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

@@ -18,3 +18,11 @@ if os.environ.get("PADDLE_OPTEST_PLACE", "").lower() != "tpu":
     from paddle_tpu.platform_setup import force_virtual_cpu_devices
 
     force_virtual_cpu_devices(8)
+
+
+def pytest_configure(config):
+    # the tier-1 lane runs with `-m 'not slow'`; anything expected to exceed
+    # ~60s wall (long fault-injection soaks etc.) gets @pytest.mark.slow
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from the tier-1 lane"
+    )

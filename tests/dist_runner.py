@@ -16,6 +16,17 @@ Flags beyond the round-3 set:
 - --load_dir: each pserver restores its shard vars from the dir after
   running its startup program (dist save/load resume, dist_save_load.py)
 - --start_step: offset into the deterministic batch schedule (resume)
+
+Resilience flags (tests/test_resilience.py; docs/resilience.md):
+- --faults: install a FaultPlan spec in THIS process (subprocesses normally
+  inherit PADDLE_TPU_FAULTS from the env instead)
+- --nan_guard: enable FLAGS_resilience_nan_guard for the trainer loop
+- --ckpt_dir + --ckpt_every: trainer 0 writes manifest checkpoints of its
+  persistables every k steps and starts via resilience.resume_or_init
+  (prints "RESUMED <n>"); a fresh process pointed at the same dir continues
+  from the latest valid checkpoint
+Trainers always end with a "HEALTH <json>" line (resilience.health counters)
+so the parent test can assert survived-fault counts.
 """
 
 import argparse
@@ -104,15 +115,25 @@ def main():
     ap.add_argument("--save_after", type=int, default=0)
     ap.add_argument("--load_dir", default="")
     ap.add_argument("--start_step", type=int, default=0)
+    ap.add_argument("--faults", default="")
+    ap.add_argument("--nan_guard", type=int, default=0)
+    ap.add_argument("--ckpt_dir", default="")
+    ap.add_argument("--ckpt_every", type=int, default=0)
     args = ap.parse_args()
 
     import paddle_tpu.fluid as fluid
-    from paddle_tpu import framework
+    from paddle_tpu import framework, resilience
     from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.resilience import faults, health
     from paddle_tpu.transpiler import (
         DistributeTranspiler,
         DistributeTranspilerConfig,
     )
+
+    if args.faults:
+        faults.install(args.faults)
+    if args.nan_guard:
+        fluid.set_flags({"resilience_nan_guard": True})
 
     main_prog, startup, loss = build(args.model, args.lr)
     config = DistributeTranspilerConfig()
@@ -179,7 +200,16 @@ def main():
     scope = Scope(seed=5)
     with scope_guard(scope):
         exe = fluid.Executor()
-        exe.run(startup)
+        if args.ckpt_dir:
+            # crash-safe resume: startup + overlay of the latest valid
+            # manifest checkpoint (0 completed steps when fresh)
+            resumed = resilience.resume_or_init(
+                exe, startup, args.ckpt_dir, scope=scope, program=trainer_prog
+            )
+            args.start_step += resumed
+            print("RESUMED %d" % resumed, flush=True)
+        else:
+            exe.run(startup)
         if args.load_dir:
             load_into_trainer(scope)
         for s in range(args.start_step, args.start_step + args.steps):
@@ -204,7 +234,22 @@ def main():
                 )
                 exe.run(ck)
                 print("CHECKPOINT_SAVED", flush=True)
+            if (
+                args.ckpt_dir
+                and args.ckpt_every
+                and args.trainer_id == 0
+                and (s + 1) % args.ckpt_every == 0
+            ):
+                from paddle_tpu.resilience import checkpoint as ckpt
+
+                ckpt.save_checkpoint(
+                    args.ckpt_dir,
+                    ckpt.snapshot_persistables(trainer_prog, scope),
+                    step=s + 1,
+                )
+                print("CKPT %d" % (s + 1), flush=True)
         exe.close()  # SendComplete → pserver exits when all trainers did
+    print("HEALTH " + json.dumps(health.snapshot()), flush=True)
     print("LOSSES " + json.dumps(losses), flush=True)
 
 
