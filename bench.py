@@ -481,26 +481,28 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=30,
         # k=32 halves the per-call dispatch share again), so it
         # amortizes per-call dispatch + the end-of-window fetch sync the
         # same way the ResNet/LSTM passes do. Each timed window covers 64
-        # steps so the single ~100 ms tunnel sync stays under 1%%; the pass
-        # takes the BEST of two windows and falls back to per-step dispatch
-        # if the scan path errors. Best-of is the right estimator HERE
-        # because the noise is one-sided: harness contention and stalls only
-        # ever ADD time to a window (a one-off host stall once produced a
-        # 25%% artifact against the same run's own steady state — the same
-        # failure shape as r04's LSTM skew), so min-over-windows converges
-        # on the device steady state, and the policy is stated here so the
-        # number is read as what it is.
+        # steps so the single ~100 ms tunnel sync stays under 1%%. The
+        # HEADLINE estimator is min-over-windows: the noise here is
+        # one-sided — harness contention and stalls only ever ADD time to a
+        # window (a one-off host stall once produced a 25%% artifact against
+        # the same run's own steady state — the same failure shape as r04's
+        # LSTM skew), so the min converges on the device steady state. To
+        # make that estimator choice AUDITABLE rather than asserted, >=5
+        # windows are timed and every per-window time plus the median ride
+        # along in the JSON record: a min far below the median flags a run
+        # whose headline deserves suspicion (r05 advisor).
         k = 32
         calls = 2
+        windows = 5
         stacked = {n: jnp.stack([v] * k) for n, v in feed.items()}
-        best_dt = float("inf")
+        window_dts = []
         try:
             (l,) = exe.run(
                 main, feed=stacked, fetch_list=[loss.name],
                 return_numpy=False, steps_per_run=k,
             )
             np.asarray(l)
-            for _ in range(2):
+            for _ in range(windows):
                 t0 = time.perf_counter()
                 for _ in range(calls):
                     (l,) = exe.run(
@@ -508,7 +510,7 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=30,
                         return_numpy=False, steps_per_run=k,
                     )
                 np.asarray(l)
-                best_dt = min(best_dt, (time.perf_counter() - t0) / (calls * k))
+                window_dts.append((time.perf_counter() - t0) / (calls * k))
         except Exception as e:
             print("transformer multi-step failed, per-step fallback: %r" % e,
                   file=sys.stderr)
@@ -516,13 +518,107 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=30,
                 (l,) = exe.run(main, feed=feed, fetch_list=[loss.name],
                                return_numpy=False)
             np.asarray(l)
+            window_dts = []
+            for _ in range(windows):
+                t0 = time.perf_counter()
+                for _ in range(max(steps // windows, 1)):
+                    (l,) = exe.run(main, feed=feed, fetch_list=[loss.name],
+                                   return_numpy=False)
+                np.asarray(l)
+                window_dts.append(
+                    (time.perf_counter() - t0) / max(steps // windows, 1)
+                )
+    best_dt = min(window_dts)
+    median_dt = sorted(window_dts)[len(window_dts) // 2]
+    return {
+        "tflops_min_window": flops / best_dt / 1e12,
+        "tflops_median_window": flops / median_dt / 1e12,
+        "window_ms_per_step": [round(dt * 1e3, 2) for dt in window_dts],
+    }
+
+
+def run_zero1_bench(d=512, depth=4, bs_per_dev=16, steps=12, warmup=3):
+    """ZeRO-1 vs replicated data parallelism over the local device mesh:
+    same MLP+Adam train step under ReduceStrategy.AllReduce (replicated
+    optimizer state, gradient all-reduce) and ReduceStrategy.Reduce (ZeRO-1:
+    reduce-scatter grad, sharded moments, param all-gather). Reports step
+    time for both and the measured PER-CHIP optimizer-state bytes — the
+    sharded path's state bytes drop ~dp× (the ZeRO-1 memory claim, measured
+    not asserted). Returns None on a single-device harness (the bench chip):
+    there is no dp axis to shard over. Wire-volume evidence for the same
+    pair of paths comes from tools/comm_audit.py."""
+    import jax
+
+    if jax.device_count() < 2:
+        return None
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.parallel_executor import BuildStrategy, ReduceStrategy
+
+    n_dev = jax.device_count()
+    bs = bs_per_dev * n_dev
+    rng = np.random.RandomState(0)
+    x = rng.randn(bs, d).astype("float32")
+    y = rng.randint(0, 10, (bs, 1)).astype("int64")
+
+    def one(strategy):
+        main_p, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+            xv = fluid.layers.data(name="x", shape=[d], dtype="float32")
+            yv = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h = xv
+            for _ in range(depth):
+                h = fluid.layers.fc(h, size=d, act="relu")
+            logits = fluid.layers.fc(h, size=10)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, yv)
+            )
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        strat = BuildStrategy()
+        strat.reduce_strategy = strategy
+        scope = Scope(seed=0)
+        with scope_guard(scope):
+            fluid.Executor().run(startup)
+            pe = fluid.ParallelExecutor(
+                loss_name=loss.name, main_program=main_p, build_strategy=strat,
+                scope=scope,
+            )
+            for _ in range(warmup):
+                (l,) = pe.run(fetch_list=[loss.name], feed={"x": x, "y": y},
+                              return_numpy=False)
+            np.asarray(l)
             t0 = time.perf_counter()
             for _ in range(steps):
-                (l,) = exe.run(main, feed=feed, fetch_list=[loss.name],
-                               return_numpy=False)
+                (l,) = pe.run(fetch_list=[loss.name], feed={"x": x, "y": y},
+                              return_numpy=False)
             np.asarray(l)
-            best_dt = (time.perf_counter() - t0) / steps
-    return flops / best_dt / 1e12
+            ms = (time.perf_counter() - t0) / steps * 1e3
+            # optimizer accumulators carry the unique_name "_acc" suffix
+            # (optimizer._add_accumulator); per-chip bytes = device 0's shard
+            state_bytes = 0
+            for name, val in scope.vars.items():
+                if "_acc" in name and hasattr(val, "addressable_shards"):
+                    state_bytes += val.addressable_shards[0].data.nbytes
+            final_loss = float(np.asarray(l).reshape(-1)[0])
+        return ms, state_bytes, final_loss
+
+    ar_ms, ar_bytes, ar_loss = one(ReduceStrategy.AllReduce)
+    z1_ms, z1_bytes, z1_loss = one(ReduceStrategy.Reduce)
+    assert np.isfinite(z1_loss) and abs(z1_loss - ar_loss) < 5e-2, (
+        "zero1 trajectory diverged from replicated: %.4f vs %.4f"
+        % (z1_loss, ar_loss)
+    )
+    return {
+        "zero1_devices": n_dev,
+        "zero1_step_ms": round(z1_ms, 2),
+        "allreduce_step_ms": round(ar_ms, 2),
+        "zero1_opt_state_bytes_per_chip": z1_bytes,
+        "allreduce_opt_state_bytes_per_chip": ar_bytes,
+        "zero1_state_reduction_x": round(ar_bytes / z1_bytes, 2)
+        if z1_bytes
+        else None,
+    }
 
 
 def main():
@@ -576,20 +672,40 @@ def main():
         # TPU-native training configuration (convergence-tested,
         # tests/test_ops_optimizers.py) which halves optimizer-state memory
         # and its share of the dW-fusion HBM traffic (PROFILE.md audit)
-        tfs = run_transformer_mfu()
+        mfu = run_transformer_mfu()
+        tfs = mfu["tflops_min_window"]
         record["transformer_tflops_per_sec"] = round(tfs, 1)
         record["transformer_mfu_vs_nominal_peak"] = round(tfs / NOMINAL_BF16_TFLOPS, 3)
+        # estimator audit trail: the median and every window time (min far
+        # below median = suspect headline; see run_transformer_mfu)
+        record["transformer_tflops_median_window"] = round(
+            mfu["tflops_median_window"], 1
+        )
+        record["transformer_window_ms_per_step"] = mfu["window_ms_per_step"]
     except Exception as e:
         print("transformer MFU pass failed: %r" % e, file=sys.stderr)
     try:
         # reference-comparable variant: full-f32 Adam state
-        tfs_f32 = run_transformer_mfu(moment_dtype=None)
+        mfu_f32 = run_transformer_mfu(moment_dtype=None)
+        tfs_f32 = mfu_f32["tflops_min_window"]
         record["transformer_tflops_f32_state"] = round(tfs_f32, 1)
         record["transformer_mfu_f32_state"] = round(
             tfs_f32 / NOMINAL_BF16_TFLOPS, 3
         )
+        record["transformer_f32_state_window_ms_per_step"] = mfu_f32[
+            "window_ms_per_step"
+        ]
     except Exception as e:
         print("f32-state MFU pass failed: %r" % e, file=sys.stderr)
+    try:
+        # ZeRO-1 evidence (multi-device meshes only; the single-chip bench
+        # harness skips): step time + per-chip optimizer-state bytes,
+        # Reduce(ZeRO-1) vs AllReduce(replicated) — docs/parallelism.md
+        z1 = run_zero1_bench()
+        if z1:
+            record.update(z1)
+    except Exception as e:
+        print("zero1 bench pass failed: %r" % e, file=sys.stderr)
     try:
         lstm_ms, token_frac = run_lstm(measure_pipeline=True)
         record["lstm_ms_per_batch"] = round(lstm_ms, 1)

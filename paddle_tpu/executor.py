@@ -157,10 +157,21 @@ class _CompiledBlock:
     feeds are batch-sharded over the mesh's data axes, state is replicated,
     and XLA's GSPMD partitioner inserts the gradient all-reduce over ICI at
     the same seam where the reference's multi_devices_graph_pass inserted
-    ncclAllReduce ops."""
+    ncclAllReduce ops.
+
+    With `zero1_axis` (ParallelExecutor under ReduceStrategy.Reduce), the
+    optimizer tier runs ZeRO-1 sharded over that axis: optimizer-state
+    tensors (momentum velocities, Adam moments — core_ops.ZERO1_STATE_SLOTS)
+    are STORED sharded 1/dp per rank via their in/out_shardings, and the
+    optimizer lowerings (core_ops._opt_f32 reading ctx.zero1_axis) constrain
+    grad/param/moments so GSPMD emits reduce-scatter + sharded update +
+    param all-gather in place of the gradient all-reduce — identical wire
+    volume, optimizer-state memory and HBM traffic ÷ dp
+    (docs/parallelism.md)."""
 
     def __init__(self, program, block, feed_names, fetch_names, scope, mesh=None,
-                 data_axes=("dp",), feed_ranks=None, ops_override=None):
+                 data_axes=("dp",), feed_ranks=None, ops_override=None,
+                 zero1_axis=None):
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         src_ops = block.ops if ops_override is None else ops_override
@@ -236,6 +247,17 @@ class _CompiledBlock:
                     jnp.bfloat16 if _v.dtype == "bfloat16" else np.dtype(_v.dtype)
                 )
 
+        # ZeRO-1 active only when the mesh actually has >1 rank on the axis
+        # (a dp=1 mesh degrades to the plain replicated path, same program)
+        z1 = (
+            zero1_axis
+            if mesh is not None
+            and zero1_axis
+            and mesh.shape.get(zero1_axis, 1) > 1
+            else None
+        )
+        self.zero1_axis = z1
+
         def run(feeds, ro_state, mut_state, rng_key):
             feeds = {
                 n: (
@@ -249,7 +271,7 @@ class _CompiledBlock:
             env.update(ro_state)
             env.update(mut_state)
             env.update(feeds)
-            ctx = registry.LowerCtx(rng_key, mesh=mesh)
+            ctx = registry.LowerCtx(rng_key, mesh=mesh, zero1_axis=z1)
             registry.lower_ops(ctx, ops_, env)
             fetches = [env[n] for n in self.fetch_names]
             new_mut = {n: env[n] for n in self.mut_names}
@@ -263,6 +285,7 @@ class _CompiledBlock:
         if mesh is None:
             self.jitted = jax.jit(run, donate_argnums=(2,))
             self._feed_sharding = None
+            self.zero1_state_names = []
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -270,12 +293,35 @@ class _CompiledBlock:
             repl = NamedSharding(mesh, P())
             self._feed_sharding = batch
 
+            # ZeRO-1: optimizer-state tensors live sharded 1/dp per rank —
+            # the ÷dp state-memory/HBM win. Names come from the optimizer
+            # ops' state input slots; only tensors whose leading dim divides
+            # the axis shard (scalars like Beta*Pow stay replicated).
+            zero1_names = set()
+            if z1 is not None:
+                from .ops.core_ops import ZERO1_STATE_SLOTS
+                from .parallel.collectives import zero1_shardable
+
+                for op in self.ops:
+                    for slot in ZERO1_STATE_SLOTS.get(op.type, ()):
+                        for name in op.inputs.get(slot, ()):
+                            val = scope.find_var(name)
+                            if val is not None and zero1_shardable(
+                                np.shape(val), mesh, z1
+                            ):
+                                zero1_names.add(name)
+            self.zero1_state_names = sorted(zero1_names)
+            z1_sh = NamedSharding(mesh, P(z1)) if z1 is not None else None
+
             def state_sharding(name):
                 """Parameters annotated via parallel.shard_parameter carry a
                 PartitionSpec tuple (tensor parallelism); default replicated.
                 Axes the current mesh doesn't have degrade to replication so
                 the same program runs on any mesh (e.g. distributed_embedding
-                under a dp-only ParallelExecutor)."""
+                under a dp-only ParallelExecutor). ZeRO-1 optimizer state
+                shards over the zero1 axis."""
+                if name in zero1_names:
+                    return z1_sh
                 try:
                     v = block._var_recursive(name)
                 except KeyError:
@@ -352,7 +398,8 @@ class _MultiStepBlock:
     """
 
     def __init__(self, program, block, feed_names, fetch_names, scope,
-                 steps_per_run, mesh=None, data_axes=("dp",), feed_ranks=None):
+                 steps_per_run, mesh=None, data_axes=("dp",), feed_ranks=None,
+                 zero1_axis=None):
         if steps_per_run < 1:
             raise ValueError("steps_per_run must be >= 1")
         self.steps_per_run = steps_per_run
@@ -361,6 +408,7 @@ class _MultiStepBlock:
         inner = _CompiledBlock(
             program, block, feed_names, fetch_names, scope,
             mesh=mesh, data_axes=data_axes, feed_ranks=feed_ranks,
+            zero1_axis=zero1_axis,
         )
         if inner.created_persistables:
             raise RuntimeError(
@@ -373,6 +421,8 @@ class _MultiStepBlock:
         self.fetch_names = inner.fetch_names
         self.ro_names = inner.ro_names
         self.mut_names = inner.mut_names
+        self.zero1_axis = inner.zero1_axis
+        self.zero1_state_names = inner.zero1_state_names
         self._feed_sharding = None
 
         def run_k(stacked_feeds, ro_state, mut_state, rng_key):

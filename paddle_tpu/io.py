@@ -101,8 +101,14 @@ def _load_dtype_meta(dirname):
         return meta
     for fname in names:
         if fname.startswith("__dtypes__") and fname.endswith(".json"):
-            with open(os.path.join(dirname, fname)) as f:
-                meta.update(json.load(f))
+            try:
+                with open(os.path.join(dirname, fname)) as f:
+                    meta.update(json.load(f))
+            except (OSError, ValueError):
+                # a torn legacy meta (writer died mid-dump) must not fail
+                # the load; the per-array sidecars still carry the dtypes
+                # for anything saved by the current layout
+                continue
     return meta
 
 
@@ -175,9 +181,14 @@ def save_vars(
             combined[name] = arr
         np.savez(os.path.join(dirname, filename), **combined)
         # always rewrite (even empty): an earlier save's meta left in place
-        # would apply stale dtypes to a later all-f32 save of the same file
-        with open(os.path.join(dirname, "__dtypes__.json"), "w") as f:
+        # would apply stale dtypes to a later all-f32 save of the same file.
+        # Atomic like save_arrays' payloads: a crash mid-write must not leave
+        # a torn half-JSON that poisons every later load of the directory
+        path = os.path.join(dirname, "__dtypes__.json")
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
             json.dump(meta, f)
+        os.replace(tmp, path)
 
 
 def _is_param(v):
@@ -226,7 +237,10 @@ def load_vars(
         try:
             with open(os.path.join(dirname, "__dtypes__.json")) as f:
                 meta = json.load(f)
-        except OSError:
+        except (OSError, ValueError):
+            # missing OR torn (a legacy writer died mid-json.dump): degrade
+            # to no dtype records — bf16 vars restore as their f32 payloads
+            # — rather than failing the whole load over a sidecar
             meta = {}
     else:
         meta = _load_dtype_meta(dirname)

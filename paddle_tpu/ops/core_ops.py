@@ -943,14 +943,19 @@ def _lookup_table(ctx, ins, attrs):
 
 @register("lookup_table_grad", no_grad=True)
 def _lookup_table_grad(ctx, ins, attrs):
-    """Explicit grad: scatter-add of the cotangent rows in the COTANGENT's
-    dtype. The generic vjp runs the scatter in the f32 master table's dtype,
-    which under bf16 training materializes a [vocab, d] f32 gradient (plus
-    island casts either side) — on the MFU-bench transformer that was 2x
-    262 MB of pure HBM traffic per step for tables whose grad immediately
-    feeds an optimizer op that casts internally anyway (r05 audit: the two
-    embedding-grad scatters ran at 4.4x roofline). W is consulted for its
-    SHAPE only, so the transpiler's W@BF16 cast (if any) dead-codes away."""
+    """Explicit grad: scatter-add of the cotangent rows ACCUMULATED IN F32,
+    cast once to the cotangent's dtype at the end. The f32 accumulator is
+    what makes repeated ids safe under bf16 training: adding 1-ulp increments
+    into a bf16 row plateaus once the row outgrows the increment's precision
+    (the sum of ones stalls at 256 — the r05 advisor's swamping repro, covered
+    by tests/test_ops_roundout.py), while one final rounding step loses at
+    most 1 ulp. The result still lands in the cotangent's dtype, so the
+    bf16-wire saving vs the generic vjp (which scatters in the f32 master
+    table's dtype AND hands the f32 grad downstream — 2x 262 MB/step of HBM
+    traffic on the MFU-bench transformer, r05 audit) is kept for every
+    consumer; XLA fuses the trailing cast into the scatter's output write.
+    W is consulted for its SHAPE only, so the transpiler's W@BF16 cast (if
+    any) dead-codes away."""
     (w,) = ins["W"]
     (ids,) = ins["Ids"]
     (dout,) = ins["Out@GRAD"]
@@ -962,9 +967,10 @@ def _lookup_table_grad(ctx, ins, attrs):
         pad = padding_idx if padding_idx >= 0 else padding_idx + w.shape[0]
         mask = mask & (flat != pad)
     dw = (
-        jnp.zeros(w.shape, d2.dtype)
+        jnp.zeros(w.shape, jnp.float32)
         .at[jnp.where(mask, flat, 0)]
-        .add(jnp.where(mask[:, None], d2, 0))
+        .add(jnp.where(mask[:, None], d2, 0).astype(jnp.float32))
+        .astype(d2.dtype)
     )
     return {"W@GRAD": [dw]}
 
@@ -1251,6 +1257,91 @@ def _p(ins, slot):
     return ins[slot][0]
 
 
+# optimizer-state input slots per op type — the moment/accumulator tensors the
+# ZeRO-1 tier (ReduceStrategy.Reduce) stores sharded 1/dp per rank. Scalar
+# state (Beta*Pow, LearningRate) is NOT listed: shape [1] cannot shard and its
+# update must stay replicated for numerics identical to the all-reduce path.
+# Consumed by executor._CompiledBlock to build the sharded in/out_shardings.
+ZERO1_STATE_SLOTS = {
+    "momentum": ("Velocity",),
+    "lars_momentum": ("Velocity",),
+    "adam": ("Moment1", "Moment2"),
+    "adagrad": ("Moment",),
+    "decayed_adagrad": ("Moment",),
+    "rmsprop": ("MeanSquare", "Moment", "MeanGrad"),
+    "adadelta": ("AvgSquaredGrad", "AvgSquaredUpdate"),
+    "adamax": ("Moment", "InfNorm"),
+    "ftrl": ("SquaredAccumulator", "LinearAccumulator"),
+}
+
+
+def _zero1_mesh(ctx):
+    """(mesh, axis) when the ZeRO-1 tier is active for this trace, else
+    (None, None)."""
+    axis = getattr(ctx, "zero1_axis", None)
+    mesh = getattr(ctx, "mesh", None)
+    if axis and mesh is not None and mesh.shape.get(axis, 1) > 1:
+        return mesh, axis
+    return None, None
+
+
+def _zero1_constrain_ins(ins, mesh, axis):
+    """ZeRO-1 input constraints: every shardable floating input (Param, Grad,
+    moments) is pinned to a 1/dp shard along dim 0. On the GRADIENT — still an
+    unpositioned cross-replica partial sum at this point of the trace — GSPMD
+    materializes the combine as reduce-scatter ((p-1)/p wire bytes vs the
+    all-reduce's 2(p-1)/p); on replicated params it is a local slice; on the
+    already-sharded moments it is a no-op confirming the stored layout."""
+    from ..parallel import collectives as _coll
+
+    out = {}
+    for slot, vals in ins.items():
+        cons = []
+        for a in vals:
+            if (
+                a is not None
+                and jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                and _coll.zero1_shardable(jnp.shape(a), mesh, axis)
+            ):
+                a = _coll.constrain_sharded(a, mesh, axis)
+            cons.append(a)
+        out[slot] = cons
+    return out
+
+
+def _zero1_constrain_outs(res, mesh, axis):
+    """ZeRO-1 output constraints: ParamOut is constrained back to replicated
+    (GSPMD → all-gather, overlappable with the rest of the step), every other
+    shardable state output (moments) STAYS sharded — that is the 1/dp
+    optimizer-state memory and HBM-traffic win."""
+    from ..parallel import collectives as _coll
+
+    out = {}
+    for slot, vals in res.items():
+        cons = []
+        for v in vals:
+            if v is not None and jnp.issubdtype(
+                jnp.asarray(v).dtype, jnp.floating
+            ):
+                if slot == "ParamOut":
+                    if _coll.zero1_shardable(jnp.shape(v), mesh, axis):
+                        # pin the updated param to the sharded layout FIRST:
+                        # without it the partitioner may push the replicated
+                        # constraint through the update arithmetic and gather
+                        # every operand separately (observed on the CPU
+                        # partitioner: p and lr·v each all-gathered, 2x the
+                        # wire bytes); sharded-then-replicated makes the
+                        # update compute on the 1/dp shard and the reshard a
+                        # single all-gather
+                        v = _coll.constrain_sharded(v, mesh, axis)
+                    v = _coll.constrain_replicated(v, mesh)
+                elif _coll.zero1_shardable(jnp.shape(v), mesh, axis):
+                    v = _coll.constrain_sharded(v, mesh, axis)
+            cons.append(v)
+        out[slot] = cons
+    return out
+
+
 def _opt_f32(fn):
     """Optimizer-lowering dtype fidelity: compute the update in f32 (bf16
     grads upcast; master states already f32 under the train-mode
@@ -1263,6 +1354,12 @@ def _opt_f32(fn):
 
     @functools.wraps(fn)
     def wrapped(ctx, ins, attrs):
+        z1_mesh, z1_axis = _zero1_mesh(ctx)
+        if z1_mesh is not None:
+            # ZeRO-1 tier: reduce-scatter the grad, slice param + moments to
+            # this rank's 1/dp shard BEFORE the f32 upcast (the wire carries
+            # the grad's native dtype; the upcast then touches only the shard)
+            ins = _zero1_constrain_ins(ins, z1_mesh, z1_axis)
         orig_dt = {}
         ins32 = {}
         for slot, vals in ins.items():
@@ -1293,6 +1390,10 @@ def _opt_f32(fn):
                 else:
                     down.append(v)
             out[slot] = down
+        if z1_mesh is not None:
+            # all-gather the updated param back to every rank; moments stay
+            # sharded (stored 1/dp via the executor's state shardings)
+            out = _zero1_constrain_outs(out, z1_mesh, z1_axis)
         return out
 
     return wrapped

@@ -783,7 +783,8 @@ def _flash_backward(q, k, v, out, lse, dout, causal, sm_scale, block_q,
             jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
             # dQ partials, one slab per k block, in q's dtype: each partial
             # is already f32-accumulated inside its dot; the cross-block sum
-            # over <=8 terms loses nothing the final bf16 cast keeps
+            # over nk<=2 terms (the tier gate above routes tk//block_k > 2
+            # to the streamed path) loses nothing the final bf16 cast keeps
             jax.ShapeDtypeStruct((b * h, nk, tq, d), q.dtype),
         ],
         interpret=interpret,

@@ -130,12 +130,20 @@ class LowerCtx:
     """Per-trace context handed to lowerings. Threads the PRNG key through the
     block (stochastic ops call next_rng()), carries build attrs, and exposes
     the SPMD mesh (None single-device) so mesh-aware ops (ring attention,
-    sharded embedding) can pick their distributed lowering."""
+    sharded embedding) can pick their distributed lowering.
 
-    def __init__(self, key, is_test=False, mesh=None):
+    zero1_axis (a mesh axis name, normally 'dp') selects the ZeRO-1 sharded
+    optimizer tier: optimizer-op lowerings (core_ops._opt_f32) constrain their
+    gradient to a sharded layout (GSPMD → reduce-scatter), update the 1/dp
+    param+moment shard locally, and constrain ParamOut back to replicated
+    (→ all-gather). Set by _CompiledBlock when the ParallelExecutor build
+    strategy asks for ReduceStrategy.Reduce."""
+
+    def __init__(self, key, is_test=False, mesh=None, zero1_axis=None):
         self.key = key
         self.is_test = is_test
         self.mesh = mesh
+        self.zero1_axis = zero1_axis
 
     def next_rng(self):
         self.key, sub = jax.random.split(self.key)

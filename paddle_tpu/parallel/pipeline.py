@@ -38,22 +38,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-try:  # newer jax exposes the function at jax.shard_map
-    from jax import shard_map as _sm
-
-    shard_map = _sm if callable(_sm) else _sm.shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
-
-import inspect as _inspect
-
-# the replication-check kwarg was renamed check_rep -> check_vma across jax
-# versions; resolve once
-_CHECK_KW = (
-    "check_rep"
-    if "check_rep" in _inspect.signature(shard_map).parameters
-    else "check_vma"
-)
+# version-portable shard_map + replication-check kwarg spelling (the shim
+# moved to collectives so every shard_map user in the package shares it)
+from .collectives import SHARD_MAP_CHECK_KW as _CHECK_KW, axis_size, shard_map
 
 __all__ = ["gpipe", "gpipe_spmd"]
 
@@ -74,7 +61,7 @@ def gpipe_spmd(stage_fn, params_local, x, n_micro, axis_name="pp"):
     [n_local, ...] stage stack; `x` is the (already dp-sharded) batch,
     replicated across `axis_name`. Returns the last stage's outputs,
     replicated across `axis_name`."""
-    pp = lax.axis_size(axis_name)
+    pp = axis_size(axis_name)
     r = lax.axis_index(axis_name)
     b = x.shape[0]
     if b % n_micro:

@@ -40,6 +40,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import pallas_kernels as pk
+from .collectives import SHARD_MAP_CHECK_KW, axis_size, shard_map
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
@@ -48,7 +49,7 @@ NEG_INF = -1e30
 
 def _ring_attention_local(q, k, v, axis_name, causal, scale):
     """Runs inside shard_map: q,k,v are local (b, h, t_loc, d) shards."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, h, t_loc, d = q.shape
 
@@ -127,7 +128,7 @@ def _ring_flash_local(q, k, v, axis_name, causal, scale, interpret):
 
 
 def _ring_flash_fwd_pass(q, k, v, axis_name, causal, scale, interpret):
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, h, t_loc, d = q.shape
 
@@ -170,7 +171,7 @@ def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, interpret):
 
 def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, res, do):
     q, k, v, out, lse = res
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     me = lax.axis_index(axis_name)
 
     dq = jnp.zeros(q.shape, jnp.float32)
@@ -269,16 +270,16 @@ def ring_attention_sharded(
         local = functools.partial(
             _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
         )
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         # flash tier only: pallas_call out_shapes carry no varying-mesh-axes
-        # annotation, which the vma checker requires; collective correctness
-        # there is covered by the ring-vs-dense forward/grad tests. The dense
-        # tier keeps the checker.
-        check_vma=not use_flash,
+        # annotation, which the replication checker requires; collective
+        # correctness there is covered by the ring-vs-dense forward/grad
+        # tests. The dense tier keeps the checker.
+        **{SHARD_MAP_CHECK_KW: not use_flash},
     )
     return fn(q, k, v)
 

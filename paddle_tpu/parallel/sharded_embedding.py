@@ -12,10 +12,11 @@ automatically from this forward.
 
 import functools
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+
+from .collectives import shard_map
 
 __all__ = ["sharded_embedding_lookup"]
 
@@ -40,7 +41,7 @@ def sharded_embedding_lookup(table, ids, mesh, axis_name="ep"):
     (when the mesh has it) so per-device work scales with batch/dp, not the
     global batch. Returns (ids.shape..., d) with the same dp sharding."""
     batch_spec = P(("dp",)) if "dp" in mesh.shape else P()
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_local_lookup, axis_name=axis_name),
         mesh=mesh,
         in_specs=(P((axis_name,), None), batch_spec),

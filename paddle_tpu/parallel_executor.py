@@ -14,6 +14,8 @@ no-ops by construction (XLA already fuses, orders collectives
 deterministically, and GCs buffers), documented per-field.
 """
 
+import weakref
+
 import numpy as np
 
 import jax
@@ -40,9 +42,15 @@ class ReduceStrategy:
 
 class BuildStrategy:
     """Knobs from reference details/build_strategy.h (pybind.cc:746-833).
-    On TPU: reduce_strategy maps AllReduce→all-reduce / Reduce→XLA's choice
-    (GSPMD may emit reduce-scatter+all-gather); fusion knobs are no-ops (XLA
-    fuses); sequential/debug knobs are honored where meaningful."""
+    On TPU: reduce_strategy maps AllReduce→gradient all-reduce with fully
+    replicated optimizer state, Reduce→the ZeRO-1 tier (the reference's
+    Reduce strategy likewise updated each parameter on ONE device and
+    broadcast it back — reduce_op_handle.cc; here the update is sharded
+    1/dp per rank instead of whole-param per owner): gradients
+    reduce-scatter over 'dp', each rank updates its param+moment shard,
+    params all-gather back, optimizer state stored sharded (÷dp memory).
+    Fusion knobs are no-ops (XLA fuses); sequential/debug knobs are honored
+    where meaningful."""
 
     ReduceStrategy = ReduceStrategy
 
@@ -187,11 +195,19 @@ class ParallelExecutor:
             feed_ranks = {
                 n: np.ndim(a) - batch_dim for n, a in feed_arrays.items()
             }
+            # ReduceStrategy.Reduce → ZeRO-1 over the dp axis (BuildStrategy
+            # docstring); degrades to the replicated path when dp == 1
+            zero1_axis = (
+                "dp"
+                if self._build_strategy.reduce_strategy == ReduceStrategy.Reduce
+                and self._mesh.shape.get("dp", 1) > 1
+                else None
+            )
             if is_multi:
                 compiled = _MultiStepBlock(
                     program, block, list(feed_arrays.keys()), fetch_names,
                     self._scope, steps_per_run, mesh=self._mesh,
-                    feed_ranks=feed_ranks,
+                    feed_ranks=feed_ranks, zero1_axis=zero1_axis,
                 )
             else:
                 compiled = _CompiledBlock(
@@ -202,6 +218,7 @@ class ParallelExecutor:
                     self._scope,
                     mesh=self._mesh,
                     feed_ranks=feed_ranks,
+                    zero1_axis=zero1_axis,
                 )
             self._cache[key] = compiled
 
@@ -220,9 +237,42 @@ class ParallelExecutor:
             for n, a in feed_arrays.items()
         }
         fetches = compiled(self._scope, sharded)
+        # correlation seed for compiled_hlo(): abstract feed shapes only
+        # (concrete arrays would pin a batch of device memory), same
+        # contract as Executor._last_run
+        self._last_run = (
+            compiled,
+            weakref.ref(self._scope),
+            {
+                n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                for n, a in sharded.items()
+            },
+        )
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
+
+    def compiled_hlo(self):
+        """Post-optimization HLO text of the most recently run SPMD block
+        (Executor.compiled_hlo analog). Every collective the GSPMD partition
+        inserted — the gradient all-reduce, or the ZeRO-1 reduce-scatter /
+        all-gather pair under ReduceStrategy.Reduce — is visible here with
+        shapes and replica_groups; tools/comm_audit.py parses this text for
+        the per-collective wire-volume audit. Served from the backend's
+        compilation cache after a run, so this does not recompile."""
+        last = getattr(self, "_last_run", None)
+        if last is None:
+            raise RuntimeError("compiled_hlo() needs a prior ParallelExecutor.run")
+        compiled, scope_ref, feed_avals = last
+        scope = scope_ref()
+        if scope is None:
+            raise RuntimeError(
+                "compiled_hlo(): the scope of the last run no longer exists"
+            )
+        ro = {n: scope.vars[n] for n in compiled.ro_names}
+        mut = {n: scope.vars[n] for n in compiled.mut_names}
+        lowered = compiled.jitted.lower(feed_avals, ro, mut, scope.rng_key)
+        return lowered.compile().as_text()
 
     def drop_local_exe_scopes(self):  # compat no-op: no per-device scopes
         pass

@@ -53,6 +53,14 @@ JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
         tests/test_mnist.py::test_mnist_lenet_converges \
         tests/test_resilience.py::test_cluster_completes_under_seeded_rpc_drop
 
+echo "== zero1 + comm-volume smoke (docs/parallelism.md) =="
+# compiles the dp and zero1 (ReduceStrategy.Reduce) MLP train steps on the
+# 8-device mesh, parses every collective out of the HLO, and asserts the
+# reduce-combined bytes match the analytic gradient bytes (and the zero1
+# all-gather the shardable param bytes) within 10%
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python tools/comm_audit.py --check
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

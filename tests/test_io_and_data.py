@@ -351,3 +351,46 @@ def test_sidecar_dtype_round_trips_bf16():
             json.dump({"w": "bfloat16"}, f)
         got = load_arrays(d)["w"]
         assert got.dtype == jnp.bfloat16
+
+
+def test_torn_dtype_meta_degrades_gracefully():
+    """A writer that died mid-json.dump leaves a torn `__dtypes__.json`.
+    Loads must not fail over the sidecar: the per-var path skips the torn
+    meta (per-array .dtype sidecars still apply), and the combined-file path
+    degrades to no dtype records — vars restore as their f32 payloads."""
+    import json
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.io import load_arrays
+
+    # per-var layout: torn legacy meta + healthy sidecar
+    with tempfile.TemporaryDirectory() as d:
+        from paddle_tpu.io import save_arrays
+
+        save_arrays(d, {"w": jnp.asarray([2.5], jnp.bfloat16)})
+        with open(os.path.join(d, "__dtypes__.json"), "w") as f:
+            f.write('{"w": "bfl')  # truncated mid-dump
+        got = load_arrays(d)["w"]
+        assert got.dtype == jnp.bfloat16  # sidecar still wins
+
+    # combined layout: torn meta beside the .npz
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x, y = _build_linear()
+    exe = fluid.Executor()
+    with tempfile.TemporaryDirectory() as d:
+        with scope_guard(Scope(seed=1)):
+            exe.run(startup)
+            xv = np.ones((3, 4), "float32")
+            (before,) = exe.run(main, feed={"x": xv}, fetch_list=[y.name])
+            fluid.io.save_persistables(exe, d, main, filename="all.npz")
+            # the save's own meta must have committed atomically (no temps)
+            assert os.path.exists(os.path.join(d, "__dtypes__.json"))
+            assert not [n for n in os.listdir(d) if ".tmp." in n]
+        with open(os.path.join(d, "__dtypes__.json"), "w") as f:
+            f.write('{"fc_0.w_0": "bfloat1')  # torn
+        with scope_guard(Scope(seed=99)):
+            fluid.io.load_persistables(exe, d, main, filename="all.npz")
+            (after,) = exe.run(main, feed={"x": xv}, fetch_list=[y.name])
+        np.testing.assert_allclose(before, after)

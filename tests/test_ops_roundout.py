@@ -346,5 +346,51 @@ class TestRpcRetryAndCollectiveGather(unittest.TestCase):
         np.testing.assert_allclose(got2, 2 * table)
 
 
+class TestLookupTableGradF32Accumulation(unittest.TestCase):
+    def test_repeated_ids_do_not_swamp_bf16(self):
+        """1536 occurrences of one id with bf16 cotangents of 1.0: a naive
+        bf16 scatter-add plateaus at 512 (row spacing becomes 2 and 1-ulp
+        adds round away under ties-to-even), so the accumulated row must come
+        from the f32 accumulator — full count, one trailing cast, result
+        still bf16 for the wire saving."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import registry
+
+        n_rep, vocab, d = 1536, 8, 4
+        ids = jnp.zeros((n_rep, 1), jnp.int64)  # every row hits id 0
+        w = jnp.zeros((vocab, d), jnp.bfloat16)
+        dout = jnp.ones((n_rep, d), jnp.bfloat16)
+        ctx = registry.LowerCtx(jax.random.key(0))
+        out = registry.get("lookup_table_grad").lower(
+            ctx, {"W": [w], "Ids": [ids], "Out@GRAD": [dout]}, {}
+        )
+        (dw,) = out["W@GRAD"]
+        self.assertEqual(str(dw.dtype), "bfloat16")
+        got = np.asarray(dw.astype(jnp.float32))
+        # bf16 spacing at 1536 is 8: the exact count is representable to
+        # within one ulp of the final cast
+        np.testing.assert_allclose(got[0], n_rep, atol=8)
+        # untouched rows stay zero
+        np.testing.assert_allclose(got[1:], 0.0)
+
+    def test_swamping_premise(self):
+        """The defect the f32 accumulator fixes must actually exist: summing
+        1536 bf16 ones sequentially in bf16 stalls at 256 (8 significand
+        bits: above 2^8 the spacing is 2 and +1 rounds back down)."""
+        import jax
+        import jax.numpy as jnp
+
+        acc = jax.jit(
+            lambda: jax.lax.fori_loop(
+                0, 1536,
+                lambda i, a: a + jnp.ones((), jnp.bfloat16),
+                jnp.zeros((), jnp.bfloat16),
+            )
+        )()
+        self.assertEqual(float(acc.astype(jnp.float32)), 256.0)
+
+
 if __name__ == "__main__":
     unittest.main()

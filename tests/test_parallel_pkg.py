@@ -217,7 +217,7 @@ def test_collective_wrappers():
         b = collectives.broadcast(x, "dp", root=3)
         return s, idx.reshape(1, 1), rot, b
 
-    fn = jax.shard_map(
+    fn = collectives.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(("dp",), None),),

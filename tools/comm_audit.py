@@ -1,0 +1,588 @@
+"""Communication-volume audit for the multi-device lowering paths.
+
+Compiles one real training step per parallelism path (dp all-reduce,
+zero1 = ReduceStrategy.Reduce, dp x tp x sp x ep attention, dp x pp GPipe) over
+the 8-device mesh, parses every collective out of the post-optimization HLO
+(the same HloIndex machinery as tools/mfu_audit.py), and tabulates per
+collective: op kind, tensor bytes, mesh axis (recovered from replica_groups),
+count per step, and per-chip ring wire bytes.
+
+Cross-check (--check, run by CI): the dp path's reduce-combined bytes must
+match the analytic gradient bytes, and the zero1 path must additionally
+all-gather exactly the shardable parameter bytes — both within 10%. The check
+compares COMBINED TENSOR bytes, not instruction opcodes, because backends
+spell the same semantics differently (the CPU partitioner emits the zero1
+reduce-scatter as all-reduce + dynamic-slice; TPU emits a real
+reduce-scatter) — the reduced bytes are invariant under that choice.
+
+Ring wire formulas (per chip, group size p, full tensor B bytes):
+    all-reduce      2(p-1)/p * B
+    reduce-scatter   (p-1)/p * B
+    all-gather       (p-1)/p * B
+    all-to-all       (p-1)/p * B
+    collective-permute   B (one neighbor send)
+
+Also writes an analytic v5p-32 scaling projection (16 chips; v5e-measured
+step anchors from MFU_AUDIT_*.json scaled by public v5p spec ratios — every
+assumption recorded in the JSON).
+
+Usage:
+    python tools/comm_audit.py            # full audit -> COMM_AUDIT.json
+    python tools/comm_audit.py --check    # CI smoke: dp+zero1 cross-check only
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from tools.mfu_audit import HloIndex, _parse_shapes  # noqa: E402
+
+# --- collective opcodes (async "-start" halves count once; "-done" is free) --
+_COLLECTIVES = (
+    "all-reduce",
+    "reduce-scatter",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+)
+
+_GROUPS_EXPLICIT_RE = re.compile(r"replica_groups=\{(\{[\d,]+\}(?:,\{[\d,]+\})*)\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?"
+)
+
+
+def _parse_replica_groups(d):
+    """HLO replica_groups attr -> list of tuples of device ids (both the
+    explicit {{0,1},{2,3}} and the iota [G,S]<=[dims]T(perm) spellings)."""
+    m = _GROUPS_EXPLICIT_RE.search(d)
+    if m:
+        return [
+            tuple(int(x) for x in g.split(","))
+            for g in m.group(1)[1:-1].split("},{")
+        ]
+    m = _GROUPS_IOTA_RE.search(d)
+    if m:
+        n_groups, size = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            ids = ids.transpose([int(x) for x in m.group(4).split(",")])
+        return [tuple(g) for g in ids.reshape(n_groups, size).tolist()]
+    return []
+
+
+def _axis_groups(mesh):
+    """axis name -> frozenset of device-id groups that vary only that axis
+    (logical ids 0..n-1 in mesh.devices order — what replica_groups use)."""
+    sizes = [mesh.shape[a] for a in mesh.axis_names]
+    ids = np.arange(int(np.prod(sizes))).reshape(sizes)
+    out = {}
+    for k, name in enumerate(mesh.axis_names):
+        moved = np.moveaxis(ids, k, -1).reshape(-1, sizes[k])
+        out[name] = frozenset(frozenset(g) for g in moved.tolist())
+    return out
+
+
+def _wire_bytes(kind, full_bytes, p):
+    """Per-chip ring wire bytes for one instance."""
+    if p <= 1:
+        return 0
+    if kind == "all-reduce":
+        return 2 * (p - 1) * full_bytes // p
+    if kind == "collective-permute":
+        return full_bytes
+    return (p - 1) * full_bytes // p  # reduce-scatter / all-gather / all-to-all
+
+
+def audit_hlo(hlo_text, mesh):
+    """Parse one compiled module's collectives into table rows + totals."""
+    idx = HloIndex(hlo_text)
+    axis_groups = _axis_groups(mesh)
+    rows = {}
+    for name in idx.defs:
+        op = idx.opcode(name)
+        base = op[:-6] if op.endswith("-start") else op
+        if base not in _COLLECTIVES or op.endswith("-done"):
+            continue
+        d = idx.line(name)
+        res_bytes = sum(b for _, _, b in idx.result_shapes(name))
+        if base == "collective-permute":
+            # source_target_pairs, not replica_groups; the ring length is the
+            # cycle of the permutation (a 2-ring inside a dp2xsp2 mesh lists
+            # 8 pairs but each device's cycle closes after 2 hops)
+            m = re.search(r"source_target_pairs=\{\{(.*?)\}\}", d)
+            pairs = (
+                [tuple(int(x) for x in pr.split(","))
+                 for pr in m.group(1).split("},{")]
+                if m
+                else []
+            )
+            nxt = dict(pairs)
+            cycle, cur = [0], nxt.get(0)
+            while cur not in (None, 0) and len(cycle) <= len(pairs):
+                cycle.append(cur)
+                cur = nxt.get(cur)
+            p = len(cycle)
+            groups = [tuple(cycle)] if len(cycle) > 1 else None
+            full = res_bytes
+        else:
+            groups = _parse_replica_groups(d)
+            p = len(groups[0]) if groups else mesh.size
+            # result of reduce-scatter is the 1/p shard; of the others, the
+            # full combined tensor
+            full = res_bytes * p if base == "reduce-scatter" else res_bytes
+        axis = "?"
+        if groups:
+            gset = frozenset(frozenset(g) for g in groups)
+            for a, expect in axis_groups.items():
+                if gset <= expect:
+                    axis = a
+                    break
+            else:
+                axis = "mixed(%d)" % p
+        key = (base, axis, p, full)
+        if key in rows:
+            rows[key]["count"] += 1
+        else:
+            rows[key] = {
+                "op": base,
+                "axis": axis,
+                "group_size": p,
+                "tensor_bytes": full,
+                "wire_bytes_per_chip": _wire_bytes(base, full, p),
+                "count": 1,
+            }
+    table = sorted(
+        rows.values(),
+        key=lambda r: -r["wire_bytes_per_chip"] * r["count"],
+    )
+    totals = {
+        "reduced_bytes": sum(
+            r["tensor_bytes"] * r["count"]
+            for r in table
+            if r["op"] in ("all-reduce", "reduce-scatter")
+        ),
+        "gathered_bytes": sum(
+            r["tensor_bytes"] * r["count"] for r in table if r["op"] == "all-gather"
+        ),
+        "wire_bytes_per_chip": sum(
+            r["wire_bytes_per_chip"] * r["count"] for r in table
+        ),
+        "collective_count": sum(r["count"] for r in table),
+    }
+    return {"collectives": table, "totals": totals}
+
+
+# ---------------------------------------------------------------------------
+# model steps per parallelism path
+# ---------------------------------------------------------------------------
+
+
+def _build_mlp(d_in=64, d_hidden=128, classes=8):
+    """BN-free MLP whose every parameter (incl. the size-8 bias) has a
+    leading dim divisible by 8 — the whole gradient is shardable, so the
+    zero1 analytic check has no replicated remainder to excuse."""
+    import paddle_tpu.fluid as fluid
+
+    x = fluid.layers.data(name="x", shape=[d_in], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=d_hidden, act="relu")
+    logits = fluid.layers.fc(h, size=classes)
+    loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
+    return loss
+
+
+def _grad_bytes(program):
+    """Analytic f32 gradient bytes: one grad per trainable parameter."""
+    total = 0
+    for p in program.global_block().all_parameters():
+        if getattr(p, "trainable", True):
+            total += int(np.prod(p.shape)) * 4
+    return total
+
+
+def _shardable_param_bytes(program, mesh, axis="dp"):
+    from paddle_tpu.parallel.collectives import zero1_shardable
+
+    total = 0
+    for p in program.global_block().all_parameters():
+        if getattr(p, "trainable", True) and zero1_shardable(p.shape, mesh, axis):
+            total += int(np.prod(p.shape)) * 4
+    return total
+
+
+def _mlp_step_hlo(reduce_strategy):
+    """Compile+run one MLP Adam step under the given ReduceStrategy; return
+    (hlo_text, mesh, main_program)."""
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.parallel_executor import BuildStrategy
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        loss = _build_mlp()
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    strat = BuildStrategy()
+    strat.reduce_strategy = reduce_strategy
+    n = jax.device_count()
+    rng = np.random.RandomState(0)
+    x = rng.randn(4 * n, 64).astype("float32")
+    y = rng.randint(0, 8, (4 * n, 1)).astype("int64")
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        fluid.Executor().run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, build_strategy=strat,
+            scope=scope,
+        )
+        pe.run(fetch_list=[loss.name], feed={"x": x, "y": y})
+        hlo = pe.compiled_hlo()
+        mesh = pe._mesh
+    return hlo, mesh, main
+
+
+def _attention_step_hlo():
+    """dp x tp x sp x ep attention-LM step (the dryrun_multichip stage-2 model)."""
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.parallel import MeshConfig, shard_parameter
+
+    n = jax.device_count()
+    if n % 8:
+        return None, None
+    cfg = MeshConfig(dp=n // 8, tp=2, sp=2, ep=2)
+    VOCAB, D, HEADS, T = 64, 16, 2, 8
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        tok = fluid.layers.data(
+            name="tok", shape=[-1, T, 1], dtype="int64", append_batch_size=False
+        )
+        lbl = fluid.layers.data(
+            name="lbl", shape=[-1, 1], dtype="int64", append_batch_size=False
+        )
+        emb = fluid.layers.distributed_embedding(tok, size=[VOCAB, D])
+        qkv = fluid.layers.fc(emb, size=3 * D, num_flatten_dims=2, bias_attr=False)
+        for p in main.global_block().all_parameters():
+            if p.shape == (D, 3 * D):
+                shard_parameter(p, (None, "tp"))
+        q, k, v = fluid.layers.split(qkv, 3, dim=2)
+
+        def heads(x):
+            r = fluid.layers.reshape(x, [0, 0, HEADS, D // HEADS])
+            return fluid.layers.transpose(r, [0, 2, 1, 3])
+
+        att = fluid.layers.ring_attention(heads(q), heads(k), heads(v), causal=True)
+        att = fluid.layers.reshape(
+            fluid.layers.transpose(att, [0, 2, 1, 3]), [0, 0, D]
+        )
+        pooled = fluid.layers.reduce_mean(att, dim=[1])
+        logits = fluid.layers.fc(pooled, size=4)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, lbl))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    dp = cfg.resolve(n)["dp"]
+    rng = np.random.RandomState(1)
+    toks = rng.randint(0, VOCAB, (2 * dp, T, 1)).astype("int64")
+    lbls = rng.randint(0, 4, (2 * dp, 1)).astype("int64")
+    scope = Scope(seed=1)
+    with scope_guard(scope):
+        fluid.Executor().run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope, mesh_config=cfg,
+        )
+        pe.run(fetch_list=[loss.name], feed={"tok": toks, "lbl": lbls})
+        hlo = pe.compiled_hlo()
+        mesh = pe._mesh
+    return hlo, mesh
+
+
+def _gpipe_step_hlo():
+    """dp x pp GPipe train step (the dryrun_multichip stage-3 computation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel import MeshConfig, gpipe, make_mesh
+
+    n = jax.device_count()
+    if n % 4:
+        return None, None
+    pp = 4
+    mesh = make_mesh(MeshConfig(dp=n // pp, pp=pp))
+    D = 16
+    rng = np.random.RandomState(5)
+    params = {
+        "w": jnp.asarray(rng.randn(8, D, D).astype("float32") * 0.3),
+        "b": jnp.asarray(rng.randn(8, D).astype("float32") * 0.1),
+    }
+    x = jnp.asarray(rng.randn(4 * (n // pp), D).astype("float32"))
+    tgt = jnp.asarray((rng.randn(4 * (n // pp), D) * 0.1).astype("float32"))
+
+    def stage(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def step(params):
+        def loss_fn(p):
+            y = gpipe(stage, p, x, n_micro=4, mesh=mesh)
+            return jnp.mean((y - tgt) ** 2)
+
+        l, g = jax.value_and_grad(loss_fn)(params)
+        new = jax.tree_util.tree_map(lambda p, gg: p - 0.1 * gg, params, g)
+        return l, new
+
+    hlo = jax.jit(step).lower(params).compile().as_text()
+    return hlo, mesh
+
+
+# ---------------------------------------------------------------------------
+# analytic cross-checks (backend-robust: combined tensor bytes, not opcodes)
+# ---------------------------------------------------------------------------
+
+
+def check_dp(audit, grad_bytes, tol=0.10):
+    """The dp step must reduce-combine exactly the gradients (+ the scalar
+    loss fetch, <<1%)."""
+    reduced = audit["totals"]["reduced_bytes"]
+    err = abs(reduced - grad_bytes) / grad_bytes
+    assert err <= tol, (
+        "dp reduced bytes %d vs analytic grad bytes %d (%.1f%% off)"
+        % (reduced, grad_bytes, 100 * err)
+    )
+    return err
+
+
+def check_zero1(audit, grad_bytes, shardable_param_bytes, tol=0.10):
+    """The zero1 step reduce-combines the same gradient bytes AND gathers
+    back exactly the shardable parameter bytes (each updated shard returns
+    to every rank once)."""
+    reduced = audit["totals"]["reduced_bytes"]
+    gathered = audit["totals"]["gathered_bytes"]
+    r_err = abs(reduced - grad_bytes) / grad_bytes
+    g_err = abs(gathered - shardable_param_bytes) / shardable_param_bytes
+    assert r_err <= tol, (
+        "zero1 reduced bytes %d vs analytic grad bytes %d (%.1f%% off)"
+        % (reduced, grad_bytes, 100 * r_err)
+    )
+    assert g_err <= tol, (
+        "zero1 gathered bytes %d vs shardable param bytes %d (%.1f%% off)"
+        % (gathered, shardable_param_bytes, 100 * g_err)
+    )
+    return r_err, g_err
+
+
+def analytic_wire(grad_bytes, shardable_param_bytes, p):
+    """Ideal ring wire per chip for both strategies. zero1's total equals the
+    all-reduce total when every gradient is shardable: RS(G) + AG(P) =
+    (p-1)/p*(G+P) = 2(p-1)/p*G for G == P — the ZeRO-1 claim that sharding
+    optimizer state costs no extra wire."""
+    ar = 2 * (p - 1) * grad_bytes // p
+    rest = grad_bytes - shardable_param_bytes  # non-shardable grads stay AR
+    z1 = (
+        (p - 1) * shardable_param_bytes // p  # reduce-scatter(grad shard)
+        + (p - 1) * shardable_param_bytes // p  # all-gather(param)
+        + 2 * (p - 1) * rest // p
+    )
+    return {"allreduce_wire_per_chip": ar, "zero1_wire_per_chip": z1}
+
+
+# ---------------------------------------------------------------------------
+# v5p-32 projection (analytic; all inputs recorded)
+# ---------------------------------------------------------------------------
+
+# anchors measured on the v5e bench chip (MFU_AUDIT_*.json in repo root)
+_V5E_ANCHORS = {
+    "resnet50_bs256": {
+        "wall_ms": 117.8,
+        "hlo_tflops": 6.01,
+        "hlo_gb": 127.5,
+        "images_per_step": 256,
+        "optimizer": "momentum_f32",
+        "source": "MFU_AUDIT_resnet.json",
+    },
+    "transformer_8x1024_d2048_L4": {
+        "wall_ms": 218.4,
+        "hlo_tflops": 26.31,
+        "hlo_gb": 182.59,
+        "optimizer": "adam_bf16_moments",
+        "source": "MFU_AUDIT_transformer.json",
+    },
+}
+
+_ASSUMPTIONS = {
+    "v5e_peak_mm_tflops": 192.0,  # measured probe (tools/mfu_audit.py)
+    "v5e_peak_bw_gbs": 676.0,  # measured probe
+    "v5p_peak_bf16_tflops": 459.0,  # public spec sheet
+    "v5p_hbm_gbs": 2765.0,  # public spec sheet
+    "v5p_hbm_gb_per_chip": 95,
+    "v5p_ici_gbs_per_chip": 600.0,  # 4800 Gbit/s aggregate per chip
+    "v5p_ici_efficiency": 0.66,  # achievable fraction of nominal ICI
+    "v5p32_chips": 16,  # a v5p-32 slice = 32 TensorCores = 16 chips
+    "method": (
+        "per-chip step time bracketed by scaling the measured v5e wall "
+        "by the compute-peak ratio (if MXU-bound) and the HBM-bandwidth "
+        "ratio (if HBM-bound); 16-way dp adds the gradient ring time, "
+        "reported overlapped (max) and serial (sum)"
+    ),
+}
+
+
+def _project_model(anchor, param_bytes, opt_state_bytes_replicated):
+    a = _ASSUMPTIONS
+    chips = a["v5p32_chips"]
+    f_compute = a["v5p_peak_bf16_tflops"] / a["v5e_peak_mm_tflops"]
+    f_hbm = a["v5p_hbm_gbs"] / a["v5e_peak_bw_gbs"]
+    # per-chip step-time bracket: the step speeds up by at least the smaller
+    # ratio and at most the larger, whichever resource bounds it
+    t_fast_ms = anchor["wall_ms"] / max(f_compute, f_hbm)
+    t_slow_ms = anchor["wall_ms"] / min(f_compute, f_hbm)
+    grad_bytes = param_bytes  # f32 grads, one per param element
+    wire = 2 * (chips - 1) * grad_bytes // chips  # AR == zero1 RS+AG wire
+    ici_gbs = a["v5p_ici_gbs_per_chip"] * a["v5p_ici_efficiency"]
+    t_ici_ms = wire / ici_gbs / 1e6
+    out = {
+        "anchor": anchor,
+        "param_bytes": param_bytes,
+        "grad_allreduce_wire_per_chip_bytes": wire,
+        "ici_ms_per_step": round(t_ici_ms, 3),
+        "per_chip_step_ms_range": [round(t_fast_ms, 1), round(t_slow_ms, 1)],
+        "step_ms_overlapped_range": [
+            round(max(t_fast_ms, t_ici_ms), 1),
+            round(max(t_slow_ms, t_ici_ms), 1),
+        ],
+        "step_ms_serial_range": [
+            round(t_fast_ms + t_ici_ms, 1),
+            round(t_slow_ms + t_ici_ms, 1),
+        ],
+        "optimizer_state_bytes_per_chip_replicated": opt_state_bytes_replicated,
+        "optimizer_state_bytes_per_chip_zero1": opt_state_bytes_replicated
+        // chips,
+    }
+    if "images_per_step" in anchor:
+        per_chip = anchor["images_per_step"]
+        out["v5p32_images_per_sec_range"] = [
+            round(chips * per_chip / (t_slow_ms + t_ici_ms) * 1e3),
+            round(chips * per_chip / max(t_fast_ms, t_ici_ms) * 1e3),
+        ]
+    else:
+        tf = anchor["hlo_tflops"]
+        out["v5p32_tflops_per_sec_range"] = [
+            round(chips * tf / (t_slow_ms + t_ici_ms) * 1e3, 1),
+            round(chips * tf / max(t_fast_ms, t_ici_ms) * 1e3, 1),
+        ]
+    return out
+
+
+def build_projection():
+    """Param/state bytes come from the ACTUAL bench programs (IR only — no
+    step is run), so the projection tracks the models as they evolve."""
+    import bench
+
+    main_r, _startup, _loss = bench.build(256)
+    p_r = _grad_bytes(main_r)
+    main_t, _startup_t, _feed, _loss_t, _flops = bench.build_transformer()
+    p_t = _grad_bytes(main_t)
+    return {
+        "assumptions": _ASSUMPTIONS,
+        "resnet50": _project_model(
+            _V5E_ANCHORS["resnet50_bs256"], p_r,
+            # Momentum: one f32 velocity per param element
+            p_r,
+        ),
+        "transformer": _project_model(
+            _V5E_ANCHORS["transformer_8x1024_d2048_L4"], p_t,
+            # Adam with bf16 moments: two moments at 2 bytes per element ==
+            # one f32-equivalent copy of the params
+            p_t,
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="CI smoke: dp+zero1 audits + analytic cross-check "
+                         "only, no file writes")
+    ap.add_argument("--out", default="COMM_AUDIT.json")
+    args = ap.parse_args()
+
+    from paddle_tpu.platform_setup import force_virtual_cpu_devices
+
+    force_virtual_cpu_devices(8)
+    import jax
+
+    from paddle_tpu.parallel_executor import ReduceStrategy
+
+    n = jax.device_count()
+    hlo_dp, mesh_dp, prog = _mlp_step_hlo(ReduceStrategy.AllReduce)
+    hlo_z1, mesh_z1, _ = _mlp_step_hlo(ReduceStrategy.Reduce)
+    dp_audit = audit_hlo(hlo_dp, mesh_dp)
+    z1_audit = audit_hlo(hlo_z1, mesh_z1)
+
+    grad_bytes = _grad_bytes(prog)
+    shardable = _shardable_param_bytes(prog, mesh_dp)
+    dp_err = check_dp(dp_audit, grad_bytes)
+    z1_r_err, z1_g_err = check_zero1(z1_audit, grad_bytes, shardable)
+    print(
+        "check ok on %d devices: dp reduced within %.2f%%, zero1 reduced "
+        "within %.2f%% / gathered within %.2f%% of analytic"
+        % (n, 100 * dp_err, 100 * z1_r_err, 100 * z1_g_err)
+    )
+    if args.check:
+        return
+
+    out = {
+        "devices": n,
+        "model": "MLP 64->128->8, Adam (dp/zero1 paths)",
+        "analytic": dict(
+            grad_bytes=grad_bytes,
+            shardable_param_bytes=shardable,
+            **analytic_wire(grad_bytes, shardable, mesh_dp.shape["dp"]),
+        ),
+        "paths": {"dp_allreduce": dp_audit, "zero1": z1_audit},
+        "check_errors_pct": {
+            "dp_reduced": round(100 * dp_err, 2),
+            "zero1_reduced": round(100 * z1_r_err, 2),
+            "zero1_gathered": round(100 * z1_g_err, 2),
+        },
+    }
+
+    hlo_att, mesh_att = _attention_step_hlo()
+    if hlo_att:
+        out["paths"]["tp_sp_ep"] = audit_hlo(hlo_att, mesh_att)
+    hlo_pp, mesh_pp = _gpipe_step_hlo()
+    if hlo_pp:
+        out["paths"]["dp_pp_gpipe"] = audit_hlo(hlo_pp, mesh_pp)
+
+    out["v5p32_projection"] = build_projection()
+
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", args.out)
+    fmt = "%-12s %-18s %-8s %5s %12s %12s %5s"
+    for path, audit in out["paths"].items():
+        print("\n[%s] wire/chip/step = %d B" % (
+            path, audit["totals"]["wire_bytes_per_chip"]))
+        print(fmt % ("path", "op", "axis", "p", "tensor_B", "wire_B/chip",
+                     "count"))
+        for r in audit["collectives"]:
+            print(fmt % (path, r["op"], r["axis"], r["group_size"],
+                         r["tensor_bytes"], r["wire_bytes_per_chip"],
+                         r["count"]))
+
+
+if __name__ == "__main__":
+    main()
