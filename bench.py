@@ -11,6 +11,7 @@ headline for this model.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -621,7 +622,131 @@ def run_zero1_bench(d=512, depth=4, bs_per_dev=16, steps=12, warmup=3):
     }
 
 
+def run_pp_bench(dp=2, pp=4, m1=4, m2=16, mb=8, steps=8, warmup=2):
+    """Program-level pipeline parallelism (ParallelExecutor + MeshConfig(pp))
+    on a dp2×pp4 mesh: an encoder-only Transformer stack pinned one layer
+    per stage (framework.device_guard), trained through the GPipe schedule
+    at two microbatch counts m1 < m2 with the PER-MICROBATCH size fixed.
+
+    The bubble is MEASURED, not asserted: with t(m) = c + (m+p-1)·τ the
+    slope τ = (t(m2)-t(m1))/(m2-m1) is the steady-state per-tick time, so
+    bubble(m1) = 1 - m1·τ/t(m1), compared against the analytic GPipe bound
+    (p-1)/(m1+p-1) (docs/parallelism.md). A measured/analytic ratio far
+    from 1 means the schedule is losing time to something other than
+    pipeline fill/drain. Returns None below dp×pp devices."""
+    import jax
+
+    if jax.device_count() < dp * pp:
+        return None
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.models.transformer import encoder_layer
+    from paddle_tpu.parallel import MeshConfig
+    from paddle_tpu.parallel_executor import BuildStrategy, ExecutionStrategy
+
+    vocab, t_len, d, n_head, d_inner = 512, 16, 64, 4, 256
+    n_layer = pp  # one encoder layer per stage
+
+    def build():
+        cfg = {"d_key": d // n_head, "d_value": d // n_head, "d_model": d,
+               "n_head": n_head, "d_inner": d_inner, "dropout": 0.0}
+        word = fluid.layers.data(name="word", shape=[-1, t_len, 1],
+                                 dtype="int64", append_batch_size=False)
+        pos = fluid.layers.data(name="pos", shape=[-1, t_len, 1],
+                                dtype="int64", append_batch_size=False)
+        label = fluid.layers.data(name="label", shape=[-1, 1],
+                                  dtype="int64", append_batch_size=False)
+        with framework.device_guard("pp:0"):
+            h = fluid.layers.elementwise_add(
+                fluid.layers.embedding(word, size=[vocab, d]),
+                fluid.layers.embedding(pos, size=[t_len, d]),
+            )
+            h = encoder_layer(h, None, cfg)
+        for k in range(1, n_layer):
+            with framework.device_guard("pp:%d" % k):
+                h = encoder_layer(h, None, cfg)
+        with framework.device_guard("pp:%d" % (n_layer - 1)):
+            pooled = fluid.layers.reduce_mean(h, dim=1)
+            logits = fluid.layers.fc(pooled, size=16)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    label=label, logits=logits
+                )
+            )
+        return loss
+
+    def one(m, schedule):
+        b = dp * m * mb
+        rng = np.random.RandomState(0)
+        feed = {
+            "word": rng.randint(0, vocab, (b, t_len, 1)).astype("int64"),
+            "pos": np.tile(
+                np.arange(t_len)[None, :, None], (b, 1, 1)
+            ).astype("int64"),
+            "label": rng.randint(0, 16, (b, 1)).astype("int64"),
+        }
+        main_p, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+            loss = build()
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        es = ExecutionStrategy()
+        es.pipeline_schedule = schedule
+        es.num_microbatches = m
+        exe = fluid.Executor(fluid.TPUPlace())
+        with scope_guard(Scope(seed=0)):
+            exe.run(startup)
+            pe = fluid.ParallelExecutor(
+                loss_name=loss.name, main_program=main_p,
+                mesh_config=MeshConfig(dp=dp, pp=pp),
+                exec_strategy=es, build_strategy=BuildStrategy(),
+            )
+            for _ in range(warmup):
+                (l,) = pe.run(fetch_list=[loss.name], feed=feed)
+            np.asarray(l)
+            # min-over-windows: harness noise only ever ADDS time
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    (l,) = pe.run(fetch_list=[loss.name], feed=feed)
+                np.asarray(l)
+                best = min(best, (time.perf_counter() - t0) / steps)
+        return best
+
+    t1 = one(m1, "gpipe")
+    t2 = one(m2, "gpipe")
+    tau = (t2 - t1) / (m2 - m1)
+    measured = 1 - m1 * tau / t1
+    analytic = (pp - 1) / (m1 + pp - 1)
+    t1_1f1b = one(m1, "1f1b")
+    return {
+        "pp_mesh": "dp%d x pp%d" % (dp, pp),
+        "pp_schedule": "gpipe",
+        "pp_microbatch_rows_per_shard": mb,
+        "pp_step_ms_m%d" % m1: round(t1 * 1e3, 2),
+        "pp_step_ms_m%d" % m2: round(t2 * 1e3, 2),
+        "pp_step_ms_m%d_1f1b" % m1: round(t1_1f1b * 1e3, 2),
+        "pp_tick_ms": round(tau * 1e3, 3),
+        "pp_bubble_measured_m%d" % m1: round(measured, 3),
+        "pp_bubble_analytic_m%d" % m1: round(analytic, 3),
+        "pp_bubble_measured_over_analytic": round(measured / analytic, 2),
+    }
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "pp":
+        # standalone pp-bubble evidence pass (scripts/build_and_test.sh):
+        # writes MULTICHIP_PP.json next to this file
+        rec = run_pp_bench()
+        if rec is None:
+            raise SystemExit("pp bench needs a dp*pp-device mesh")
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "MULTICHIP_PP.json")
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=1)
+        print(json.dumps(rec, indent=1))
+        return
     batch_size = int(sys.argv[1]) if len(sys.argv) > 1 else 256
     ips = single_ips = pyreader_ips = pyreader_u8_ips = None
     ladder = [batch_size] + [b for b in (128, 64, 32) if b < batch_size]

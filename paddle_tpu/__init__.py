@@ -52,6 +52,7 @@ from .framework import (
     Variable,
     default_main_program,
     default_startup_program,
+    device_guard,
     name_scope,
     program_guard,
 )

@@ -36,11 +36,43 @@ class Evaluator:
 
 class ChunkEvaluator(Evaluator):
     """Chunk F1 over (num_infer, num_label, num_correct) fetched per batch
-    (reference evaluator.py:126)."""
+    (reference evaluator.py:126). Given input/label variables it appends the
+    chunk_eval op to the current program (layers.nn.chunk_eval), so the
+    per-batch counts are computed in-framework — fetch `self.metrics` each
+    step and pass the three counts to update()."""
 
-    def __init__(self, input=None, label=None, chunk_scheme=None, num_chunk_types=None):
+    def __init__(
+        self,
+        input=None,
+        label=None,
+        chunk_scheme=None,
+        num_chunk_types=None,
+        excluded_chunk_types=None,
+        seq_length=None,
+    ):
         super().__init__("chunk_eval")
         self.metric = _metrics.ChunkEvaluator("chunk_eval")
+        self.metrics = ()
+        if input is not None:
+            from .layers import nn as _nn
+
+            (
+                self.precision,
+                self.recall,
+                self.f1_score,
+                num_infer,
+                num_label,
+                num_correct,
+            ) = _nn.chunk_eval(
+                input,
+                label,
+                chunk_scheme=chunk_scheme,
+                num_chunk_types=num_chunk_types,
+                excluded_chunk_types=excluded_chunk_types,
+                seq_length=seq_length,
+            )
+            # per-batch count vars, in update()'s argument order
+            self.metrics = (num_infer, num_label, num_correct)
 
     def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
         self.metric.update(num_infer_chunks, num_label_chunks, num_correct_chunks)

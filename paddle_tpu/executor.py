@@ -246,6 +246,7 @@ class _CompiledBlock:
                 feed_want[_n] = jnp.dtype(
                     jnp.bfloat16 if _v.dtype == "bfloat16" else np.dtype(_v.dtype)
                 )
+        self._feed_want = feed_want
 
         # ZeRO-1 active only when the mesh actually has >1 rank on the axis
         # (a dp=1 mesh degrades to the plain replicated path, same program)
@@ -257,28 +258,9 @@ class _CompiledBlock:
             else None
         )
         self.zero1_axis = z1
+        self._feed_ranks = dict(feed_ranks or {})
 
-        def run(feeds, ro_state, mut_state, rng_key):
-            feeds = {
-                n: (
-                    v.astype(feed_want[n])
-                    if n in feed_want and v.dtype != feed_want[n]
-                    else v
-                )
-                for n, v in feeds.items()
-            }
-            env = {}
-            env.update(ro_state)
-            env.update(mut_state)
-            env.update(feeds)
-            ctx = registry.LowerCtx(rng_key, mesh=mesh, zero1_axis=z1)
-            registry.lower_ops(ctx, ops_, env)
-            fetches = [env[n] for n in self.fetch_names]
-            new_mut = {n: env[n] for n in self.mut_names}
-            # an op may legally omit a declared output slot (lowering returns
-            # None) — only bind names that actually materialized
-            created = {n: env[n] for n in self.created_persistables if n in env}
-            return fetches, new_mut, created, ctx.key
+        run = self._build_run(ops_, feed_want, mesh, z1)
 
         self.fn = run  # un-jitted lowering, reusable by __graft_entry__ et al.
         # donate the mutated-state pytree: params update in place on device
@@ -362,6 +344,34 @@ class _CompiledBlock:
                 out_shardings=out_sh,
             )
 
+    def _build_run(self, ops_, feed_want, mesh, z1):
+        """The block's lowering closure (overridden by _PipelinedBlock, which
+        replaces the straight-line interpretation with the pp schedule)."""
+
+        def run(feeds, ro_state, mut_state, rng_key):
+            feeds = {
+                n: (
+                    v.astype(feed_want[n])
+                    if n in feed_want and v.dtype != feed_want[n]
+                    else v
+                )
+                for n, v in feeds.items()
+            }
+            env = {}
+            env.update(ro_state)
+            env.update(mut_state)
+            env.update(feeds)
+            ctx = registry.LowerCtx(rng_key, mesh=mesh, zero1_axis=z1)
+            registry.lower_ops(ctx, ops_, env)
+            fetches = [env[n] for n in self.fetch_names]
+            new_mut = {n: env[n] for n in self.mut_names}
+            # an op may legally omit a declared output slot (lowering returns
+            # None) — only bind names that actually materialized
+            created = {n: env[n] for n in self.created_persistables if n in env}
+            return fetches, new_mut, created, ctx.key
+
+        return run
+
     def __call__(self, scope, feed_arrays):
         ro = {n: scope.vars[n] for n in self.ro_names}
         mut = {n: scope.vars[n] for n in self.mut_names}
@@ -372,6 +382,593 @@ class _CompiledBlock:
         scope.vars.update(created)
         scope.rng_key = new_key
         return fetches
+
+
+class _PipelinedBlock(_CompiledBlock):
+    """Pipeline-parallel lowering of a whole training block over the mesh's
+    'pp' axis (ParallelExecutor with MeshConfig(pp>1)).
+
+    Where _CompiledBlock interprets the block straight-line under GSPMD,
+    this block re-expresses it as a microbatch pipeline:
+
+    1. ops split by op_role: forward (Forward/Loss) vs backward (skipped —
+       the schedule differentiates the forward itself) vs optimizer
+       (Optimize/LRSched, re-run verbatim after the pipeline so ZeRO-1,
+       bf16 moments, lr schedules and clipping compose unchanged);
+    2. the forward op list is cut into pp contiguous stages — explicit
+       `device_guard("pp:k")` annotations win, otherwise
+       parallel.partition balances analytic per-op roofline time + param
+       bytes over the LEGAL cut points (every value crossing a cut must be
+       microbatch-major so it can ride the packed boundary buffer);
+    3. each stage's params stay canonical named tensors (replicated, the
+       scope's layout) and enter the shard_map as a plain dict with P()
+       specs — per-stage param pytrees are heterogeneous, and each rank's
+       branch reads only its own stage's entries; inter-stage boundary
+       activations are packed into a uniform [mb, K] f32 buffer;
+    4. inside one shard_map over the full mesh, lax.switch on
+       axis_index('pp') dispatches this rank's stage subgraph
+       (registry.lower_ops on its op slice), and parallel.pipeline's
+       GPipe or 1F1B engine runs the schedule; 'dp' keeps its meaning —
+       each dp slice pipelines its own batch shard, grads pmean over dp;
+    5. gradients come back as a dict (assembled across stages by the
+       shard_map transpose / an explicit psum over 'pp'), are bound under
+       the program's own `<param>@GRAD` names, and the block's optimizer
+       ops run through registry.lower_ops exactly as in _CompiledBlock —
+       same scope layout, so checkpoint save/resume, donation and the
+       ZeRO-1 dp tier are untouched.
+
+    Contracts/limits (all raised with guidance): the loss (and any fetched
+    forward value) must land in the LAST stage; forward ops may not write
+    persistable state (running stats); a parameter may be read by only one
+    stage; fetched last-stage values are combined across microbatches by
+    MEAN (exact for batch-mean losses/metrics).
+    """
+
+    def __init__(self, program, block, feed_names, fetch_names, scope,
+                 mesh, feed_ranks=None, zero1_axis=None, loss_name=None,
+                 n_micro=None, schedule="gpipe"):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                "pipeline schedule must be 'gpipe' or '1f1b', got %r"
+                % (schedule,)
+            )
+        if "pp" not in mesh.shape or mesh.shape["pp"] < 2:
+            raise ValueError("_PipelinedBlock needs a mesh with pp >= 2")
+        self._pp_opts = {
+            "loss_name": loss_name, "n_micro": n_micro, "schedule": schedule,
+        }
+        self.stage_plan = None  # filled at first trace
+        super().__init__(
+            program, block, feed_names, fetch_names, scope,
+            mesh=mesh, feed_ranks=feed_ranks, zero1_axis=zero1_axis,
+        )
+
+    # packable boundary dtypes: everything is carried as f32 in the boundary
+    # buffer via value-preserving casts (bf16/f16/bool/small ints are exact;
+    # int32 is exact below 2^24 — larger ids crossing a cut need device_guard)
+    _PACK_DTYPES = frozenset([
+        "float32", "bfloat16", "float16", "bool",
+        "int8", "uint8", "int16", "int32", "uint32",
+    ])
+
+    def _build_run(self, ops_, feed_want, mesh, z1):
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from .framework import GRAD_VAR_SUFFIX, OpRole
+        from .parallel import partition as pp_partition
+        from .parallel.collectives import SHARD_MAP_CHECK_KW, shard_map
+        from .parallel.pipeline import pipeline_1f1b_spmd, pipeline_fwd_spmd
+
+        pp = mesh.shape["pp"]
+        opts = self._pp_opts
+        self_ = self
+
+        def role(op):
+            return int(op.attrs.get(OpRole.OP_ROLE_KEY, 0))
+
+        skip_mask = OpRole.Backward | OpRole.Optimize | OpRole.LRSched
+        fwd_ops = [op for op in ops_ if not role(op) & skip_mask]
+        opt_ops = [
+            op for op in ops_
+            if role(op) & (OpRole.Optimize | OpRole.LRSched)
+        ]
+        if not fwd_ops:
+            raise RuntimeError("pipeline lowering: block has no forward ops")
+
+        # trainable params = the optimizer section's Param slots
+        param_set = set()
+        for op in opt_ops:
+            param_set.update(op.inputs.get("Param", ()))
+        state_set = set(self.ro_names) | set(self.mut_names)
+        param_set &= state_set
+
+        fwd_written_state = sorted(
+            {n for op in fwd_ops for n in op.output_arg_names} & state_set
+        )
+        if fwd_written_state:
+            raise NotImplementedError(
+                "pipeline-parallel lowering cannot thread forward-op state "
+                "updates (%s) through the microbatch schedule; run these on "
+                "a non-pp mesh" % (fwd_written_state,)
+            )
+
+        loss_name = opts["loss_name"]
+        if loss_name is None:
+            for op in fwd_ops:
+                if role(op) & OpRole.Loss:
+                    outs = [
+                        n for n in op.output_arg_names if n != EMPTY_VAR_NAME
+                    ]
+                    if outs:
+                        loss_name = outs[0]
+                        break
+        if loss_name is None:
+            raise ValueError(
+                "pipeline parallelism needs the loss: pass loss_name= to "
+                "ParallelExecutor (no op in the block carries the Loss role)"
+            )
+
+        feed_ranks = self._feed_ranks
+        fetch_names = list(self.fetch_names)
+
+        def run(feeds, ro_state, mut_state, rng_key):
+            feeds = {
+                n: (
+                    v.astype(feed_want[n])
+                    if n in feed_want and v.dtype != feed_want[n]
+                    else v
+                )
+                for n, v in feeds.items()
+            }
+            dp = mesh.shape.get("dp", 1)
+            batch_feeds = {
+                n: v for n, v in feeds.items()
+                if np.ndim(v) > 0 and feed_ranks.get(n, np.ndim(v)) > 0
+            }
+            scalar_feeds = {
+                n: v for n, v in feeds.items() if n not in batch_feeds
+            }
+            if not batch_feeds:
+                raise ValueError(
+                    "pipeline lowering needs at least one batch-major feed"
+                )
+            B = next(iter(batch_feeds.values())).shape[0]
+            for n, v in batch_feeds.items():
+                if v.shape[0] != B:
+                    raise ValueError(
+                        "batch feeds disagree on batch size: %r has %d, "
+                        "expected %d" % (n, v.shape[0], B)
+                    )
+            if B % dp:
+                raise ValueError(
+                    "global batch %d not divisible by dp=%d" % (B, dp)
+                )
+            b_local = B // dp
+            m = opts["n_micro"] or pp
+            if b_local % m:
+                raise ValueError(
+                    "dp-local batch %d not divisible into %d microbatches "
+                    "(set ExecutionStrategy.num_microbatches)" % (b_local, m)
+                )
+            mb = b_local // m
+
+            state_env = {}
+            state_env.update(ro_state)
+            state_env.update(mut_state)
+
+            # ---- abstract forward pass at microbatch scale: per-op output
+            # avals drive cut legality, cost weights and packing layouts
+            mb_feed_avals = {
+                n: jax.ShapeDtypeStruct((mb,) + tuple(v.shape[1:]), v.dtype)
+                for n, v in batch_feeds.items()
+            }
+
+            def absrun(bf, sf, st, key):
+                env = {}
+                env.update(st)
+                env.update(sf)
+                env.update(bf)
+                ctx = registry.LowerCtx(key, mesh=None)
+                recs = []
+                for op in fwd_ops:
+                    registry.lower_ops(ctx, [op], env)
+                    recs.append({
+                        n: env[n]
+                        for n in op.output_arg_names
+                        if n != EMPTY_VAR_NAME and n in env
+                    })
+                return recs
+
+            recs = jax.eval_shape(
+                absrun, mb_feed_avals, scalar_feeds, state_env, rng_key
+            )
+
+            producers = {}  # name -> [(op_idx, aval)] in program order
+            for i, rec in enumerate(recs):
+                for n, av in rec.items():
+                    producers.setdefault(n, []).append((i, av))
+            if loss_name not in producers:
+                raise ValueError(
+                    "loss %r is not produced by the forward ops" % loss_name
+                )
+            loss_idx = producers[loss_name][-1][0]
+            n_ops = len(fwd_ops)
+
+            # live values crossing each candidate cut k (between op k, k+1)
+            crossing = [dict() for _ in range(max(n_ops - 1, 0))]
+            for j, op in enumerate(fwd_ops):
+                for n in op.input_arg_names:
+                    if n == EMPTY_VAR_NAME:
+                        continue
+                    plist = [
+                        (i, av) for (i, av) in producers.get(n, []) if i < j
+                    ]
+                    if not plist:
+                        continue  # fed / state: available on every rank
+                    i, av = plist[-1]
+                    for k in range(i, min(j, n_ops - 1)):
+                        crossing[k][n] = av
+
+            def packable(av):
+                return (
+                    len(av.shape) >= 1
+                    and av.shape[0] == mb
+                    and str(jnp.dtype(av.dtype)) in self_._PACK_DTYPES
+                )
+
+            legal = [
+                k for k in range(n_ops - 1)
+                if k < loss_idx  # the loss must stay in the LAST stage
+                and all(packable(av) for av in crossing[k].values())
+            ]
+
+            # ---- stage assignment: device_guard override, else balanced cut
+            stages = pp_partition.stages_from_attrs(fwd_ops, pp)
+            if stages is None:
+                def aval_of(n, j):
+                    plist = [
+                        (i, av) for (i, av) in producers.get(n, []) if i < j
+                    ]
+                    if plist:
+                        return plist[-1][1]
+                    v = feeds.get(n)
+                    if v is not None and n in mb_feed_avals:
+                        return mb_feed_avals[n]
+                    if v is None:
+                        v = state_env.get(n)
+                    if v is None:
+                        return None
+                    return jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+
+                weights = []
+                for j, op in enumerate(fwd_ops):
+                    in_avals = {
+                        slot: [
+                            aval_of(n, j)
+                            for n in names if n != EMPTY_VAR_NAME
+                        ]
+                        for slot, names in op.inputs.items()
+                    }
+                    out_avals = {
+                        slot: [recs[j].get(n) for n in names]
+                        for slot, names in op.outputs.items()
+                    }
+                    weights.append(
+                        pp_partition.analytic_op_time_us(
+                            op.type, in_avals, out_avals
+                        )
+                    )
+                # param read bytes, charged to the op of first use, so a
+                # weight-heavy stage is as expensive as a FLOP-heavy one
+                first_use = {}
+                for j, op in enumerate(fwd_ops):
+                    for n in op.input_arg_names:
+                        if n in param_set and n not in first_use:
+                            first_use[n] = j
+                for n, j in first_use.items():
+                    v = state_env[n]
+                    pbytes = (
+                        int(np.prod(np.shape(v)))
+                        * np.dtype(v.dtype).itemsize
+                    )
+                    weights[j] += pbytes / 676.0e3
+                stages = pp_partition.balanced_partition(weights, legal, pp)
+            else:
+                legal_set = set(legal)
+                for k in range(n_ops - 1):
+                    if stages[k + 1] != stages[k] and k not in legal_set:
+                        bad = {
+                            n: (tuple(av.shape), str(av.dtype))
+                            for n, av in crossing[k].items()
+                            if not packable(av)
+                        }
+                        raise ValueError(
+                            "device_guard cut after op %d (%s) is illegal: "
+                            "values crossing it are not microbatch-major or "
+                            "the loss would leave the last stage: %s"
+                            % (k, fwd_ops[k].type, bad or {"loss": loss_name})
+                        )
+            used = sorted(set(stages))
+            if used != list(range(pp)):
+                raise ValueError(
+                    "pipeline partition produced stages %s for pp=%d; every "
+                    "pp rank needs a non-empty stage (annotate with "
+                    "device_guard('pp:k') or lower pp)" % (used, pp)
+                )
+
+            stage_of_op = stages
+            param_stage = {}
+            for j, op in enumerate(fwd_ops):
+                for n in op.input_arg_names:
+                    if n in param_set:
+                        s0 = param_stage.setdefault(n, stage_of_op[j])
+                        if s0 != stage_of_op[j]:
+                            raise ValueError(
+                                "parameter %r is read by pipeline stages %d "
+                                "and %d; pin its consumers to one stage with "
+                                "device_guard" % (n, s0, stage_of_op[j])
+                            )
+
+            stage_ops = [[] for _ in range(pp)]
+            for op, s in zip(fwd_ops, stage_of_op):
+                stage_ops[s].append(op)
+
+            # boundary packing tables: cut s = after the last op of stage s
+            cut_entries = []
+            for s in range(pp - 1):
+                k = max(j for j in range(n_ops) if stage_of_op[j] == s)
+                ents = []
+                for n in sorted(crossing[k]):
+                    av = crossing[k][n]
+                    w = int(np.prod(av.shape[1:])) if len(av.shape) > 1 else 1
+                    ents.append(
+                        (n, tuple(av.shape), jnp.dtype(av.dtype), w)
+                    )
+                cut_entries.append(ents)
+            K = max([sum(e[3] for e in ents) for ents in cut_entries] + [1])
+
+            # scalar outputs: loss first, then fetched last-stage values
+            produced_fwd = set(producers)
+            ext = set(feeds) | state_set
+            scal_names = [loss_name] + [
+                n for n in fetch_names
+                if n != loss_name and n in produced_fwd and n not in ext
+            ]
+            scal_entries = []
+            for n in scal_names:
+                i, av = producers[n][-1]
+                if stage_of_op[i] != pp - 1:
+                    raise ValueError(
+                        "the pp lowering can only fetch values computed in "
+                        "the LAST pipeline stage; %r is computed in stage %d "
+                        "— pin its ops with device_guard or drop the fetch"
+                        % (n, stage_of_op[i])
+                    )
+                sz = int(np.prod(av.shape)) if av.shape else 1
+                scal_entries.append(
+                    (n, tuple(av.shape), jnp.dtype(av.dtype), sz)
+                )
+            if scal_entries[0][3] != 1:
+                raise ValueError(
+                    "loss %r must be scalar, got shape %s"
+                    % (loss_name, scal_entries[0][1])
+                )
+            Ks = sum(e[3] for e in scal_entries)
+
+            opt_out = {n for op in opt_ops for n in op.output_arg_names}
+            grad_names_all = {n + GRAD_VAR_SUFFIX for n in param_set}
+            for n in fetch_names:
+                if (
+                    n in ext or n in scal_names or n in opt_out
+                    or n in grad_names_all
+                ):
+                    continue
+                raise ValueError(
+                    "fetch %r is a non-last-stage intermediate; under pp the "
+                    "block returns only last-stage scalars, state, feeds and "
+                    "optimizer outputs" % n
+                )
+
+            # per-stage parameter name lists (first-use order). The params
+            # enter the shard_map REPLICATED (in_spec P()) and each rank's
+            # switch branch reads only its own stage's entries — they are
+            # jit arguments, so the manual-region entry is an identity.
+            # (A packed [pp, S] buffer sharded P('pp') was tried first: a
+            # jit-internal value entering a shard_map with a partial spec is
+            # resharded by XLA as dynamic-update-slice + all-reduce over the
+            # WHOLE mesh, which double-counts the dp replicas — scope params
+            # are stored replicated anyway, so the dict costs no extra HBM.)
+            stage_params = [[] for _ in range(pp)]
+            for j, op in enumerate(fwd_ops):
+                s = stage_of_op[j]
+                for n in op.input_arg_names:
+                    if n in param_set and n not in stage_params[s]:
+                        stage_params[s].append(n)
+            fwd_param_names = [n for ns in stage_params for n in ns]
+            params_fwd = {n: state_env[n] for n in fwd_param_names}
+
+            self_.stage_plan = {
+                "schedule": opts["schedule"],
+                "n_micro": int(m),
+                "microbatch": int(mb),
+                "op_stage": [int(s) for s in stage_of_op],
+                "stages": [[op.type for op in ops] for ops in stage_ops],
+                "stage_params": [list(ns) for ns in stage_params],
+                "boundaries": [
+                    [e[0] for e in ents] for ents in cut_entries
+                ],
+                "boundary_width": int(K),
+            }
+
+            # read-only state the forward consumes: replicated to all stages
+            ro_for_fwd = {}
+            for op in fwd_ops:
+                for n in op.input_arg_names:
+                    if (
+                        n in state_set and n not in param_set
+                        and n not in ro_for_fwd
+                    ):
+                        ro_for_fwd[n] = state_env[n]
+
+            key_fwd, key_opt = jax.random.split(rng_key)
+
+            def make_branches(feeds_micro, sfeeds, ro_vals, key):
+                def make_branch(s):
+                    in_ents = cut_entries[s - 1] if s > 0 else []
+                    out_ents = cut_entries[s] if s < pp - 1 else []
+                    s_ops = stage_ops[s]
+                    s_params = stage_params[s]
+
+                    def branch(params, bin_buf, mb_idx):
+                        env = {}
+                        env.update(sfeeds)
+                        env.update(ro_vals)
+                        for n in s_params:
+                            env[n] = params[n]
+                        for n, v in feeds_micro.items():
+                            env[n] = lax.dynamic_index_in_dim(
+                                v, mb_idx, axis=0, keepdims=False
+                            )
+                        off = 0
+                        for (n, shp, dt, w) in in_ents:
+                            env[n] = (
+                                bin_buf[:, off:off + w].reshape(shp)
+                                .astype(dt)
+                            )
+                            off += w
+                        ctx = registry.LowerCtx(
+                            jax.random.fold_in(
+                                jax.random.fold_in(key, s), mb_idx
+                            ),
+                            mesh=None,
+                        )
+                        registry.lower_ops(ctx, s_ops, env)
+                        if out_ents:
+                            buf = jnp.concatenate([
+                                env[n].reshape(mb, -1).astype(jnp.float32)
+                                for (n, _, _, _) in out_ents
+                            ], axis=1)
+                            out = jnp.pad(
+                                buf, ((0, 0), (0, K - buf.shape[1]))
+                            )
+                        else:
+                            out = jnp.zeros((mb, K), jnp.float32)
+                        if s == pp - 1:
+                            scal = jnp.concatenate([
+                                env[n].reshape(-1).astype(jnp.float32)
+                                for (n, _, _, _) in scal_entries
+                            ])
+                        else:
+                            scal = jnp.zeros((Ks,), jnp.float32)
+                        return out, scal
+
+                    return branch
+
+                return [make_branch(s) for s in range(pp)]
+
+            in_specs = (P(), P("dp"), P(), P(), P())
+
+            if opts["schedule"] == "gpipe":
+                def spmd_fwd(params, bfeeds_l, sfeeds, ro_vals, key):
+                    feeds_micro = {
+                        n: v.reshape((m, mb) + v.shape[1:])
+                        for n, v in bfeeds_l.items()
+                    }
+                    branches = make_branches(feeds_micro, sfeeds, ro_vals, key)
+
+                    def stage_f(bin_buf, mb_idx):
+                        return lax.switch(
+                            lax.axis_index("pp"), branches,
+                            params, bin_buf, mb_idx,
+                        )
+
+                    scal = pipeline_fwd_spmd(
+                        stage_f, m, (mb, K), Ks, axis_name="pp"
+                    )
+                    return lax.pmean(scal, "dp")
+
+                sm = shard_map(
+                    spmd_fwd, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                    **{SHARD_MAP_CHECK_KW: False},
+                )
+
+                def lossf(params):
+                    scal = sm(
+                        params, batch_feeds, scalar_feeds, ro_for_fwd,
+                        key_fwd,
+                    )
+                    return scal[0], scal
+
+                (_, scal), gdict = jax.value_and_grad(lossf, has_aux=True)(
+                    params_fwd
+                )
+            else:  # 1f1b
+                seed = jnp.zeros((Ks,), jnp.float32).at[0].set(1.0 / m)
+
+                def spmd_both(params, bfeeds_l, sfeeds, ro_vals, key):
+                    feeds_micro = {
+                        n: v.reshape((m, mb) + v.shape[1:])
+                        for n, v in bfeeds_l.items()
+                    }
+                    branches = make_branches(feeds_micro, sfeeds, ro_vals, key)
+
+                    def stage_f(p, bin_buf, mb_idx):
+                        return lax.switch(
+                            lax.axis_index("pp"), branches,
+                            p, bin_buf, mb_idx,
+                        )
+
+                    scal, gacc = pipeline_1f1b_spmd(
+                        stage_f, params, m, (mb, K), seed, axis_name="pp"
+                    )
+                    # each rank's vjp is nonzero only for its own stage's
+                    # params: psum over 'pp' assembles the full dict, pmean
+                    # over 'dp' matches GPipe's dp-mean gradient
+                    gacc = jax.tree_util.tree_map(
+                        lambda g: lax.pmean(lax.psum(g, "pp"), "dp"), gacc
+                    )
+                    return lax.pmean(scal, "dp"), gacc
+
+                sm = shard_map(
+                    spmd_both, mesh=mesh, in_specs=in_specs,
+                    out_specs=(P(), P()),
+                    **{SHARD_MAP_CHECK_KW: False},
+                )
+                scal, gdict = sm(
+                    params_fwd, batch_feeds, scalar_feeds, ro_for_fwd,
+                    key_fwd,
+                )
+
+            # ---- bind grads under the program's own @GRAD names and run
+            # the block's optimizer section verbatim
+            env = {}
+            env.update(ro_state)
+            env.update(mut_state)
+            env.update(feeds)
+            for n in fwd_param_names:
+                env[n + GRAD_VAR_SUFFIX] = gdict[n].astype(
+                    state_env[n].dtype
+                )
+            for n in param_set:
+                gname = n + GRAD_VAR_SUFFIX
+                if gname not in env:  # param unused by the forward: zero grad
+                    v = state_env[n]
+                    env[gname] = jnp.zeros(np.shape(v), v.dtype)
+            off = 0
+            for (n, shp, dt, sz) in scal_entries:
+                env[n] = scal[off:off + sz].reshape(shp).astype(dt)
+                off += sz
+            ctx = registry.LowerCtx(key_opt, mesh=mesh, zero1_axis=z1)
+            registry.lower_ops(ctx, opt_ops, env)
+            fetches = [env[n] for n in fetch_names]
+            new_mut = {n: env[n] for n in self_.mut_names}
+            created = {
+                n: env[n] for n in self_.created_persistables if n in env
+            }
+            return fetches, new_mut, created, ctx.key
+
+        return run
 
 
 class _MultiStepBlock:

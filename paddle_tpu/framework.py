@@ -36,6 +36,7 @@ __all__ = [
     "switch_startup_program",
     "program_guard",
     "name_scope",
+    "device_guard",
     "grad_var_name",
     "convert_np_dtype",
 ]
@@ -79,6 +80,47 @@ class OpRole:
 
     OP_ROLE_KEY = "op_role"
     OP_ROLE_VAR_KEY = "op_role_var"
+
+
+# Explicit pipeline-stage pin (reference fluid.device_guard("gpu:2") inside
+# the pipeline optimizer era). Stored on every op appended under an active
+# device_guard; the ParallelExecutor pp partitioner treats it as an override
+# of the analytic balanced cut (parallel/partition.py).
+PIPELINE_STAGE_ATTR = "__pipeline_stage__"
+
+_device_guard_stack = []
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Pin ops appended inside to a pipeline stage (reference fluid
+    device_guard). Accepted spellings: "pp:<k>" / "gpu:<k>" / "stage:<k>"
+    (the reference pins pipeline sections to devices; here the mesh owns
+    placement, so the integer is a pp STAGE index). device=None/"cpu"
+    clears the pin for the region (host-side data ops in the reference)."""
+    stage = None
+    if device is not None and device != "cpu":
+        dev = str(device)
+        if ":" not in dev:
+            raise ValueError(
+                "device_guard expects 'pp:<stage>' (or reference-style "
+                "'gpu:<stage>'), got %r" % (device,)
+            )
+        prefix, _, idx = dev.partition(":")
+        if prefix not in ("pp", "gpu", "stage"):
+            raise ValueError("unknown device_guard prefix %r" % prefix)
+        stage = int(idx)
+        if stage < 0:
+            raise ValueError("pipeline stage must be >= 0, got %d" % stage)
+    _device_guard_stack.append(stage)
+    try:
+        yield
+    finally:
+        _device_guard_stack.pop()
+
+
+def _current_pipeline_stage():
+    return _device_guard_stack[-1] if _device_guard_stack else None
 
 
 # TPU-first canonicalization: no fast f64/i64 path on TPU, so (like JAX's
@@ -303,6 +345,9 @@ class Operator:
         role_var = _current_role_var()
         if role_var and OpRole.OP_ROLE_VAR_KEY not in self.attrs:
             self.attrs[OpRole.OP_ROLE_VAR_KEY] = list(role_var)
+        stage = _current_pipeline_stage()
+        if stage is not None and PIPELINE_STAGE_ATTR not in self.attrs:
+            self.attrs[PIPELINE_STAGE_ATTR] = stage
 
     def input(self, slot):
         return self.inputs.get(slot, [])

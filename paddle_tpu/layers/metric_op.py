@@ -4,7 +4,7 @@ accuracy, auc)."""
 from ..layer_helper import LayerHelper
 from ..initializer import Constant
 
-__all__ = ["accuracy", "auc"]
+__all__ = ["accuracy", "auc", "positive_negative_pair"]
 
 
 def accuracy(input, label, k=1, correct=None, total=None):
@@ -71,3 +71,35 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
     )
     auc_out.stop_gradient = True
     return auc_out, [batch_out, stat_pos, stat_neg]
+
+
+def positive_negative_pair(score, label, query_id, weight=None, column=-1):
+    """Pairwise ranking metric over within-query item pairs (reference
+    metric_op.py-era positive_negative_pair op; the LETOR/mq2007 evaluation
+    shipped in dataset/mq2007.py). Returns (positive, negative, neutral)
+    pair counts as float32 [1] tensors; higher positive/negative ratio means
+    the scorer orders items more like the relevance labels."""
+    helper = LayerHelper("positive_negative_pair")
+    pos = helper.create_variable_for_type_inference(dtype="float32")
+    neg = helper.create_variable_for_type_inference(dtype="float32")
+    neu = helper.create_variable_for_type_inference(dtype="float32")
+    inputs = {
+        "Score": [score.name],
+        "Label": [label.name],
+        "QueryID": [query_id.name],
+    }
+    if weight is not None:
+        inputs["Weight"] = [weight.name]
+    helper.append_op(
+        type="positive_negative_pair",
+        inputs=inputs,
+        outputs={
+            "PositivePair": [pos.name],
+            "NegativePair": [neg.name],
+            "NeutralPair": [neu.name],
+        },
+        attrs={"column": column},
+    )
+    for v in (pos, neg, neu):
+        v.stop_gradient = True
+    return pos, neg, neu

@@ -13,6 +13,8 @@ __all__ = [
     "flash_attention",
     "fc",
     "embedding",
+    "hash",
+    "chunk_eval",
     "dropout",
     "conv2d",
     "conv2d_transpose",
@@ -184,6 +186,72 @@ def embedding(
     if getattr(input, "_len_name", None):
         tmp._len_name = input._len_name
     return tmp
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """Feature-hash integer ids into [0, hash_size) buckets (reference
+    layers/nn.py hash → hash op): Out is [N, num_hash, 1], one bucket id per
+    hash seed, ready to feed `embedding`/lookup_table. See ops/core_ops.py
+    _hash for the XXH32 scheme."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="hash",
+        inputs={"X": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={"num_hash": num_hash, "mod_by": hash_size},
+    )
+    out.stop_gradient = True
+    return out
+
+
+def chunk_eval(
+    input,
+    label,
+    chunk_scheme,
+    num_chunk_types,
+    excluded_chunk_types=None,
+    seq_length=None,
+):
+    """Chunk-level precision/recall/F1 for sequence tagging (reference
+    layers/nn.py chunk_eval → chunk_eval op, the conlleval metric).
+
+    input/label are padded-dense [b, t] tag grids (this repo's sequence
+    convention), with `seq_length` [b] masking padding. Returns the 6-tuple
+    (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks); fetch the three counts per batch and feed them to
+    fluid.metrics.ChunkEvaluator.update for streaming aggregation — the
+    counting itself runs in-framework, inside the compiled program."""
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_variable_for_type_inference(dtype="float32")
+    recall = helper.create_variable_for_type_inference(dtype="float32")
+    f1_score = helper.create_variable_for_type_inference(dtype="float32")
+    num_infer = helper.create_variable_for_type_inference(dtype="int64")
+    num_label = helper.create_variable_for_type_inference(dtype="int64")
+    num_correct = helper.create_variable_for_type_inference(dtype="int64")
+    inputs = {"Inference": [input.name], "Label": [label.name]}
+    if seq_length is not None:
+        inputs["SeqLength"] = [seq_length.name]
+    helper.append_op(
+        type="chunk_eval",
+        inputs=inputs,
+        outputs={
+            "Precision": [precision.name],
+            "Recall": [recall.name],
+            "F1-Score": [f1_score.name],
+            "NumInferChunks": [num_infer.name],
+            "NumLabelChunks": [num_label.name],
+            "NumCorrectChunks": [num_correct.name],
+        },
+        attrs={
+            "chunk_scheme": chunk_scheme,
+            "num_chunk_types": num_chunk_types,
+            "excluded_chunk_types": list(excluded_chunk_types or []),
+        },
+    )
+    for v in (precision, recall, f1_score, num_infer, num_label, num_correct):
+        v.stop_gradient = True
+    return precision, recall, f1_score, num_infer, num_label, num_correct
 
 
 def dropout(

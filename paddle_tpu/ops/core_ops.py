@@ -716,6 +716,160 @@ def _auc(ctx, ins, attrs):
     }
 
 
+def _chunk_flags(y, n_types, scheme, excluded, seqlen):
+    """Per-position chunk (start, end, type) flags for a padded [b, t] int
+    tag grid under one of the conlleval tagging schemes.
+
+    Tag layout matches the reference chunk_eval_op.h: label =
+    chunk_type * num_tag_types + tag_type, with tag ids B=0,I=1 (IOB) /
+    I=0,E=1 (IOE) / B=0,I=1,E=2,S=3 (IOBES) / the single tag 0 (plain, every
+    tagged position its own chunk); any label outside [0, n_types*num_tag)
+    is the O tag. A chunk starts where the tag says so OR the type changes
+    OR the previous position is O/sequence-start (conlleval's boundary
+    rules), and symmetrically for ends.
+    """
+    ntag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    typ = y // ntag
+    tag = y % ntag
+    valid = (y >= 0) & (y < n_types * ntag)
+    for ex in excluded:
+        valid = valid & (typ != int(ex))
+    t = y.shape[1]
+    if seqlen is not None:
+        valid = valid & (
+            jnp.arange(t)[None, :] < seqlen.reshape(-1, 1).astype(jnp.int32)
+        )
+    pad_col = jnp.zeros((y.shape[0], 1), y.dtype)
+    pad_f = jnp.zeros((y.shape[0], 1), bool)
+    p_valid = jnp.concatenate([pad_f, valid[:, :-1]], 1)
+    p_typ = jnp.concatenate([pad_col, typ[:, :-1]], 1)
+    p_tag = jnp.concatenate([pad_col, tag[:, :-1]], 1)
+    n_valid = jnp.concatenate([valid[:, 1:], pad_f], 1)
+    n_typ = jnp.concatenate([typ[:, 1:], pad_col], 1)
+    n_tag = jnp.concatenate([tag[:, 1:], pad_col], 1)
+    boundary_in = ~p_valid | (p_typ != typ)
+    boundary_out = ~n_valid | (n_typ != typ)
+    if scheme == "plain":
+        start = valid
+        end = valid
+    elif scheme == "IOB":
+        start = valid & ((tag == 0) | boundary_in)
+        end = valid & (boundary_out | (n_tag == 0))
+    elif scheme == "IOE":
+        start = valid & (boundary_in | (p_tag == 1))
+        end = valid & ((tag == 1) | boundary_out)
+    else:  # IOBES
+        start = valid & ((tag == 0) | (tag == 3) | boundary_in | (p_tag >= 2))
+        end = valid & ((tag >= 2) | boundary_out | (n_tag == 0) | (n_tag == 3))
+    return start, end, typ
+
+
+def _chunk_endpos(end):
+    """For each position, the index of the NEXT chunk end at-or-after it
+    (reverse running minimum over end positions) — a chunk starting at i
+    spans [i, endpos[i]]."""
+    t = end.shape[1]
+    cand = jnp.where(end, jnp.arange(t)[None, :], t)
+    return jnp.flip(lax.cummin(jnp.flip(cand, 1), axis=1), 1)
+
+
+@register("chunk_eval", no_grad=True)
+def _chunk_eval(ctx, ins, attrs):
+    """Chunk-level precision/recall/F1 (reference chunk_eval_op.cc — the
+    conlleval metric for NER-style taggers). Sequence layout follows this
+    repo's padded-dense convention (sequence_ops.py): Inference/Label are
+    [b, t] (or [b, t, 1]) tag grids with an optional SeqLength [b] mask.
+    A predicted chunk is correct when a label chunk with the same span AND
+    type exists; counting is fully vectorized (start/end boundary flags +
+    span-end matching) rather than the reference's per-sequence scan."""
+    (inference,) = ins["Inference"]
+    (label,) = ins["Label"]
+    seqlen = (ins.get("SeqLength") or [None])[0]
+    scheme = str(attrs.get("chunk_scheme", "IOB"))
+    if scheme not in ("plain", "IOB", "IOE", "IOBES"):
+        raise ValueError("chunk_eval: unknown chunk_scheme %r" % scheme)
+    n_types = int(attrs["num_chunk_types"])
+    excluded = tuple(attrs.get("excluded_chunk_types", ()) or ())
+    inf = inference.reshape(inference.shape[0], -1).astype(jnp.int32)
+    lab = label.reshape(label.shape[0], -1).astype(jnp.int32)
+    i_start, i_end, i_typ = _chunk_flags(inf, n_types, scheme, excluded, seqlen)
+    l_start, l_end, l_typ = _chunk_flags(lab, n_types, scheme, excluded, seqlen)
+    n_inf = jnp.sum(i_start)
+    n_lab = jnp.sum(l_start)
+    n_cor = jnp.sum(
+        i_start
+        & l_start
+        & (i_typ == l_typ)
+        & (_chunk_endpos(i_end) == _chunk_endpos(l_end))
+    )
+    fi, fl, fc = (x.astype(jnp.float32) for x in (n_inf, n_lab, n_cor))
+    precision = jnp.where(fi > 0, fc / jnp.maximum(fi, 1.0), 0.0)
+    recall = jnp.where(fl > 0, fc / jnp.maximum(fl, 1.0), 0.0)
+    f1 = jnp.where(
+        precision + recall > 0,
+        2.0 * precision * recall / jnp.maximum(precision + recall, 1e-38),
+        0.0,
+    )
+    i64 = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return {
+        "Precision": [precision.reshape((1,))],
+        "Recall": [recall.reshape((1,))],
+        "F1-Score": [f1.reshape((1,))],
+        "NumInferChunks": [n_inf.astype(i64).reshape((1,))],
+        "NumLabelChunks": [n_lab.astype(i64).reshape((1,))],
+        "NumCorrectChunks": [n_cor.astype(i64).reshape((1,))],
+    }
+
+
+@register("positive_negative_pair", no_grad=True)
+def _positive_negative_pair(ctx, ins, attrs):
+    """Pairwise ranking metric (reference positive_negative_pair_op.cc, the
+    mq2007/LETOR evaluation): over every within-query item pair with
+    differing labels, a pair is positive when the higher-labeled item also
+    scores higher, negative when it scores lower, neutral on score ties.
+    O(N^2) masked pairwise comparison — N is a batch, not a corpus."""
+    (score,) = ins["Score"]
+    (label,) = ins["Label"]
+    (qid,) = ins["QueryID"]
+    col = int(attrs.get("column", -1))
+    s = score.reshape(score.shape[0], -1)[:, col].astype(jnp.float32)
+    l = label.reshape(-1).astype(jnp.float32)
+    q = qid.reshape(-1)
+    n = s.shape[0]
+    # each unordered pair once: strict upper triangle of the same-query mask
+    pair = (
+        (q[:, None] == q[None, :])
+        & (jnp.arange(n)[:, None] < jnp.arange(n)[None, :])
+        & (l[:, None] != l[None, :])
+    ).astype(jnp.float32)
+    if ins.get("Weight"):
+        w = ins["Weight"][0].reshape(-1).astype(jnp.float32)
+        pair = pair * 0.5 * (w[:, None] + w[None, :])
+    # orient the score difference so positive means ranked like the labels
+    d = (s[:, None] - s[None, :]) * jnp.sign(l[:, None] - l[None, :])
+    pos = jnp.sum(pair * (d > 0))
+    neg = jnp.sum(pair * (d < 0))
+    neu = jnp.sum(pair * (d == 0))
+    for slot, v in (
+        ("AccumulatePositivePair", pos),
+        ("AccumulateNegativePair", neg),
+        ("AccumulateNeutralPair", neu),
+    ):
+        if ins.get(slot):
+            v = v + ins[slot][0].reshape(())
+        if slot == "AccumulatePositivePair":
+            pos = v
+        elif slot == "AccumulateNegativePair":
+            neg = v
+        else:
+            neu = v
+    return {
+        "PositivePair": [pos.reshape((1,))],
+        "NegativePair": [neg.reshape((1,))],
+        "NeutralPair": [neu.reshape((1,))],
+    }
+
+
 # ---------------------------------------------------------------------------
 # tensor manipulation (reference: reshape_op.cc, transpose_op.cc, concat_op.cc,
 # split_op.cc, stack_op.cc, squeeze/unsqueeze, flatten, slice, gather, scatter,
@@ -919,6 +1073,56 @@ def _one_hot(ctx, ins, attrs):
     depth = int(attrs["depth"])
     flat = x.reshape(x.shape[:-1]) if x.shape[-1] == 1 else x
     return {"Out": [jax.nn.one_hot(flat.astype(jnp.int32), depth, dtype=jnp.float32)]}
+
+
+@register("hash", no_grad=True)
+def _hash(ctx, ins, attrs):
+    """Feature hashing of integer id rows (reference hash_op.cc, the
+    "hash trick" front-end of sparse models: ids → num_hash hashed buckets
+    in [0, mod_by), each feeding a lookup_table). The reference runs xxHash
+    over each row's raw int64 bytes per seed; this is the same XXH32 round
+    structure (the <16-byte tail path: per-4-byte-lane mix + avalanche,
+    primes 2654435761/2246822519/3266489917/668265263/374761393) in wrapped
+    uint32 jnp arithmetic — bit-exact XXH32 for the typical [N, 1] int64 id
+    column, lane-chained for wider rows. Each logical id always hashes as 8
+    bytes (hi lane 0 under i64→i32 canonicalization) so bucket assignment
+    is independent of the executor's dtype policy."""
+    (x,) = ins["X"]
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 1))
+    p1, p2, p3, p4, p5 = (
+        jnp.uint32(2654435761),
+        jnp.uint32(2246822519),
+        jnp.uint32(3266489917),
+        jnp.uint32(668265263),
+        jnp.uint32(374761393),
+    )
+
+    def rotl(v, r):
+        return (v << jnp.uint32(r)) | (v >> jnp.uint32(32 - r))
+
+    ids = x.reshape(x.shape[0], -1)
+    lanes = []
+    for c in range(ids.shape[1]):
+        col = ids[:, c]
+        lo = col.astype(jnp.uint32)  # wraps mod 2^32 == the low 4 bytes
+        hi = (
+            (col >> 32).astype(jnp.uint32)
+            if np.dtype(col.dtype).itemsize == 8
+            else jnp.zeros(col.shape, jnp.uint32)
+        )
+        lanes += [lo, hi]
+    nbytes = jnp.uint32(8 * ids.shape[1])
+    outs = []
+    for seed in range(num_hash):
+        h = jnp.full(ids.shape[:1], jnp.uint32(seed), jnp.uint32) + p5 + nbytes
+        for w in lanes:
+            h = rotl(h + w * p3, 17) * p4
+        h = (h ^ (h >> jnp.uint32(15))) * p2
+        h = (h ^ (h >> jnp.uint32(13))) * p3
+        h = h ^ (h >> jnp.uint32(16))
+        outs.append((h % jnp.uint32(mod_by)).astype(x.dtype))
+    return {"Out": [jnp.stack(outs, axis=1).reshape(x.shape[0], num_hash, 1)]}
 
 
 @register("lookup_table")

@@ -101,3 +101,33 @@ def _fake_init(op, scope):
     for name in op.output("Out"):
         if scope.find_var(name) is None:
             scope.set_var(name, jnp.zeros((1,), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# in-graph distributed selection (not RPC: lowers to XLA like any tensor op)
+# ---------------------------------------------------------------------------
+
+
+def _register_ref_by_trainer_id():
+    # local import: keep the host-op section above import-light (this module
+    # loads even where jax is absent-but-stubbed during docs builds)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .registry import register
+
+    @register("ref_by_trainer_id", no_grad=True)
+    def _ref_by_trainer_id(ctx, ins, attrs):
+        """Out = X[TrainerId] (reference ref_by_trainer_id_op.cc): each
+        trainer selects its own row from a list of same-shaped candidates —
+        the reference used it to hand trainer-k its slice of a split
+        parameter/LR schedule. All inputs must agree in shape (the reference
+        indexes a vector of pre-split vars the transpiler sized equally)."""
+        xs = ins["X"]
+        (tid,) = ins["TrainerId"]
+        idx = jnp.clip(tid.reshape(()).astype(jnp.int32), 0, len(xs) - 1)
+        out = lax.dynamic_index_in_dim(jnp.stack(xs), idx, 0, keepdims=False)
+        return {"Out": [out]}
+
+
+_register_ref_by_trainer_id()

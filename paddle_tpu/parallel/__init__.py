@@ -14,13 +14,22 @@ here onto jax.sharding over a device Mesh:
 
 from .mesh import MeshConfig, make_mesh
 from .multihost import init_distributed
-from .pipeline import gpipe, gpipe_spmd
+from .pipeline import (
+    gpipe,
+    gpipe_spmd,
+    pipeline_1f1b_spmd,
+    pipeline_fwd_spmd,
+)
 from .ring_attention import ring_attention
 from . import collectives
+from . import partition
 
 __all__ = [
     "gpipe",
     "gpipe_spmd",
+    "pipeline_fwd_spmd",
+    "pipeline_1f1b_spmd",
+    "partition",
     "MeshConfig",
     "make_mesh",
     "init_distributed",

@@ -20,8 +20,22 @@ recipe rather than a send/recv port:
   next microbatch's stage compute;
 - `ppermute` has a transpose rule, so `jax.grad` through the pipeline IS
   the backward pipeline (cotangents flow rank+1 -> rank via the reversed
-  ring) — no hand-written 1F1B machinery, and the optimizer update
-  composes outside like any other jax.grad.
+  ring) — the GPipe schedules need no hand-written backward, and the
+  optimizer update composes outside like any other jax.grad.
+
+Two generations of schedule live here:
+
+- the HOMOGENEOUS tier (`gpipe`/`gpipe_spmd`): stages are a stack of
+  identical blocks, parameters stacked [n_stages, ...] and sharded P('pp');
+- the HETEROGENEOUS tier (`pipeline_fwd_spmd`/`pipeline_1f1b_spmd`), the
+  engine under ParallelExecutor's Program lowering: each pp rank holds ONE
+  stage's arbitrary op subgraph (dispatched per-rank via lax.switch in the
+  caller-built `stage_f`), activations cross stages through a uniform
+  packed [mb, K] boundary buffer, and the 1F1B variant (PipeDream /
+  Megatron flavor: Narayanan et al.) interleaves one forward with one
+  backward per tick, rematerializing the stage forward at backward time so
+  the stash holds only the O(pp) in-flight stage INPUTS instead of GPipe's
+  O(n_micro) residual sets.
 
 Composition: 'pp' is one axis of the SAME mesh as dp/tp/sp/ep, so a
 dp2xpp4 mesh runs data-parallel pipelines (each dp slice pipelines its
@@ -42,7 +56,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 # moved to collectives so every shard_map user in the package shares it)
 from .collectives import SHARD_MAP_CHECK_KW as _CHECK_KW, axis_size, shard_map
 
-__all__ = ["gpipe", "gpipe_spmd"]
+__all__ = ["gpipe", "gpipe_spmd", "pipeline_fwd_spmd", "pipeline_1f1b_spmd"]
 
 
 def _apply_stages(stage_fn, params_local, x):
@@ -124,3 +138,128 @@ def gpipe(stage_fn, stacked_params, x, n_micro, mesh, axis_name="pp",
     )
     x_sh = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(batch_axis)))
     return fn(params_sh, x_sh)
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous-stage engines (the ParallelExecutor Program lowering's core)
+# ---------------------------------------------------------------------------
+#
+# Caller contract (both engines; call INSIDE a shard_map whose mesh binds
+# `axis_name`): `stage_f(boundary_in, mb_idx) -> (boundary_out, scalars)`
+# runs THIS RANK's stage (dispatch over lax.axis_index inside, e.g. via
+# lax.switch) on one microbatch. boundary_in/out are the uniform packed
+# activation buffers [mb, K] float32; `scalars` is a packed [n_scalars]
+# float32 vector that only the LAST stage fills (loss + scalar fetches);
+# mb_idx is the (traced, clamped-valid) microbatch index for feed slicing.
+# Microbatch-MEAN combination: the engines average scalars over microbatches
+# (exact for batch-mean losses/metrics when n_micro divides the batch).
+
+
+def pipeline_fwd_spmd(stage_f, n_micro, boundary_shape, n_scalars,
+                      axis_name="pp"):
+    """GPipe forward schedule over heterogeneous stages: microbatch m
+    occupies rank r at tick m + r; ticks = n_micro + pp - 1; the bubble is
+    (pp-1)/(n_micro+pp-1). Returns the microbatch-mean scalars vector,
+    replicated over `axis_name`. Backward: differentiate THROUGH this
+    function (ppermute/psum transposes give the reversed-ring cotangent
+    pipeline); peak liveness is the classic GPipe O(n_micro) residual set."""
+    pp = axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    recv = jnp.zeros(boundary_shape, jnp.float32)
+    scal_sum = jnp.zeros((n_scalars,), jnp.float32)
+    for t in range(n_micro + pp - 1):
+        f = t - r  # microbatch this rank works on at tick t (may be invalid)
+        fvalid = (f >= 0) & (f < n_micro)
+        fc = jnp.clip(f, 0, n_micro - 1)
+        out, scal = stage_f(recv, fc)
+        scal_sum = scal_sum + jnp.where(
+            fvalid & (r == pp - 1), scal, jnp.zeros_like(scal)
+        )
+        recv = lax.ppermute(out, axis_name, perm)
+    scal_mean = scal_sum / n_micro
+    return lax.psum(
+        jnp.where(r == pp - 1, scal_mean, jnp.zeros_like(scal_mean)), axis_name
+    )
+
+
+def pipeline_1f1b_spmd(stage_f, params_local, n_micro, boundary_shape,
+                       scal_cotangent, axis_name="pp"):
+    """1F1B schedule (PipeDream-flush / Megatron): each tick interleaves one
+    forward with one backward sub-step, so microbatch b's backward at rank r
+    runs at tick b + 2(pp-1) - r — in-flight forwards per rank stay at most
+    2(pp-1-r)+1 ≈ O(pp) instead of GPipe's O(n_micro). The backward is
+    hand-scheduled with per-stage jax.vjp, REMATERIALIZING the stage forward
+    from the stashed boundary input (activation-checkpoint flavor), so the
+    stash is a [2·pp, mb, K] ring buffer of stage inputs, not full residuals.
+
+    `stage_f(params_local, boundary_in, mb_idx) -> (boundary_out, scalars)`
+    (params explicit here so vjp can differentiate w.r.t. them).
+    `scal_cotangent` [n_scalars] seeds the loss cotangent at the LAST rank
+    (one-hot at the loss slot, scaled 1/n_micro for the microbatch mean).
+
+    Returns (microbatch-mean scalars replicated over axis_name,
+    accumulated parameter-buffer gradient shaped like params_local).
+    The math is identical to GPipe's jax.grad — same per-microbatch grads,
+    summed — only the schedule (and liveness) differs.
+    """
+    pp = axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    perm_f = [(i, (i + 1) % pp) for i in range(pp)]
+    perm_b = [(i, (i - 1) % pp) for i in range(pp)]
+    n_scalars = scal_cotangent.shape[0]
+
+    # stash of in-flight stage INPUTS keyed f mod W, one trash slot at W for
+    # invalid-tick writes (clobbering a live slot would corrupt the replay)
+    W = 2 * pp - 1
+    stash = jnp.zeros((W + 1,) + tuple(boundary_shape), jnp.float32)
+    gacc = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params_local
+    )
+    scal_sum = jnp.zeros((n_scalars,), jnp.float32)
+    recv_f = jnp.zeros(boundary_shape, jnp.float32)
+    recv_b = jnp.zeros(boundary_shape, jnp.float32)
+
+    for t in range(n_micro + 2 * (pp - 1)):
+        # ---- forward sub-step: microbatch f = t - r
+        f = t - r
+        fvalid = (f >= 0) & (f < n_micro)
+        fc = jnp.clip(f, 0, n_micro - 1)
+        out_f, scal = stage_f(params_local, recv_f, fc)
+        scal_sum = scal_sum + jnp.where(
+            fvalid & (r == pp - 1), scal, jnp.zeros_like(scal)
+        )
+        slot = jnp.where(fvalid, jnp.remainder(fc, W), W)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, recv_f[None], slot, axis=0
+        )
+        recv_f = lax.ppermute(out_f, axis_name, perm_f)
+
+        # ---- backward sub-step: microbatch b = t - 2(pp-1) + r
+        b = t - 2 * (pp - 1) + r
+        bvalid = (b >= 0) & (b < n_micro)
+        bc = jnp.clip(b, 0, n_micro - 1)
+        bin_b = lax.dynamic_index_in_dim(
+            stash, jnp.remainder(bc, W), axis=0, keepdims=False
+        )
+        _, vjp = jax.vjp(
+            lambda p, bi: stage_f(p, bi, bc), params_local, bin_b
+        )
+        is_last = r == pp - 1
+        cot_out = jnp.where(is_last, jnp.zeros_like(recv_b), recv_b)
+        cot_scal = jnp.where(
+            is_last, scal_cotangent, jnp.zeros_like(scal_cotangent)
+        )
+        gp, gbi = vjp((cot_out, cot_scal))
+        gacc = jax.tree_util.tree_map(
+            lambda acc, g: acc + jnp.where(bvalid, g, jnp.zeros_like(g)),
+            gacc, gp,
+        )
+        send = jnp.where(bvalid, gbi, jnp.zeros_like(gbi))
+        recv_b = lax.ppermute(send, axis_name, perm_b)
+
+    scal_mean = scal_sum / n_micro
+    scal_repl = lax.psum(
+        jnp.where(r == pp - 1, scal_mean, jnp.zeros_like(scal_mean)), axis_name
+    )
+    return scal_repl, gacc

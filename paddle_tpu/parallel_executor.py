@@ -25,6 +25,7 @@ from . import framework
 from .executor import (
     _CompiledBlock,
     _MultiStepBlock,
+    _PipelinedBlock,
     _as_feed_array,
     global_scope,
 )
@@ -65,6 +66,12 @@ class BuildStrategy:
         self.memory_optimize = False
         self.num_trainers = 1
         self.trainer_id = 0
+        # pipeline parallelism depth: >1 makes ParallelExecutor build a
+        # dp×pp mesh (all remaining devices on 'dp') and lower the program
+        # through the pipeline partitioner (executor._PipelinedBlock).
+        # Ignored when an explicit mesh_config is passed — set MeshConfig(pp=)
+        # there instead.
+        self.pipeline_stages = 1
 
 
 class ExecutionStrategy:
@@ -76,6 +83,15 @@ class ExecutionStrategy:
         self.use_cuda = False
         self.allow_op_delay = False
         self.num_iteration_per_drop_scope = 1
+        # pp-tier knobs (no-ops unless the mesh has pp > 1):
+        # pipeline_schedule: "gpipe" (all forwards then all backwards; O(m)
+        # live activations per rank) or "1f1b" (interleaved one-forward-
+        # one-backward; O(pp) live activations — same bubble fraction,
+        # (pp-1)/(m+pp-1), much flatter memory at large m).
+        self.pipeline_schedule = "gpipe"
+        # microbatch count m per dp-local batch; None → pp (the minimum that
+        # fills the pipeline once).
+        self.num_microbatches = None
 
 
 class ParallelExecutor:
@@ -114,6 +130,13 @@ class ParallelExecutor:
             from .parallel import make_mesh
 
             self._mesh = make_mesh(mesh_config, devices)
+        elif self._build_strategy.pipeline_stages > 1:
+            from .parallel import MeshConfig, make_mesh
+
+            self._mesh = make_mesh(
+                MeshConfig(dp=-1, pp=self._build_strategy.pipeline_stages),
+                devices,
+            )
         else:
             self._mesh = Mesh(np.asarray(devices), ("dp",))
         self._cache = {}
@@ -180,6 +203,12 @@ class ParallelExecutor:
                 )
             feed_arrays[name] = arr
 
+        pp = self._mesh.shape.get("pp", 1)
+        if pp > 1 and is_multi:
+            raise NotImplementedError(
+                "steps_per_run > 1 is not supported with pipeline "
+                "parallelism yet; run one step per call on a pp mesh"
+            )
         key = (
             program._uid,
             program._version,
@@ -188,6 +217,12 @@ class ParallelExecutor:
             self._scope._uid,
             steps_per_run,
             force_multi and steps_per_run == 1,
+            (
+                self._exec_strategy.pipeline_schedule,
+                self._exec_strategy.num_microbatches,
+            )
+            if pp > 1
+            else None,
         )
         compiled = self._cache.get(key)
         if compiled is None:
@@ -203,7 +238,15 @@ class ParallelExecutor:
                 and self._mesh.shape.get("dp", 1) > 1
                 else None
             )
-            if is_multi:
+            if pp > 1:
+                compiled = _PipelinedBlock(
+                    program, block, list(feed_arrays.keys()), fetch_names,
+                    self._scope, mesh=self._mesh, feed_ranks=feed_ranks,
+                    zero1_axis=zero1_axis, loss_name=self._loss_name,
+                    n_micro=self._exec_strategy.num_microbatches,
+                    schedule=self._exec_strategy.pipeline_schedule,
+                )
+            elif is_multi:
                 compiled = _MultiStepBlock(
                     program, block, list(feed_arrays.keys()), fetch_names,
                     self._scope, steps_per_run, mesh=self._mesh,

@@ -37,7 +37,7 @@ class _FeederError:
 
 class PyReader:
     def __init__(self, feed_names, capacity=4, return_device_arrays=True,
-                 wire_dtypes=None):
+                 wire_dtypes=None, cache_epoch=False):
         """wire_dtypes: optional {feed_name: dtype} COMPACT WIRE FORMAT —
         batches are converted to this dtype on the host before staging, so
         the host->device transfer carries e.g. uint8 pixels (4x fewer bytes
@@ -47,9 +47,23 @@ class PyReader:
         Reference analog: the double-buffer reader moves whatever dtype the
         LoDTensor holds (operators/reader/buffered_reader.h:48) — uint8
         image feeds + an in-graph cast were the reference's own trick for
-        byte-bound input pipelines."""
+        byte-bound input pipelines.
+
+        cache_epoch: DEVICE-RESIDENT EPOCH CACHE. The first epoch runs the
+        normal path (reader → host assembly → wire → device staging) and
+        additionally retains every staged batch; once the epoch completes
+        cleanly, later start() calls replay the cached device arrays through
+        the same queue/feeder machinery with the reader, host assembly, and
+        the host->device wire all out of the loop. For an image set that
+        fits HBM this removes the wire-bound stage entirely
+        (PIPELINE_KEEPUP.json keep-up evidence; tools/pipeline_probe.py
+        measures the replay rate). Caching keys off the staged arrays, so a
+        new decorate_* call or a mid-epoch reset() invalidates it."""
         self.feed_names = list(feed_names)
         self.capacity = capacity
+        self._cache_epoch = bool(cache_epoch)
+        self._cache = None  # completed-epoch staged batches, serve order
+        self._cache_building = None
         self._wire_dtypes = {
             k: (jax.numpy.bfloat16 if str(v) == "bfloat16" else v)
             for k, v in (wire_dtypes or {}).items()
@@ -71,12 +85,14 @@ class PyReader:
         dense; ragged (LoD) fields need a DataFeeder (set_feeder)."""
         self._paddle_reader = reader
         self._batched_tuples = True
+        self._cache = None  # new dataset: cached epoch no longer valid
         return self
 
     def decorate_tensor_provider(self, reader):
         """reader yields dicts name->numpy directly"""
         self._paddle_reader = reader
         self._raw_dicts = True
+        self._cache = None
         return self
 
     def decorate_batch_generator(self, reader, places=None):
@@ -132,6 +148,8 @@ class PyReader:
                     continue
             return False
 
+        building = [] if (self._cache_epoch and self._cache is None) else None
+
         def fill():
             try:
                 for item in self._paddle_reader():
@@ -152,15 +170,34 @@ class PyReader:
                     if self._return_device:
                         # stage on device ahead of compute (double buffering)
                         feed = {k: jax.device_put(v) for k, v in feed.items()}
+                    if building is not None:
+                        building.append(feed)
                     if not _put(feed):
                         return
+                # clean epoch end: the staged batches ARE the epoch — keep
+                # them on device for wire-free replay next epoch
+                if building is not None:
+                    self._cache = building
             except BaseException as e:  # noqa: B036 — carried to the consumer
                 _put(_FeederError(e))
                 return
             finally:
                 _put(_EndOfEpoch)
 
-        self._thread = threading.Thread(target=fill, daemon=True)
+        def replay():
+            # cached-epoch path: same queue/consumer machinery, but the
+            # reader, host assembly, and host->device wire are not involved
+            for feed in self._cache:
+                if stop.is_set():
+                    return
+                if not _put(feed):
+                    return
+            _put(_EndOfEpoch)
+
+        serve_cached = self._cache_epoch and self._cache is not None
+        self._thread = threading.Thread(
+            target=replay if serve_cached else fill, daemon=True
+        )
         self._thread.start()
 
     def reset(self):

@@ -61,6 +61,13 @@ echo "== zero1 + comm-volume smoke (docs/parallelism.md) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python tools/comm_audit.py --check
 
+echo "== pp through ParallelExecutor (docs/parallelism.md) =="
+# a fluid Program must train on the dp2×pp4 mesh purely via
+# ParallelExecutor — loss parity vs single-device for both schedules,
+# device_guard override, checkpoint round-trip under stage partitioning
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest -q tests/test_pp_program.py
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

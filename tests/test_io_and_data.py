@@ -193,6 +193,68 @@ def test_pyreader_reset_mid_epoch_stops_thread():
     assert len(produced) < 1000  # source was not drained
 
 
+def test_pyreader_epoch_cache_replays_without_reader():
+    """cache_epoch=True: epoch 1 pulls from the source; epoch 2+ replays the
+    staged batches device-resident — the source, host assembly, and wire are
+    out of the loop (PIPELINE_KEEPUP.json keep-up evidence path)."""
+    from paddle_tpu.py_reader import PyReader, EOFException
+
+    pulls = []
+
+    def src():
+        pulls.append(1)
+        for i in range(4):
+            yield {"x": np.full((2, 3), i, "float32")}
+
+    r = PyReader(["x"], capacity=2, cache_epoch=True)
+    r.decorate_tensor_provider(src)
+
+    def epoch():
+        r.start()
+        got = []
+        try:
+            while True:
+                got.append(np.asarray(r.next_batch()["x"]))
+        except EOFException:
+            return got
+
+    e1 = epoch()
+    e2 = epoch()
+    e3 = epoch()
+    assert len(pulls) == 1  # source consulted once, epochs 2-3 cached
+    assert len(e1) == len(e2) == len(e3) == 4
+    for a, b in zip(e1, e3):
+        np.testing.assert_array_equal(a, b)
+    # a new dataset invalidates the cache
+    r.decorate_tensor_provider(src)
+    epoch()
+    assert len(pulls) == 2
+
+
+def test_pyreader_partial_epoch_does_not_poison_cache():
+    from paddle_tpu.py_reader import PyReader, EOFException
+
+    def src():
+        for i in range(6):
+            yield {"x": np.asarray([i], "float32")}
+
+    r = PyReader(["x"], capacity=2, cache_epoch=True)
+    r.decorate_tensor_provider(src)
+    r.start()
+    r.next_batch()
+    r.reset()  # mid-epoch abort: the partial epoch must NOT become the cache
+    assert r._cache is None
+    r.start()
+    seen = []
+    try:
+        while True:
+            seen.append(int(np.asarray(r.next_batch()["x"])[0]))
+    except EOFException:
+        pass
+    assert seen == [0, 1, 2, 3, 4, 5]
+    assert r._cache is not None and len(r._cache) == 6
+
+
 def test_xmap_readers_order_preserved():
     def src():
         return iter(range(50))

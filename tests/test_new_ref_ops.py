@@ -1,0 +1,394 @@
+"""OpTest coverage for the four reference ops added by the pp PR: chunk_eval,
+hash, positive_negative_pair, ref_by_trainer_id (PARITY.md §2.5 — these were
+missing without a waiver, falsifying the "all deliberate" claim).
+
+Every numpy reference here is written independently of the jnp lowering:
+chunk extraction is a literal per-sequence python scan (conlleval-style),
+hash is a scalar-python XXH32, pair counting is a double loop.
+"""
+
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+
+from op_test import OpTest
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+
+_NUM_TAGS = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}
+
+
+def extract_chunks(seq, scheme, num_types, excluded=()):
+    """Brute-force chunk extraction: per-position begin/end decisions from a
+    left-to-right scan (the conlleval boundary rules, coded as a scan rather
+    than the lowering's shifted masks), returning the set of
+    (start, end, type) spans."""
+    ntag = _NUM_TAGS[scheme]
+
+    def parse(y):
+        if y < 0 or y >= num_types * ntag or (y // ntag) in excluded:
+            return None  # O tag
+        return y // ntag, y % ntag
+
+    ps = [parse(int(y)) for y in seq]
+    n = len(ps)
+    begins, ends = [], []
+    for i, p in enumerate(ps):
+        if p is None:
+            begins.append(False)
+            ends.append(False)
+            continue
+        typ, tag = p
+        prev = ps[i - 1] if i > 0 else None
+        nxt = ps[i + 1] if i < n - 1 else None
+        if scheme == "plain":
+            b = e = True
+        elif scheme == "IOB":  # B=0, I=1
+            b = tag == 0 or prev is None or prev[0] != typ
+            e = nxt is None or nxt[0] != typ or nxt[1] == 0
+        elif scheme == "IOE":  # I=0, E=1
+            b = prev is None or prev[0] != typ or prev[1] == 1
+            e = tag == 1 or nxt is None or nxt[0] != typ
+        else:  # IOBES: B=0, I=1, E=2, S=3
+            b = (
+                tag in (0, 3)
+                or prev is None
+                or prev[0] != typ
+                or prev[1] in (2, 3)
+            )
+            e = (
+                tag in (2, 3)
+                or nxt is None
+                or nxt[0] != typ
+                or nxt[1] in (0, 3)
+            )
+        begins.append(b)
+        ends.append(e)
+    chunks = set()
+    for i in range(n):
+        if begins[i]:
+            j = next(k for k in range(i, n) if ends[k])
+            chunks.add((i, j, ps[i][0]))
+    return chunks
+
+
+def chunk_counts(inf, lab, lens, scheme, num_types, excluded=()):
+    n_inf = n_lab = n_cor = 0
+    for b in range(inf.shape[0]):
+        t = int(lens[b]) if lens is not None else inf.shape[1]
+        ci = extract_chunks(inf[b, :t], scheme, num_types, excluded)
+        cl = extract_chunks(lab[b, :t], scheme, num_types, excluded)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    return n_inf, n_lab, n_cor
+
+
+def _chunk_case(scheme, num_types, shape=(4, 12), excluded=(), with_len=True):
+    rng = np.random.RandomState(hash_seed(scheme))
+    ntag = _NUM_TAGS[scheme]
+    hi = num_types * ntag + 1  # includes the O tag
+    inf = rng.randint(0, hi, shape).astype("int64")
+    lab = rng.randint(0, hi, shape).astype("int64")
+    # force agreement on some rows so NumCorrectChunks is non-trivial
+    lab[::2] = inf[::2]
+    lens = (
+        rng.randint(1, shape[1] + 1, (shape[0],)).astype("int32")
+        if with_len
+        else None
+    )
+    n_inf, n_lab, n_cor = chunk_counts(inf, lab, lens, scheme, num_types, excluded)
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    inputs = {"Inference": inf, "Label": lab}
+    if with_len:
+        inputs["SeqLength"] = lens
+    outputs = {
+        "Precision": np.asarray([p], "float32"),
+        "Recall": np.asarray([r], "float32"),
+        "F1-Score": np.asarray([f1], "float32"),
+        "NumInferChunks": np.asarray([n_inf], "int64"),
+        "NumLabelChunks": np.asarray([n_lab], "int64"),
+        "NumCorrectChunks": np.asarray([n_cor], "int64"),
+    }
+    attrs = {
+        "chunk_scheme": scheme,
+        "num_chunk_types": num_types,
+        "excluded_chunk_types": list(excluded),
+    }
+    return inputs, outputs, attrs
+
+
+def hash_seed(s):
+    return sum(ord(c) for c in s)
+
+
+class TestChunkEvalIOB(OpTest):
+    def setUp(self):
+        self.op_type = "chunk_eval"
+        self.inputs, self.outputs, self.attrs = _chunk_case("IOB", 3)
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestChunkEvalIOE(OpTest):
+    def setUp(self):
+        self.op_type = "chunk_eval"
+        self.inputs, self.outputs, self.attrs = _chunk_case("IOE", 2)
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestChunkEvalIOBES(OpTest):
+    def setUp(self):
+        self.op_type = "chunk_eval"
+        self.inputs, self.outputs, self.attrs = _chunk_case("IOBES", 2)
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestChunkEvalPlainExcluded(OpTest):
+    def setUp(self):
+        self.op_type = "chunk_eval"
+        self.inputs, self.outputs, self.attrs = _chunk_case(
+            "plain", 4, excluded=(1,)
+        )
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestChunkEvalNoSeqLength(OpTest):
+    def setUp(self):
+        self.op_type = "chunk_eval"
+        self.inputs, self.outputs, self.attrs = _chunk_case(
+            "IOB", 2, with_len=False
+        )
+
+    def test_check_output(self):
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# hash
+# ---------------------------------------------------------------------------
+
+
+def xxh32_u64(value, seed):
+    """Scalar-python XXH32 of one little-endian uint64 (the <16-byte tail
+    path), independent of the jnp lowering."""
+    P2, P3, P4, P5 = 2246822519, 3266489917, 668265263, 374761393
+    M = 0xFFFFFFFF
+
+    def rotl(v, r):
+        return ((v << r) | (v >> (32 - r))) & M
+
+    h = (seed + P5 + 8) & M
+    for lane in (value & M, (value >> 32) & M):
+        h = (rotl((h + lane * P3) & M, 17) * P4) & M
+    h = ((h ^ (h >> 15)) * P2) & M
+    h = ((h ^ (h >> 13)) * P3) & M
+    return h ^ (h >> 16)
+
+
+class TestHashOp(OpTest):
+    def setUp(self):
+        self.op_type = "hash"
+        ids = np.random.randint(0, 2**31 - 1, (16, 1)).astype("int64")
+        num_hash, mod_by = 4, 10000
+        out = np.empty((16, num_hash, 1), "int64")
+        for i, v in enumerate(ids[:, 0]):
+            for s in range(num_hash):
+                out[i, s, 0] = xxh32_u64(int(v), s) % mod_by
+        self.inputs = {"X": ids}
+        self.outputs = {"Out": out}
+        self.attrs = {"num_hash": num_hash, "mod_by": mod_by}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+def test_hash_layer_feeds_embedding():
+    """The advertised composition: ids → hash buckets → lookup_table."""
+    main = framework.Program()
+    startup = framework.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+            buckets = fluid.layers.hash(ids, hash_size=100, num_hash=2)
+            emb = fluid.layers.embedding(buckets, size=[100, 8])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        (e,) = exe.run(
+            main,
+            feed={"ids": np.arange(6, dtype="int64").reshape(6, 1)},
+            fetch_list=[emb.name],
+        )
+    assert e.shape[0] == 6 and e.shape[-1] == 8
+    assert np.isfinite(e).all()
+
+
+# ---------------------------------------------------------------------------
+# positive_negative_pair
+# ---------------------------------------------------------------------------
+
+
+def pnpair_brute(score, label, qid, weight=None):
+    pos = neg = neu = 0.0
+    n = len(score)
+    for i in range(n):
+        for j in range(i + 1, n):
+            if qid[i] != qid[j] or label[i] == label[j]:
+                continue
+            w = 1.0 if weight is None else 0.5 * (weight[i] + weight[j])
+            hi, lo = (i, j) if label[i] > label[j] else (j, i)
+            if score[hi] > score[lo]:
+                pos += w
+            elif score[hi] < score[lo]:
+                neg += w
+            else:
+                neu += w
+    return pos, neg, neu
+
+
+class TestPositiveNegativePairOp(OpTest):
+    def setUp(self):
+        self.op_type = "positive_negative_pair"
+        n = 24
+        score = np.random.rand(n, 1).astype("float32")
+        label = np.random.randint(0, 3, (n, 1)).astype("float32")
+        qid = np.random.randint(0, 4, (n, 1)).astype("int64")
+        # force some score ties for the neutral bucket
+        score[::5] = 0.5
+        pos, neg, neu = pnpair_brute(
+            score[:, 0], label[:, 0], qid[:, 0]
+        )
+        self.inputs = {"Score": score, "Label": label, "QueryID": qid}
+        self.outputs = {
+            "PositivePair": np.asarray([pos], "float32"),
+            "NegativePair": np.asarray([neg], "float32"),
+            "NeutralPair": np.asarray([neu], "float32"),
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+
+def test_pnpair_on_mq2007():
+    """The shipped ranking dataset end to end: score mq2007 listwise batches
+    with the hidden-scorer features and evaluate orientation quality via the
+    in-graph pair metric against the brute-force count."""
+    from paddle_tpu import dataset
+
+    feats, rels, qids = [], [], []
+    for q, (f, r) in enumerate(dataset.mq2007.train("listwise")()):
+        feats.append(np.asarray(f, "float32"))
+        rels.append(np.asarray(r, "float32").reshape(-1, 1))
+        qids.append(np.full((len(f), 1), q, "int64"))
+        if q >= 3:
+            break
+    x = np.concatenate(feats)
+    label = np.concatenate(rels)
+    qid = np.concatenate(qids)
+    score = x.mean(axis=1, keepdims=True).astype("float32")
+
+    main = framework.Program()
+    with fluid.program_guard(main, framework.Program()):
+        blk = main.global_block()
+        for nm, arr, dt in (
+            ("score", score, "float32"),
+            ("label", label, "float32"),
+            ("qid", qid, "int64"),
+        ):
+            blk.create_var(name=nm, shape=arr.shape, dtype=dt)
+        pos, neg, neu = fluid.layers.positive_negative_pair(
+            blk.var("score"), blk.var("label"), blk.var("qid")
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        got = exe.run(
+            main,
+            feed={"score": score, "label": label, "qid": qid},
+            fetch_list=[pos.name, neg.name, neu.name],
+        )
+    want = pnpair_brute(score[:, 0], label[:, 0], qid[:, 0])
+    np.testing.assert_allclose([g.item() for g in got], want, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# ref_by_trainer_id
+# ---------------------------------------------------------------------------
+
+
+class TestRefByTrainerIdOp(OpTest):
+    def setUp(self):
+        self.op_type = "ref_by_trainer_id"
+        xs = [np.random.rand(3, 4).astype("float32") for _ in range(5)]
+        tid = np.asarray([2], "int64")
+        self.inputs = {
+            "X": [("x%d" % i, x) for i, x in enumerate(xs)],
+            "TrainerId": [("tid", tid)],
+        }
+        self.outputs = {"Out": xs[2]}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+# ---------------------------------------------------------------------------
+# ChunkEvaluator wiring: counts computed in-framework
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_evaluator_streams_in_framework_counts():
+    main = framework.Program()
+    with fluid.program_guard(main, framework.Program()):
+        blk = main.global_block()
+        blk.create_var(name="inf", shape=(3, 10), dtype="int64")
+        blk.create_var(name="lab", shape=(3, 10), dtype="int64")
+        blk.create_var(name="len", shape=(3,), dtype="int32")
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            ev = fluid.evaluator.ChunkEvaluator(
+                input=blk.var("inf"),
+                label=blk.var("lab"),
+                chunk_scheme="IOB",
+                num_chunk_types=3,
+                seq_length=blk.var("len"),
+            )
+    assert len(ev.metrics) == 3
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(7)
+    want = [0, 0, 0]
+    with scope_guard(Scope()):
+        for _ in range(3):
+            inf = rng.randint(0, 7, (3, 10)).astype("int64")
+            lab = inf.copy()
+            lab[1] = rng.randint(0, 7, 10)
+            lens = rng.randint(1, 11, (3,)).astype("int32")
+            counts = exe.run(
+                main,
+                feed={"inf": inf, "lab": lab, "len": lens},
+                fetch_list=[v.name for v in ev.metrics],
+            )
+            ev.update(*counts)
+            for k, c in enumerate(chunk_counts(inf, lab, lens, "IOB", 3)):
+                want[k] += c
+    p, r, f1 = ev.eval(None)
+    wp = want[2] / want[0] if want[0] else 0.0
+    wr = want[2] / want[1] if want[1] else 0.0
+    np.testing.assert_allclose(
+        [p, r], [wp, wr], rtol=1e-6
+    )
+    assert 0.0 <= f1 <= 1.0

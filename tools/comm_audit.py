@@ -309,39 +309,52 @@ def _attention_step_hlo():
 
 
 def _gpipe_step_hlo():
-    """dp x pp GPipe train step (the dryrun_multichip stage-3 computation)."""
+    """dp x pp GPipe train step on the PROGRAM path (dryrun_multichip
+    stage 3): a heterogeneous-width fluid MLP lowered end-to-end by
+    ParallelExecutor (MeshConfig(pp=4) -> partition + schedule), audited
+    from its own compiled_hlo() — the collectives counted here are the
+    ones real Program training pays, not a hand-built stand-in."""
     import jax
-    import jax.numpy as jnp
 
-    from paddle_tpu.parallel import MeshConfig, gpipe, make_mesh
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.parallel import MeshConfig
+    from paddle_tpu.parallel_executor import ExecutionStrategy
 
     n = jax.device_count()
     if n % 4:
         return None, None
     pp = 4
-    mesh = make_mesh(MeshConfig(dp=n // pp, pp=pp))
-    D = 16
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = x
+        for w in (48, 32, 24):
+            h = fluid.layers.fc(h, size=w, act="relu")
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    es = ExecutionStrategy()
+    es.pipeline_schedule = "gpipe"
+    es.num_microbatches = 4
+    dp = n // pp
     rng = np.random.RandomState(5)
-    params = {
-        "w": jnp.asarray(rng.randn(8, D, D).astype("float32") * 0.3),
-        "b": jnp.asarray(rng.randn(8, D).astype("float32") * 0.1),
-    }
-    x = jnp.asarray(rng.randn(4 * (n // pp), D).astype("float32"))
-    tgt = jnp.asarray((rng.randn(4 * (n // pp), D) * 0.1).astype("float32"))
-
-    def stage(p, h):
-        return jnp.tanh(h @ p["w"] + p["b"])
-
-    def step(params):
-        def loss_fn(p):
-            y = gpipe(stage, p, x, n_micro=4, mesh=mesh)
-            return jnp.mean((y - tgt) ** 2)
-
-        l, g = jax.value_and_grad(loss_fn)(params)
-        new = jax.tree_util.tree_map(lambda p, gg: p - 0.1 * gg, params, g)
-        return l, new
-
-    hlo = jax.jit(step).lower(params).compile().as_text()
+    xs = rng.randn(8 * dp, 16).astype("float32")
+    ys = rng.randint(0, 4, (8 * dp, 1)).astype("int64")
+    scope = Scope(seed=5)
+    with scope_guard(scope):
+        fluid.Executor().run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope,
+            mesh_config=MeshConfig(dp=dp, pp=pp), exec_strategy=es,
+        )
+        pe.run(fetch_list=[loss.name], feed={"x": xs, "y": ys})
+        hlo = pe.compiled_hlo()
+        mesh = pe._mesh
     return hlo, mesh
 
 

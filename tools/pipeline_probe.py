@@ -8,6 +8,9 @@ device pair and writes PIPELINE_KEEPUP.json:
                           wire formats (the transfer the feeder thread does)
   device_step           — staged-batch ResNet-50 bs=256 train-step rate
   pyreader_uint8        — the full async pipeline (PyReader, uint8 wire)
+  cached_epoch          — PyReader(cache_epoch=True) replay rate: epoch 1
+                          pays the wire once, later epochs serve staged
+                          device arrays (wire out of the loop)
 
 The keep-up verdict is mechanical: if wire_uint8 (bytes/s) cannot carry
 batch_bytes x device_step (batches/s), the pipeline is WIRE-bound and no
@@ -37,76 +40,154 @@ def main():
 
     import bench
 
+    # --quick: skip the ResNet-50 stages (device_step, pyreader_uint8) —
+    # they need an accelerator-class host; the host/wire/cache stages still
+    # run and the device_step rate is carried forward from the last full
+    # probe (with provenance recorded in the JSON).
+    quick = "--quick" in sys.argv[1:]
     bs = 256
-    record = {"batch_size": bs, "device": str(jax.devices()[0])}
+    out_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PIPELINE_KEEPUP.json",
+    )
+    prior = {}
+    if quick and os.path.exists(out_path):
+        with open(out_path) as f:
+            prior = json.load(f)
+    # carry mode: a prior full probe exists — its host/wire/device-step
+    # numbers (measured on the real accelerator host) stay as the
+    # first-epoch path; this run only adds the cached-epoch measurement,
+    # labelled with the host it ran on.
+    carry = quick and "device_step_batches_per_s" in prior
+    if carry:
+        record = dict(prior)
+        record["cached_epoch_measured_on"] = str(jax.devices()[0])
+    else:
+        record = {"batch_size": bs, "device": str(jax.devices()[0])}
 
     # stage 1: host batch assembly (decode/stack analog — synthetic pixels)
     imgs = [np.random.randint(0, 256, (3, 224, 224), dtype=np.uint8)
             for _ in range(bs)]
-    t0 = time.perf_counter()
-    reps = 8
-    for _ in range(reps):
-        batch = np.stack(imgs)
-    dt = (time.perf_counter() - t0) / reps
-    record["host_batch_assembly_batches_per_s"] = round(1 / dt, 2)
-    record["host_batch_assembly_MBps"] = round(batch.nbytes / dt / 1e6, 1)
+    batch = np.stack(imgs)
+    if not carry:
+        t0 = time.perf_counter()
+        reps = 8
+        for _ in range(reps):
+            batch = np.stack(imgs)
+        dt = (time.perf_counter() - t0) / reps
+        record["host_batch_assembly_batches_per_s"] = round(1 / dt, 2)
+        record["host_batch_assembly_MBps"] = round(batch.nbytes / dt / 1e6, 1)
 
     # stage 2: wire throughput per format
-    for name, arr in [
-        ("uint8", batch),
-        ("f32", batch.astype(np.float32)),
-    ]:
-        x = jax.device_put(arr)  # warm
-        np.asarray(x[0, 0, 0, :2])
-        t0 = time.perf_counter()
-        n = 2 if name == "f32" else 4
-        for _ in range(n):
-            x = jax.device_put(arr)
-        np.asarray(x[0, 0, 0, :2])
-        dt = (time.perf_counter() - t0) / n
-        record["wire_%s_MBps" % name] = round(arr.nbytes / dt / 1e6, 1)
-        record["wire_%s_batches_per_s" % name] = round(1 / dt, 3)
+    if not carry:
+        for name, arr in [
+            ("uint8", batch),
+            ("f32", batch.astype(np.float32)),
+        ]:
+            x = jax.device_put(arr)  # warm
+            np.asarray(x[0, 0, 0, :2])
+            t0 = time.perf_counter()
+            n = 2 if name == "f32" else 4
+            for _ in range(n):
+                x = jax.device_put(arr)
+            np.asarray(x[0, 0, 0, :2])
+            dt = (time.perf_counter() - t0) / n
+            record["wire_%s_MBps" % name] = round(arr.nbytes / dt / 1e6, 1)
+            record["wire_%s_batches_per_s" % name] = round(1 / dt, 3)
 
     # stage 3: device step rate (staged batches, no wire in the loop)
-    ips, single_ips, _, _ = bench.run(batch_size=bs, steps=16,
-                                      measure_pipeline=False)
-    steprate = max(ips, single_ips) / bs
-    record["device_step_batches_per_s"] = round(steprate, 3)
+    if quick:
+        steprate = prior.get("device_step_batches_per_s")
+        if steprate is not None:
+            record["device_step_batches_per_s"] = steprate
+            record["device_step_source"] = "carried from prior full probe on %s" % (
+                prior.get("device", "unknown device"),
+            )
+    else:
+        ips, single_ips, _, _ = bench.run(batch_size=bs, steps=16,
+                                          measure_pipeline=False)
+        steprate = max(ips, single_ips) / bs
+        record["device_step_batches_per_s"] = round(steprate, 3)
 
     # stage 4: full pipeline (uint8 wire, async staging)
-    try:
-        rng = np.random.RandomState(0)
-        main_, startup, loss = bench.build(bs)
-        import paddle_tpu.fluid as fluid
-        from paddle_tpu.executor import Scope, scope_guard
-        from paddle_tpu.transpiler.bf16_transpiler import Bf16Transpiler
+    if not quick:
+        try:
+            rng = np.random.RandomState(0)
+            main_, startup, loss = bench.build(bs)
+            import paddle_tpu.fluid as fluid
+            from paddle_tpu.executor import Scope, scope_guard
+            from paddle_tpu.transpiler.bf16_transpiler import Bf16Transpiler
 
-        exe = fluid.Executor(fluid.TPUPlace())
-        with scope_guard(Scope(seed=0)):
-            exe.run(startup)
-            Bf16Transpiler().transpile(main_)
-            pipe_ips = bench._run_pyreader_pass(
-                exe, main_, loss, bs, 12, 2, 2, rng, wire="uint8"
-            )
-        record["pyreader_uint8_batches_per_s"] = round(pipe_ips / bs, 3)
-    except Exception as e:  # evidence table must still land
-        record["pyreader_uint8_error"] = repr(e)
+            exe = fluid.Executor(fluid.TPUPlace())
+            with scope_guard(Scope(seed=0)):
+                exe.run(startup)
+                Bf16Transpiler().transpile(main_)
+                pipe_ips = bench._run_pyreader_pass(
+                    exe, main_, loss, bs, 12, 2, 2, rng, wire="uint8"
+                )
+            record["pyreader_uint8_batches_per_s"] = round(pipe_ips / bs, 3)
+        except Exception as e:  # evidence table must still land
+            record["pyreader_uint8_error"] = repr(e)
+    elif "pyreader_uint8_batches_per_s" in prior:
+        record["pyreader_uint8_batches_per_s"] = prior[
+            "pyreader_uint8_batches_per_s"]
+
+    # stage 5: device-resident epoch cache (PyReader cache_epoch=True) —
+    # epoch 1 pays the wire once; epoch 2+ replays staged device arrays, so
+    # the serve rate is queue handoff, not host assembly or transfer
+    try:
+        from paddle_tpu.py_reader import PyReader
+
+        n_batches = 6
+
+        def src():
+            for _ in range(n_batches):
+                yield {"image": batch}
+
+        r = PyReader(["image"], capacity=4, cache_epoch=True)
+        r.decorate_tensor_provider(src)
+        r.start()
+        for _ in r():  # epoch 1: stages + caches (wire path, timed above)
+            pass
+        t0 = time.perf_counter()
+        served = 0
+        for _ in range(3):  # epochs 2-4: cached replay
+            r.start()
+            for b in r():
+                jax.block_until_ready(b["image"])
+                served += 1
+        dt = (time.perf_counter() - t0) / served
+        record["cached_epoch_batches_per_s"] = round(1 / dt, 3)
+    except Exception as e:
+        record["cached_epoch_error"] = repr(e)
 
     # the verdict line: which stage binds?
     wire_bps = record["wire_uint8_batches_per_s"]
-    rates = {
-        "host_assembly": record["host_batch_assembly_batches_per_s"],
-        "wire_uint8": wire_bps,
-        "device_step": record["device_step_batches_per_s"],
-    }
-    record["binding_stage"] = min(rates, key=rates.get)
-    record["wire_bound"] = bool(wire_bps < record["device_step_batches_per_s"])
-    record["keep_up_frac_ceiling_uint8"] = round(
-        min(1.0, wire_bps / record["device_step_batches_per_s"]), 3
-    )
+    step_bps = record.get("device_step_batches_per_s")
+    if step_bps is not None:
+        rates = {
+            "host_assembly": record["host_batch_assembly_batches_per_s"],
+            "wire_uint8": wire_bps,
+            "device_step": step_bps,
+        }
+        record["binding_stage"] = min(rates, key=rates.get)
+        record["wire_bound"] = bool(wire_bps < step_bps)
+        record["keep_up_frac_ceiling_uint8"] = round(
+            min(1.0, wire_bps / step_bps), 3
+        )
+        # with the epoch cached on device the wire stage drops out of the
+        # loop: the keep-up ceiling becomes replay rate vs device step rate.
+        # The wire-bound numbers above stay as the FIRST-epoch path; from
+        # epoch 2 on, cache_epoch serving governs.
+        if "cached_epoch_batches_per_s" in record:
+            record["keep_up_frac_cached_epoch"] = round(
+                min(1.0, record["cached_epoch_batches_per_s"] / step_bps), 3
+            )
+            record["cached_epoch_removes_wire_bound"] = bool(
+                record["keep_up_frac_cached_epoch"] >= 0.9
+            )
 
-    with open(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "PIPELINE_KEEPUP.json"), "w") as f:
+    with open(out_path, "w") as f:
         json.dump(record, f, indent=1)
     print(json.dumps(record, indent=1))
 
