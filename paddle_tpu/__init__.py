@@ -27,6 +27,7 @@ from . import (
     unique_name,
 )
 from . import distributed  # noqa: F401
+from . import observability  # noqa: F401
 from . import resilience  # noqa: F401
 from . import profiler  # noqa: F401
 from . import imperative  # noqa: F401

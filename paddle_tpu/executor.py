@@ -20,7 +20,9 @@ that stochastic ops consume.
 """
 
 import itertools
+import sys
 import threading
+import time
 import weakref
 
 import numpy as np
@@ -40,6 +42,47 @@ def _flags_profile_ops():
     from . import flags as _flags
 
     return _flags.get_flags("profile_ops")["profile_ops"]
+
+
+def _telemetry_begin():
+    """(collector, t0) when telemetry is active, else (None, None) — the
+    disabled path costs one flags lookup per run (observability.stepstats)."""
+    from .observability import stepstats as _ss
+
+    if not _ss.active():
+        return None, None
+    return _ss.collector(), time.perf_counter()
+
+
+def _telemetry_record(obs, t0, compiled, cache_hit, nan_trip, n_steps,
+                      result, return_numpy, pp=None, n_micro=None,
+                      schedule=None):
+    """Shared Executor/ParallelExecutor step-record tail. Loss is extracted
+    best-effort from the first fetch ONLY when it is already host-side
+    (return_numpy) — telemetry must never add a device sync of its own. A
+    telemetry failure (e.g. export-dir IO) must never fail the step: it is
+    reported once and swallowed."""
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    loss = None
+    if return_numpy and result:
+        try:
+            a = np.asarray(result[0])
+            if a.size >= 1 and a.dtype.kind == "f":
+                # multi-step fetches come back [k, ...]: report the last step
+                loss = float(a.reshape(-1)[-1])
+        except (TypeError, ValueError):
+            pass
+    try:
+        obs.record_step(
+            wall_ms, n_steps=n_steps, cache_hit=cache_hit, nan_trip=nan_trip,
+            pp=pp, n_micro=n_micro, schedule=schedule, loss=loss,
+            training=bool(getattr(compiled, "mut_names", ())),
+        )
+    except Exception as e:
+        if not getattr(_telemetry_record, "_warned", False):
+            _telemetry_record._warned = True
+            print("telemetry record failed (disabled for this message): %r"
+                  % e, file=sys.stderr)
 
 
 class Scope:
@@ -1354,6 +1397,10 @@ class Executor:
         the program's started py_readers."""
         if program is None:
             program = framework.default_main_program()
+        # telemetry (observability.stepstats): t0 brackets the WHOLE run —
+        # reader pull, dispatch, and the fetch conversion (which is where
+        # the device sync lands under return_numpy / FLAGS_benchmark)
+        _obs, _obs_t0 = _telemetry_begin()
         # force_multi: a reader pull that returned a 1-batch epoch tail still
         # runs through _MultiStepBlock so fetches keep their [k, ...] axis
         force_multi = False
@@ -1424,6 +1471,7 @@ class Executor:
             )
 
         compiled = self._cache.get(key) if use_program_cache else None
+        _obs_cache_hit = compiled is not None
         if compiled is None:
             has_host = any(
                 registry.is_registered(op.type) and registry.get(op.type).is_host
@@ -1508,9 +1556,15 @@ class Executor:
                     for n, a in feed_arrays.items()
                 },
             )
-        return self._finish_run(
+        result = self._finish_run(
             compiled, scope, fetch_names, fetches, return_numpy, nan_ok=nan_ok
         )
+        if _obs is not None:
+            _telemetry_record(
+                _obs, _obs_t0, compiled, _obs_cache_hit, nan_ok,
+                steps_per_run if is_multi else 1, result, return_numpy,
+            )
+        return result
 
     def compiled_hlo(self):
         """Post-optimization HLO text of the most recently run compiled

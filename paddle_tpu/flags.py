@@ -30,6 +30,14 @@ Honored flags:
   device sync per op, so the profiler table attributes time per op type —
   the reference's per-op RecordEvent tables (operator.cc:157). Slower and
   unfused by construction; a diagnosis mode, never a training mode.
+- telemetry_dir: when set, the observability layer exports per-step
+  telemetry (JSONL event shards + a Prometheus scrape file) into this
+  directory — docs/observability.md; empty (default) disables export.
+- telemetry_interval_steps: steps between snapshot records / Prometheus
+  rewrites / the rank-0 shard merge (observability/export.py).
+- telemetry_log_every: > 0 prints one structured health line to stderr
+  every N recorded steps (step ms, steps/s, loss if fetched, health counter
+  deltas) — the "is it alive" signal for long runs; 0 (default) disables.
 - eager_delete_tensor_gb / fraction_of_gpu_memory_to_use /
   paddle_num_threads: accepted for API compatibility; storage lifetime and
   threading are XLA/PJRT-owned here (documented no-ops).
@@ -53,6 +61,9 @@ _DEFAULTS = {
     "resilience_lr_decay": 0.5,
     "dist_init_max_retry": 3,
     "profile_ops": False,
+    "telemetry_dir": "",
+    "telemetry_interval_steps": 50,
+    "telemetry_log_every": 0,
 }
 
 _flags = {}

@@ -1,0 +1,36 @@
+"""Always-on runtime telemetry (docs/observability.md).
+
+The post-hoc profiler (paddle_tpu.profiler: RecordEvent tables, xla_trace)
+answers "where did this session's time go"; this package answers "is the
+run healthy RIGHT NOW" — the streaming complement a production jax_graft
+deployment is operated with:
+
+- registry:  typed thread-safe metrics (Counter/Gauge/Histogram) shared by
+             every subsystem; resilience.health is a compat shim over it;
+- stepstats: per-step StepStats collected from Executor/ParallelExecutor,
+             the input pipeline's stall time, the NaN guard, and the
+             pipeline-parallel schedule (runtime bubble fraction);
+- export:    flag-gated JSONL event sink + Prometheus scrape file, per-host
+             shards with a rank-0 merged view (FLAGS_telemetry_dir).
+
+Live view: `python tools/monitor.py <telemetry_dir>`.
+"""
+
+from . import export, registry, stepstats  # noqa: F401
+from .registry import Counter, Gauge, Histogram, MetricRegistry, default_registry
+from .stepstats import StepStats, StepStatsCollector, active, collector
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "default_registry",
+    "StepStats",
+    "StepStatsCollector",
+    "active",
+    "collector",
+    "registry",
+    "stepstats",
+    "export",
+]

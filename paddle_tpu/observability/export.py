@@ -1,0 +1,218 @@
+"""Telemetry export: rotation-safe JSONL event sink + Prometheus scrape file.
+
+Flag-gated (FLAGS_telemetry_dir, FLAGS_telemetry_interval_steps — flags.py):
+when a dir is set, every recorded step appends one JSON line to a PER-HOST
+shard file `telemetry-host<k>.jsonl` (k = jax.process_index(), 0 on a single
+host), and every `interval_steps` steps a `snapshot` record (full metric
+registry, health counters, device-memory watermarks, pipeline-bubble
+estimate) plus a Prometheus text file `metrics-host<k>.prom` are written.
+
+Schema (every record): {"kind": "step"|"snapshot", "step": int, "ts": float,
+"host": int, ...}. Step records carry wall_ms/n_steps/feed_stall_ms/
+cache_hit/nan_trip (+ pp/n_micro/schedule/loss when present); snapshot
+records carry metrics/health/mem/bubble. tools/monitor.py renders the
+stream; tools/timeline.py --telemetry_path turns it into chrome-trace
+counter tracks.
+
+Rotation: a shard that crosses `max_bytes` is renamed to `<name>.1`
+(previous `.1` dropped) and a fresh shard is started — the sink is safe to
+leave on for a multi-day run. Writes are line-buffered appends; the
+Prometheus file and the merged view are written atomically (tmp + rename)
+so a scraper never reads a torn file.
+
+Multi-host: each process writes only its own shard (no cross-host writes to
+contend on); process identity comes from the SAME jax.distributed rendezvous
+parallel/multihost.init_distributed performs — after it, jax.process_index()
+is the trainer rank. Rank 0 additionally maintains `telemetry-merged.jsonl`,
+a ts-sorted merge of every host shard it can see (meaningful when the
+telemetry dir is shared storage; per-host shards remain the ground truth).
+
+Device-memory watermarks ride the snapshot records via
+jax.local_devices()[*].memory_stats() — present on TPU, None on the CPU
+test backend (the field is simply omitted there).
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "TelemetryExporter",
+    "device_memory_stats",
+    "merge_host_shards",
+    "SHARD_PATTERN",
+]
+
+SHARD_PATTERN = "telemetry-host*.jsonl*"
+MERGED_NAME = "telemetry-merged.jsonl"
+
+
+def _process_index():
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def _process_count():
+    try:
+        import jax
+
+        return int(jax.process_count())
+    except Exception:
+        return 1
+
+
+def device_memory_stats():
+    """{mem_peak_bytes, mem_bytes_in_use, mem_limit_bytes} maxed/summed over
+    local devices, or {} where the backend exposes no memory_stats (CPU)."""
+    try:
+        import jax
+
+        peak = in_use = limit = 0
+        seen = False
+        for d in jax.local_devices():
+            ms = d.memory_stats()
+            if not ms:
+                continue
+            seen = True
+            peak = max(peak, ms.get("peak_bytes_in_use", 0))
+            in_use = max(in_use, ms.get("bytes_in_use", 0))
+            limit = max(limit, ms.get("bytes_limit", 0))
+        if not seen:
+            return {}
+        out = {"mem_peak_bytes": peak, "mem_bytes_in_use": in_use}
+        if limit:
+            out["mem_limit_bytes"] = limit
+        return out
+    except Exception:
+        return {}
+
+
+def _atomic_write(path, text):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def read_records(path):
+    """Parse one JSONL file, skipping torn trailing lines (a crash mid-append
+    leaves at most one)."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def merge_host_shards(out_dir, out_name=MERGED_NAME):
+    """ts-sorted merge of every host shard (rotated shards included) into
+    `out_name`, written atomically. Returns the merged path (or None when no
+    shards exist). Normally called by rank 0 at flush time; also usable
+    post-hoc on a collected log dir."""
+    paths = sorted(glob.glob(os.path.join(out_dir, SHARD_PATTERN)))
+    paths = [p for p in paths if not p.endswith(".tmp")]
+    if not paths:
+        return None
+    records = []
+    for p in paths:
+        records.extend(read_records(p))
+    records.sort(key=lambda r: (r.get("ts", 0), r.get("host", 0)))
+    out = os.path.join(out_dir, out_name)
+    _atomic_write(out, "".join(json.dumps(r) + "\n" for r in records))
+    return out
+
+
+class TelemetryExporter:
+    def __init__(self, out_dir, interval_steps=50, max_bytes=64 << 20,
+                 registry=None):
+        from . import registry as _registry
+
+        self.out_dir = out_dir
+        self.interval_steps = max(int(interval_steps), 1)
+        self.max_bytes = max_bytes
+        self.registry = registry or _registry.default_registry()
+        self.host = _process_index()
+        os.makedirs(out_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._shard_path = os.path.join(
+            out_dir, "telemetry-host%d.jsonl" % self.host
+        )
+        self._prom_path = os.path.join(
+            out_dir, "metrics-host%d.prom" % self.host
+        )
+        self._fh = open(self._shard_path, "a")
+        self._steps_since_flush = 0
+
+    # ---- sink -----------------------------------------------------------
+    def _write(self, record):
+        record.setdefault("ts", time.time())
+        record["host"] = self.host
+        line = json.dumps(record) + "\n"
+        with self._lock:
+            self._fh.write(line)
+            self._fh.flush()
+            if self._fh.tell() >= self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self):
+        self._fh.close()
+        os.replace(self._shard_path, self._shard_path + ".1")
+        self._fh = open(self._shard_path, "a")
+
+    def on_step(self, step_record, collector=None):
+        self._write(step_record)
+        self._steps_since_flush += step_record.get("n_steps", 1)
+        if self._steps_since_flush >= self.interval_steps:
+            self.flush(collector)
+
+    def flush(self, collector=None):
+        """Interval work: snapshot record into the shard, Prometheus scrape
+        file, rank-0 merged view."""
+        self._steps_since_flush = 0
+        from ..resilience import health as _health
+
+        snap = {
+            "kind": "snapshot",
+            "step": getattr(collector, "_step", None) if collector else None,
+            "metrics": self.registry.snapshot(),
+            "health": _health.snapshot(),
+        }
+        mem = device_memory_stats()
+        if mem:
+            snap["mem"] = mem
+            self.registry.gauge(
+                "device/mem_peak_bytes",
+                "max over local devices of peak_bytes_in_use",
+            ).set(mem["mem_peak_bytes"])
+        if collector is not None:
+            bub = collector.bubble_estimate()
+            if bub is not None:
+                snap["bubble"] = bub
+        self._write(snap)
+        _atomic_write(self._prom_path, self.registry.to_prometheus())
+        if self.host == 0 and _process_count() > 1:
+            try:
+                merge_host_shards(self.out_dir)
+            except OSError:
+                pass  # shared-fs hiccup: shards remain the ground truth
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

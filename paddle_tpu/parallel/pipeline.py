@@ -56,7 +56,19 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 # moved to collectives so every shard_map user in the package shares it)
 from .collectives import SHARD_MAP_CHECK_KW as _CHECK_KW, axis_size, shard_map
 
-__all__ = ["gpipe", "gpipe_spmd", "pipeline_fwd_spmd", "pipeline_1f1b_spmd"]
+__all__ = [
+    "gpipe",
+    "gpipe_spmd",
+    "pipeline_fwd_spmd",
+    "pipeline_1f1b_spmd",
+    "analytic_bubble",
+]
+
+# (pp-1)/(m+pp-1), the fill-drain bound both schedules share. Canonical home
+# is observability.stepstats (no jax dependency) so the telemetry layer can
+# publish the analytic gauge next to its runtime two-m-slope measurement;
+# re-exported here because this module owns the schedules it describes.
+from ..observability.stepstats import analytic_bubble  # noqa: E402
 
 
 def _apply_stages(stage_fn, params_local, x):

@@ -27,6 +27,8 @@ from .executor import (
     _MultiStepBlock,
     _PipelinedBlock,
     _as_feed_array,
+    _telemetry_begin,
+    _telemetry_record,
     global_scope,
 )
 from .framework import Variable
@@ -154,6 +156,7 @@ class ParallelExecutor:
         meaning of per-DEVICE dicts and is only valid for k=1); fetches come
         back stacked [k, ...]."""
         feed = feed if feed is not None else (feed_dict or {})
+        _obs, _obs_t0 = _telemetry_begin()
         force_multi = False  # 1-batch epoch tail keeps the [k, ...] contract
         if not feed:
             # pull staged batches from started py_readers, like Executor.run
@@ -225,6 +228,7 @@ class ParallelExecutor:
             else None,
         )
         compiled = self._cache.get(key)
+        _obs_cache_hit = compiled is not None
         if compiled is None:
             # feed_ranks are UNSTACKED ranks: rank 0 (scalars) replicate
             feed_ranks = {
@@ -291,9 +295,19 @@ class ParallelExecutor:
                 for n, a in sharded.items()
             },
         )
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return fetches
+        result = [np.asarray(f) for f in fetches] if return_numpy else fetches
+        if _obs is not None:
+            # pp runs carry their schedule so the collector can group step
+            # times by (pp, schedule, m) for the two-m-slope bubble gauge
+            plan = getattr(compiled, "stage_plan", None)
+            _telemetry_record(
+                _obs, _obs_t0, compiled, _obs_cache_hit, False,
+                steps_per_run if is_multi else 1, result, return_numpy,
+                pp=pp if pp > 1 else None,
+                n_micro=plan["n_micro"] if plan else None,
+                schedule=plan["schedule"] if plan else None,
+            )
+        return result
 
     def compiled_hlo(self):
         """Post-optimization HLO text of the most recently run SPMD block

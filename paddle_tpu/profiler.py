@@ -198,22 +198,11 @@ def _hlo_op_map(hlo_text):
     return mapping
 
 
-def device_instr_events(log_dir):
-    """Per-HLO-instruction device timings from an xla_trace log dir:
-    {instr_name: [count, total_ms, min_ms, max_ms]}. Shared base for
-    device_op_profile and tools/mfu_audit.py."""
-    import glob as _glob
-
-    from jax.profiler import ProfileData
-
-    paths = sorted(
-        _glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
-    )
-    if not paths:
-        raise FileNotFoundError("no xplane.pb under %r — run xla_trace first" % log_dir)
-    events = {}
-    pd = ProfileData.from_file(paths[-1])
-    for plane in pd.planes:
+def _merge_device_plane_events(planes, events):
+    """Fold one xplane's device planes into the shared `events` table
+    ({instr_name: [count, total_ms, min_ms, max_ms]}). Separated from the
+    file loop so synthetic plane data can drive it in tests."""
+    for plane in planes:
         if "TPU" not in plane.name and "GPU" not in plane.name:
             continue
         for line in plane.lines:
@@ -233,6 +222,32 @@ def device_instr_events(log_dir):
                 row[1] += dur_ms
                 row[2] = min(row[2], dur_ms)
                 row[3] = max(row[3], dur_ms)
+    return events
+
+
+def device_instr_events(log_dir):
+    """Per-HLO-instruction device timings from an xla_trace log dir:
+    {instr_name: [count, total_ms, min_ms, max_ms]}. Shared base for
+    device_op_profile and tools/mfu_audit.py.
+
+    ALL xplane.pb files under the dir are merged — a trace session writes one
+    per host (multi-host run) and a restarted/repeated trace leaves several;
+    reading only the newest silently dropped every other host's kernels."""
+    import glob as _glob
+
+    paths = sorted(
+        _glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
+    )
+    if not paths:
+        raise FileNotFoundError("no xplane.pb under %r — run xla_trace first" % log_dir)
+    # module-attr access (not `from ... import`) so the name resolves at call
+    # time — older jax builds lack ProfileData, and tests substitute it
+    import jax.profiler as _jprof
+
+    profile_data = _jprof.ProfileData
+    events = {}
+    for path in paths:
+        _merge_device_plane_events(profile_data.from_file(path).planes, events)
     return events
 
 

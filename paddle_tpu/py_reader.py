@@ -224,7 +224,20 @@ class PyReader:
         pushed = getattr(self, "_pushed_back", None)
         if pushed:
             return pushed.popleft()
-        item = self._queue.get()
+        # telemetry: time blocked on the staging queue — that is the input
+        # pipeline failing to keep up (the device would idle exactly this
+        # long), recorded as feed-stall on the next step
+        # (observability/stepstats.py; only when telemetry is active)
+        from .observability import stepstats as _ss
+
+        if _ss.active():
+            import time as _time
+
+            t0 = _time.perf_counter()
+            item = self._queue.get()
+            _ss.collector().add_feed_stall((_time.perf_counter() - t0) * 1e3)
+        else:
+            item = self._queue.get()
         if isinstance(item, _FeederError):
             self._started = False
             raise item.exc

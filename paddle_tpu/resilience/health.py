@@ -1,9 +1,13 @@
 """Process-wide health counters for degraded-but-alive events.
 
 Surviving a fault silently is almost as bad as dying from it: operators need
-to see that a run skipped 3 NaN steps and retried 40 RPCs. Counters are a
-plain thread-safe name->int map; runners print `snapshot()` at exit (the
-dist test runners emit it as a HEALTH json line).
+to see that a run skipped 3 NaN steps and retried 40 RPCs. The incr/get/
+snapshot/reset API is unchanged since PR 1, but the storage now lives in the
+shared observability metric registry (observability/registry.py) as counters
+named "health/<name>" — so the same events ride the telemetry JSONL and
+Prometheus exports (FLAGS_telemetry_dir), appear in the periodic health line
+(FLAGS_telemetry_log_every), and render in tools/monitor.py, while the
+runners keep printing `snapshot()` at exit exactly as before.
 
 Well-known counter names (incremented by the wired hook points):
   nan_steps_skipped   executor NaN/Inf step guard fired
@@ -15,30 +19,38 @@ Well-known counter names (incremented by the wired hook points):
   ckpt_skipped_invalid      load_latest_valid skipped a torn checkpoint
 """
 
-import threading
+from ..observability import registry as _registry
 
 __all__ = ["incr", "get", "snapshot", "reset"]
 
-_lock = threading.Lock()
-_counters = {}
+_PREFIX = "health/"
+
+
+def _reg():
+    return _registry.default_registry()
 
 
 def incr(name, n=1):
-    with _lock:
-        _counters[name] = _counters.get(name, 0) + n
-        return _counters[name]
+    return int(_reg().counter(_PREFIX + name).inc(n))
 
 
 def get(name):
-    with _lock:
-        return _counters.get(name, 0)
+    m = _reg().get(_PREFIX + name)
+    return int(m.value()) if m is not None else 0
 
 
 def snapshot():
-    with _lock:
-        return dict(_counters)
+    """{name: count} of every counter incremented since the last reset —
+    same contract as the original plain-dict implementation (a counter
+    exists only once incr'd, so reset() -> snapshot() == {})."""
+    reg = _reg()
+    out = {}
+    for full in reg.names(_PREFIX):
+        m = reg.get(full)
+        if m is not None and m.kind == "counter":
+            out[full[len(_PREFIX):]] = int(m.value())
+    return out
 
 
 def reset():
-    with _lock:
-        _counters.clear()
+    _reg().reset(_PREFIX)

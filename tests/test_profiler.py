@@ -1,0 +1,243 @@
+"""Host-profiler unit tests (reference unittests/test_profiler.py pattern,
+plus coverage the reference never had: cross-thread stack hygiene when the
+profiler is stopped mid-event, sort-key ordering of the printed report, and
+the xplane merge in device_instr_events driven with synthetic plane data)."""
+
+import json
+import os
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+from paddle_tpu import profiler
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler():
+    """Every test starts and ends with a stopped, empty profiler (module
+    state is process-global)."""
+    profiler._state["on"] = False
+    profiler.reset_profiler()
+    yield
+    profiler._state["on"] = False
+    profiler.reset_profiler()
+
+
+def _silent_stop(sorted_key=None, profile_path=""):
+    """stop_profiler prints its table; tests that only want the return value
+    route the dump to nowhere."""
+    return profiler.stop_profiler(sorted_key, profile_path or None)
+
+
+# ---- RecordEvent nesting ------------------------------------------------
+
+
+def test_record_event_nesting_names(capsys):
+    profiler.start_profiler("All")
+    with profiler.RecordEvent("outer"):
+        with profiler.RecordEvent("inner"):
+            pass
+        with profiler.RecordEvent("inner"):
+            pass
+    table = _silent_stop()
+    capsys.readouterr()
+    assert "outer" in table
+    assert "outer/inner" in table
+    assert table["outer/inner"][0] == 2  # calls
+    assert table["outer"][0] == 1
+    # nested names never leak as bare names
+    assert "inner" not in table
+
+
+def test_record_event_noop_when_off():
+    with profiler.RecordEvent("ignored"):
+        pass
+    assert not profiler._events
+
+
+# ---- stop-mid-event stack hygiene across threads ------------------------
+
+
+def test_stop_mid_event_does_not_leak_stack_prefix(capsys):
+    """Thread B sits inside RecordEvent('outer') while the main thread stops
+    and restarts the profiler. After B exits the stale event, B's next event
+    in the NEW session must not carry an 'outer/' prefix (a leaked stack
+    entry would prefix every later event from that thread)."""
+    entered = threading.Event()
+    stop_done = threading.Event()
+
+    def worker():
+        with profiler.RecordEvent("outer"):
+            entered.set()
+            assert stop_done.wait(5)
+        # new session: this event must be top-level
+        with profiler.RecordEvent("solo"):
+            pass
+
+    profiler.start_profiler("All")
+    t = threading.Thread(target=worker)
+    t.start()
+    assert entered.wait(5)
+    _silent_stop()  # profiler goes off while B is mid-event
+    profiler.start_profiler("All")
+    stop_done.set()
+    t.join(5)
+    table = _silent_stop()
+    capsys.readouterr()
+    assert "solo" in table
+    assert not any(name.startswith("outer/") for name in table)
+
+
+# ---- stop_profiler sort keys --------------------------------------------
+
+
+def _inject(name, *durs_s):
+    now = time.perf_counter()
+    for d in durs_s:
+        profiler._events.append((name, now, now + d, 0))
+
+
+@pytest.mark.parametrize(
+    "sorted_key,expected_first",
+    [
+        ("total", "beta"),   # beta total 100 ms
+        ("calls", "beta"),   # beta 10 calls
+        ("max", "gamma"),    # gamma max 80 ms
+        ("min", "alpha"),    # alpha min 50 ms (keys sort DESCENDING)
+        ("ave", "alpha"),    # alpha ave 50 ms
+    ],
+)
+def test_stop_profiler_sort_keys(capsys, sorted_key, expected_first):
+    """Synthetic shapes chosen so every sort key has a distinct winner:
+    alpha = 1×50ms (min/ave 50), beta = 10×10ms (total 100, calls 10),
+    gamma = 1ms + 80ms (max 80)."""
+    profiler.start_profiler("All")
+    _inject("alpha", 0.050)
+    _inject("beta", *([0.010] * 10))
+    _inject("gamma", 0.001, 0.080)
+    profiler.stop_profiler(sorted_key, None)
+    out = capsys.readouterr().out
+    rows = [
+        line.split()[0]
+        for line in out.splitlines()
+        if line and line.split()[0] in ("alpha", "beta", "gamma")
+    ]
+    assert rows[0] == expected_first, out
+
+
+# ---- dump → tools/timeline.py round-trip --------------------------------
+
+
+def test_dump_timeline_roundtrip(tmp_path, capsys):
+    profiler.start_profiler("All")
+    with profiler.RecordEvent("phase_a"):
+        with profiler.RecordEvent("phase_b"):
+            time.sleep(0.001)
+    dump_path = str(tmp_path / "profile")
+    profiler.stop_profiler("total", dump_path)
+    capsys.readouterr()
+
+    with open(dump_path) as f:
+        dump = json.load(f)
+    names = {e["name"] for e in dump["events"]}
+    assert {"phase_a", "phase_a/phase_b"} <= names
+
+    sys.path.insert(0, os.path.join(HERE, "..", "tools"))
+    try:
+        import timeline
+
+        out = str(tmp_path / "timeline.json")
+        n = timeline.convert(dump_path, out)
+        assert n == len(dump["events"])
+        with open(out) as f:
+            trace = json.load(f)
+        spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        assert {e["name"] for e in spans} == names
+        for e in spans:
+            assert e["dur"] >= 0
+        # the reference's name=path,... multi-trainer merge still works
+        out2 = str(tmp_path / "timeline2.json")
+        n2 = timeline.convert(
+            "t0=%s,t1=%s" % (dump_path, dump_path), out2
+        )
+        assert n2 == 2 * len(dump["events"])
+        with open(out2) as f:
+            trace2 = json.load(f)
+        pids = {e["pid"] for e in trace2["traceEvents"] if e.get("ph") == "X"}
+        assert pids == {0, 1}
+    finally:
+        sys.path.pop(0)
+
+
+# ---- device_instr_events xplane merge -----------------------------------
+
+
+def _plane(device_name, instrs):
+    """Synthetic xplane: instrs = [(name, duration_ps), ...]."""
+    events = [
+        types.SimpleNamespace(
+            name=name, stats=[("device_duration_ps", ps)]
+        )
+        for name, ps in instrs
+    ]
+    line = types.SimpleNamespace(name="XLA Ops", events=events)
+    return types.SimpleNamespace(name=device_name, lines=[line])
+
+
+def test_merge_device_plane_events_accumulates():
+    events = {}
+    profiler._merge_device_plane_events(
+        [_plane("TPU:0", [("%fusion.1", 2e9), ("%fusion.2", 1e9)])], events
+    )
+    profiler._merge_device_plane_events(
+        [_plane("TPU:1", [("%fusion.1", 4e9)])], events
+    )
+    # host planes and non-"XLA Ops" lines are ignored
+    profiler._merge_device_plane_events(
+        [_plane("/host:CPU", [("%fusion.1", 9e9)])], events
+    )
+    assert events["fusion.1"] == [2, 6.0, 2.0, 4.0]  # count,total,min,max ms
+    assert events["fusion.2"] == [1, 1.0, 1.0, 1.0]
+
+
+def test_device_instr_events_merges_all_xplane_files(tmp_path, monkeypatch):
+    """Regression: only paths[-1] used to be read, dropping every other
+    host's kernels from a multi-host trace dir."""
+    d = tmp_path / "trace"
+    d.mkdir()
+    p0 = d / "host0.xplane.pb"
+    p1 = d / "sub"
+    p1.mkdir()
+    p1 = p1 / "host1.xplane.pb"
+    p0.write_bytes(b"")
+    p1.write_bytes(b"")
+
+    by_path = {
+        str(p0): [_plane("TPU:0", [("%add.3", 1e9)])],
+        str(p1): [_plane("TPU:0", [("%add.3", 3e9), ("%mul.7", 2e9)])],
+    }
+    opened = []
+
+    class FakeProfileData:
+        @staticmethod
+        def from_file(path):
+            opened.append(path)
+            return types.SimpleNamespace(planes=by_path[path])
+
+    import jax.profiler as jprof
+
+    monkeypatch.setattr(jprof, "ProfileData", FakeProfileData, raising=False)
+    events = profiler.device_instr_events(str(d))
+    assert sorted(opened) == sorted(by_path)  # every file read
+    assert events["add.3"] == [2, 4.0, 1.0, 3.0]
+    assert events["mul.7"] == [1, 2.0, 2.0, 2.0]
+
+
+def test_device_instr_events_empty_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        profiler.device_instr_events(str(tmp_path))

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Live training monitor: tail a telemetry JSONL directory into a summary
+table.
+
+Reads the per-host shard files (``telemetry-host*.jsonl`` plus rotated
+``.1`` siblings) written by paddle_tpu.observability.export.TelemetryExporter
+and renders a rolling summary:
+
+    steps/s, p50/p95 step ms, feed-stall %, pipeline bubble (measured vs
+    analytic), device memory high-water, compile-cache hits/misses, and the
+    resilience health counters.
+
+Usage:
+    python tools/monitor.py --dir /path/to/telemetry            # follow
+    python tools/monitor.py --dir /path/to/telemetry --once     # one shot
+    python tools/monitor.py --dir /path/to/telemetry --window 500
+
+No dependency on paddle_tpu (pure stdlib) so it can run on a machine that
+only has the telemetry files.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+SHARD_GLOB = "telemetry-host*.jsonl*"
+
+
+def load_records(telemetry_dir):
+    """All records from every host shard (rotated files first), ts-sorted."""
+    records = []
+    for path in sorted(glob.glob(os.path.join(telemetry_dir, SHARD_GLOB))):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn tail line of a live file
+        except OSError:
+            continue
+    records.sort(key=lambda r: r.get("ts", 0.0))
+    return records
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def summarize(records, window=200):
+    """Aggregate the record stream into the monitor's display fields.
+
+    ``window`` bounds how many of the most recent step records feed the
+    rate/latency stats; snapshot records always contribute their latest
+    gauges/counters regardless of the window.
+    """
+    steps = [r for r in records if r.get("kind") == "step"]
+    snaps = [r for r in records if r.get("kind") == "snapshot"]
+    recent = steps[-window:]
+
+    summary = {
+        "n_records": len(records),
+        "n_steps": len(steps),
+        "hosts": sorted({r.get("host", 0) for r in records}),
+        "last_step": steps[-1]["step"] if steps else None,
+        "steps_per_s": None,
+        "p50_ms": None,
+        "p95_ms": None,
+        "stall_pct": None,
+        "loss": None,
+        "bubble": None,
+        "bubble_analytic": None,
+        "pp": None,
+        "mem_peak_bytes": None,
+        "cache_hits": None,
+        "cache_misses": None,
+        "health": {},
+    }
+
+    if recent:
+        walls = sorted(float(r.get("wall_ms", 0.0)) for r in recent)
+        summary["p50_ms"] = _percentile(walls, 50)
+        summary["p95_ms"] = _percentile(walls, 95)
+        total_wall = sum(walls)
+        total_steps = sum(int(r.get("n_steps", 1)) for r in recent)
+        if total_wall > 0:
+            summary["steps_per_s"] = total_steps / (total_wall / 1e3)
+        total_stall = sum(float(r.get("feed_stall_ms", 0.0)) for r in recent)
+        if total_wall > 0:
+            summary["stall_pct"] = 100.0 * total_stall / total_wall
+        for r in reversed(recent):
+            if r.get("loss") is not None:
+                summary["loss"] = r["loss"]
+                break
+        for r in reversed(recent):
+            if r.get("pp"):
+                summary["pp"] = r["pp"]
+                break
+
+    if snaps:
+        last = snaps[-1]
+        # registry.snapshot() shape: {name: {"kind": ..., "values":
+        # {label_str: v}}} for counters/gauges (label_str "" when unlabelled)
+        metrics = last.get("metrics", {})
+
+        def _scalar(name):
+            rec = metrics.get(name)
+            if not rec or "values" not in rec:
+                return None
+            vals = rec["values"]
+            if not vals:
+                return None
+            return vals.get("", max(vals.values()))
+
+        summary["bubble"] = _scalar("pp/bubble_measured")
+        summary["bubble_analytic"] = _scalar("pp/bubble_analytic")
+        mem = _scalar("device/mem_peak_bytes")
+        if mem is not None:
+            summary["mem_peak_bytes"] = mem
+        hits = _scalar("compile_cache/hits")
+        misses = _scalar("compile_cache/misses")
+        summary["cache_hits"] = int(hits) if hits is not None else None
+        summary["cache_misses"] = int(misses) if misses is not None else None
+        bub = last.get("bubble")
+        if summary["bubble"] is None and bub:
+            summary["bubble"] = bub.get("bubble")
+            summary["bubble_analytic"] = bub.get("analytic")
+        summary["health"] = dict(last.get("health", {}))
+        memrec = last.get("mem", {})
+        if memrec.get("mem_peak_bytes"):
+            cur = summary["mem_peak_bytes"] or 0
+            summary["mem_peak_bytes"] = max(cur, memrec["mem_peak_bytes"])
+    return summary
+
+
+def _fmt(value, spec="{:.2f}", none="-"):
+    return none if value is None else spec.format(value)
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return "%.1f %s" % (n, unit)
+        n /= 1024.0
+
+
+def render(summary):
+    """Summary dict -> multi-line table string."""
+    rows = [
+        ("step", _fmt(summary["last_step"], "{:d}")),
+        ("hosts", ",".join(str(h) for h in summary["hosts"]) or "-"),
+        ("steps/s", _fmt(summary["steps_per_s"])),
+        ("p50 step ms", _fmt(summary["p50_ms"])),
+        ("p95 step ms", _fmt(summary["p95_ms"])),
+        ("feed stall %", _fmt(summary["stall_pct"])),
+        ("loss", _fmt(summary["loss"], "{:.6g}")),
+    ]
+    if summary["pp"]:
+        rows.append(("pp stages", _fmt(summary["pp"], "{:d}")))
+        rows.append(("bubble (measured)", _fmt(summary["bubble"], "{:.3f}")))
+        rows.append(
+            ("bubble (analytic)", _fmt(summary["bubble_analytic"], "{:.3f}"))
+        )
+    rows.append(("mem high-water", _fmt_bytes(summary["mem_peak_bytes"])))
+    if summary["cache_hits"] is not None or summary["cache_misses"] is not None:
+        rows.append(
+            (
+                "compile cache",
+                "%s hit / %s miss"
+                % (
+                    _fmt(summary["cache_hits"], "{:d}", "0"),
+                    _fmt(summary["cache_misses"], "{:d}", "0"),
+                ),
+            )
+        )
+    for name in sorted(summary["health"]):
+        rows.append(("health/" + name, str(summary["health"][name])))
+
+    width = max(len(k) for k, _ in rows)
+    lines = ["=== telemetry monitor (%d step records) ===" % summary["n_steps"]]
+    for key, val in rows:
+        lines.append("  %-*s  %s" % (width, key, val))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", required=True, help="FLAGS_telemetry_dir path")
+    ap.add_argument("--once", action="store_true", help="print once and exit")
+    ap.add_argument(
+        "--window", type=int, default=200,
+        help="recent step records used for rate/latency stats",
+    )
+    ap.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh period in seconds when following",
+    )
+    args = ap.parse_args(argv)
+
+    while True:
+        records = load_records(args.dir)
+        if not records:
+            print("(no telemetry records yet in %s)" % args.dir)
+        else:
+            print(render(summarize(records, window=args.window)))
+        if args.once:
+            return 0
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

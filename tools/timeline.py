@@ -5,9 +5,19 @@ Reference analog: tools/timeline.py:36-160 (protobuf profile → chrome trace,
 with --profile_path accepting 'name1=path1,name2=path2' to merge traces from
 multiple trainers into one timeline under distinct pids).
 
+Beyond the reference: --telemetry_path takes telemetry JSONL files (the
+FLAGS_telemetry_dir stream, observability/export.py) and emits chrome-trace
+COUNTER tracks ("ph": "C") — step wall ms, feed-stall ms, loss, and device
+memory high-water ride as counters under the same trace, so span events and
+the step-level health of the run line up on one time axis. The same
+name=path,... form merges counters from multiple trainers.
+
 Usage:
   python tools/timeline.py --profile_path /tmp/profile --timeline_path /tmp/timeline.json
   python tools/timeline.py --profile_path trainer0=/tmp/p0,trainer1=/tmp/p1 ...
+  python tools/timeline.py --profile_path /tmp/profile \
+      --telemetry_path /tmp/telem/telemetry-host0.jsonl \
+      --timeline_path /tmp/timeline.json
 Then open chrome://tracing and load the output.
 """
 
@@ -26,32 +36,106 @@ def _load(profile_path):
     return named
 
 
-def convert(profile_path, timeline_path):
-    trace_events = []
-    metadata = []
-    for pid, (name, path) in enumerate(_load(profile_path)):
-        with open(path) as f:
-            dump = json.load(f)
-        metadata.append(
+def _read_jsonl(path):
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail line of a live telemetry file
+    return records
+
+
+def _counter_events(records, pid):
+    """Telemetry records → chrome-trace counter events ("ph": "C").
+
+    Counter timestamps are normalized to the stream's earliest ts so the
+    tracks start at 0 like the span events (profiler dumps use
+    perf_counter times, telemetry uses epoch times — they don't share a
+    clock, but each is internally consistent)."""
+    out = []
+    tss = [r["ts"] for r in records if "ts" in r]
+    if not tss:
+        return out
+    t0 = min(tss)
+
+    def counter(name, ts, value):
+        out.append(
             {
-                "name": "process_name",
-                "ph": "M",
+                "name": name,
+                "ph": "C",
                 "pid": pid,
-                "args": {"name": name},
+                "ts": (ts - t0) * 1e6,
+                "args": {name: value},
             }
         )
-        for ev in dump["events"]:
-            trace_events.append(
+
+    for r in records:
+        ts = r.get("ts")
+        if ts is None:
+            continue
+        if r.get("kind") == "step":
+            n = max(int(r.get("n_steps", 1)), 1)
+            counter("step_ms", ts, float(r.get("wall_ms", 0.0)) / n)
+            if r.get("feed_stall_ms"):
+                counter("feed_stall_ms", ts, float(r["feed_stall_ms"]))
+            if r.get("loss") is not None:
+                counter("loss", ts, float(r["loss"]))
+        elif r.get("kind") == "snapshot":
+            mem = r.get("mem", {})
+            if mem.get("mem_peak_bytes"):
+                counter("mem_peak_bytes", ts, mem["mem_peak_bytes"])
+            bub = r.get("bubble")
+            if bub and bub.get("bubble") is not None:
+                counter("pp_bubble", ts, bub["bubble"])
+    return out
+
+
+def convert(profile_path, timeline_path, telemetry_path=None):
+    trace_events = []
+    metadata = []
+    pid = 0
+    if profile_path:
+        for pid, (name, path) in enumerate(_load(profile_path)):
+            with open(path) as f:
+                dump = json.load(f)
+            metadata.append(
                 {
-                    "name": ev["name"],
-                    "cat": "host",
-                    "ph": "X",
+                    "name": "process_name",
+                    "ph": "M",
                     "pid": pid,
-                    "tid": ev["tid"] % 100000,
-                    "ts": ev["start"] * 1e6,
-                    "dur": (ev["end"] - ev["start"]) * 1e6,
+                    "args": {"name": name},
                 }
             )
+            for ev in dump["events"]:
+                trace_events.append(
+                    {
+                        "name": ev["name"],
+                        "cat": "host",
+                        "ph": "X",
+                        "pid": pid,
+                        "tid": ev["tid"] % 100000,
+                        "ts": ev["start"] * 1e6,
+                        "dur": (ev["end"] - ev["start"]) * 1e6,
+                    }
+                )
+        pid += 1
+    if telemetry_path:
+        for off, (name, path) in enumerate(_load(telemetry_path)):
+            tpid = pid + off
+            metadata.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": tpid,
+                    "args": {"name": name + ":telemetry"},
+                }
+            )
+            trace_events.extend(_counter_events(_read_jsonl(path), tpid))
     with open(timeline_path, "w") as f:
         json.dump({"traceEvents": metadata + trace_events}, f)
     return len(trace_events)
@@ -59,8 +143,15 @@ def convert(profile_path, timeline_path):
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--profile_path", required=True)
+    ap.add_argument("--profile_path", default="",
+                    help="profiler dump(s); optional if --telemetry_path set")
     ap.add_argument("--timeline_path", required=True)
+    ap.add_argument("--telemetry_path", default="",
+                    help="telemetry JSONL file(s) (name=path,... to merge); "
+                         "emitted as chrome-trace counter tracks")
     args = ap.parse_args()
-    n = convert(args.profile_path, args.timeline_path)
+    if not args.profile_path and not args.telemetry_path:
+        ap.error("need --profile_path and/or --telemetry_path")
+    n = convert(args.profile_path, args.timeline_path,
+                telemetry_path=args.telemetry_path or None)
     print("wrote %d events to %s" % (n, args.timeline_path))
