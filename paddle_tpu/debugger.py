@@ -57,10 +57,52 @@ def pprint_program_codes(program, show_backward=False):
     )
 
 
-def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+def _normalize_costs(costs):
+    """Accepts either a plain {op name: ms} mapping or a full op_profile
+    record (observability/opprof.py build_record: {"ops": [{"op", "total_ms",
+    ...}]}) and returns {name: ms}."""
+    if not costs:
+        return {}
+    if isinstance(costs, dict) and isinstance(costs.get("ops"), list):
+        return {
+            str(row["op"]): float(row.get("total_ms", 0.0))
+            for row in costs["ops"]
+            if row.get("op")
+        }
+    return {str(k): float(v) for k, v in dict(costs).items()}
+
+
+def _heat_color(frac):
+    """Cold (the default box blue #d2e5ff) → hot (red) by cost fraction."""
+    frac = min(max(frac, 0.0), 1.0)
+    r = int(0xD2 + frac * (0xFF - 0xD2))
+    g = int(0xE5 + frac * (0x84 - 0xE5))
+    b = int(0xFF + frac * (0x66 - 0xFF))
+    return "#%02x%02x%02x" % (r, g, b)
+
+
+def _op_cost(op, costs):
+    """ms for one op: exact instance match ("<type>:<out>") first, then the
+    bare type (host-events tables may only resolve to type granularity)."""
+    from .observability import opprof as _opprof
+
+    ms = costs.get(_opprof.op_display_name(op))
+    if ms is None:
+        ms = costs.get(op.type)
+    return ms
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot", costs=None):
     """Emit a dot graph: op nodes (boxes) wired through var nodes (ellipses),
-    like the reference's draw_block_graphviz / graph_viz_pass."""
+    like the reference's draw_block_graphviz / graph_viz_pass.
+
+    costs: optional per-op device time — a {op name: ms} mapping or an
+    op_profile record from tools/op_profile.py --json / the telemetry stream.
+    Matching op nodes get a "(x.xx ms)" label line and a heat fill (cost
+    relative to the block's most expensive op)."""
     highlights = set(highlights or [])
+    costs = _normalize_costs(costs)
+    max_ms = max(costs.values()) if costs else 0.0
     lines = ["digraph G {", "  rankdir=TB;"]
     seen_vars = set()
 
@@ -73,7 +115,16 @@ def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
 
     for i, op in enumerate(block.ops):
         op_id = '"op_%d_%s"' % (i, op.type)
-        lines.append("  %s [label=\"%s\" shape=box style=filled fillcolor=\"#d2e5ff\"];" % (op_id, op.type))
+        label = op.type
+        fill = "#d2e5ff"
+        ms = _op_cost(op, costs) if costs else None
+        if ms is not None:
+            label = "%s\\n(%.2f ms)" % (op.type, ms)
+            fill = _heat_color(ms / max_ms if max_ms > 0 else 0.0)
+        lines.append(
+            '  %s [label="%s" shape=box style=filled fillcolor="%s"];'
+            % (op_id, label, fill)
+        )
         for name in op.input_arg_names:
             lines.append("  %s -> %s;" % (var_node(name), op_id))
         for name in op.output_arg_names:

@@ -44,6 +44,93 @@ def _flags_profile_ops():
     return _flags.get_flags("profile_ops")["profile_ops"]
 
 
+def _flags_opprof():
+    """The op-attribution flags (observability/opprof.py) in ONE flags
+    lookup — the entire hot-path cost of the feature when disabled."""
+    from . import flags as _flags
+
+    return _flags.get_flags(("tensor_stats", "nan_provenance"))
+
+
+def _compiled_ops(compiled):
+    """The fluid op list behind any compiled-block flavor (for NaN
+    provenance and the check_nan_inf last-writer report)."""
+    ops = getattr(compiled, "ops", None)
+    if ops is None:
+        inner = getattr(compiled, "_inner", None)  # _MultiStepBlock
+        ops = getattr(inner, "ops", None)
+    if ops is None:
+        blk = getattr(compiled, "block", None)  # _SegmentedBlock
+        ops = blk.ops if blk is not None else None
+    return ops or ()
+
+
+def _last_writer(compiled, var_name):
+    """Display name of the LAST op in program order writing `var_name`, or
+    None — names the suspect in the check_nan_inf report (ops are anonymous;
+    the variable is the only handle the error has)."""
+    from .observability import opprof as _opprof
+
+    found = None
+    try:
+        for op in _compiled_ops(compiled):
+            if var_name in op.output_arg_names:
+                found = _opprof.op_display_name(op)
+    except Exception:
+        return None
+    return found
+
+
+def _localize_nan(compiled, scope, feed_arrays, rng_key, reason, step=None,
+                  mut_override=None):
+    """FLAGS_nan_provenance driver: replay the failed step's feed through
+    opprof.localize_nonfinite over the block's op list, against the step's
+    PRE-state (`mut_override` = the guard's pre-step snapshot when it has
+    one, else the scope as-is) and pre-step rng key. Returns the written
+    provenance record or None; never raises (diagnosis must not mask the
+    original failure)."""
+    ops = _compiled_ops(compiled)
+    if not ops:
+        return None
+    if isinstance(compiled, _MultiStepBlock):
+        # a k-step scan's feed is stacked [k, ...]; replaying it as one
+        # step would walk garbage shapes — provenance needs steps_per_run=1
+        if not getattr(_localize_nan, "_warned_multi", False):
+            _localize_nan._warned_multi = True
+            print(
+                "[nan_provenance] skipped: steps_per_run>1 runs cannot be "
+                "replayed per-op (rerun the failing step with "
+                "steps_per_run=1)", file=sys.stderr,
+            )
+        return None
+    from .observability import opprof as _opprof
+
+    try:
+        env = {n: v for n, v in scope.vars.items() if v is not None}
+        if mut_override:
+            for n, v in mut_override.items():
+                env[n] = jnp.asarray(v)
+        feed_want = getattr(compiled, "_feed_want", {})
+        for n, v in feed_arrays.items():
+            a = v if isinstance(v, jax.Array) else jnp.asarray(v)
+            want = feed_want.get(n)
+            if want is not None and a.dtype != want:
+                a = a.astype(want)
+            env[n] = a
+        prov = _opprof.localize_nonfinite(
+            ops, env, rng_key if rng_key is not None else scope.rng_key,
+            step=step,
+        )
+        if prov is None:
+            return None
+        return _opprof.write_provenance(prov, reason=reason)
+    except Exception as e:
+        if not getattr(_localize_nan, "_warned", False):
+            _localize_nan._warned = True
+            print("[nan_provenance] replay failed: %r" % e, file=sys.stderr)
+        return None
+
+
 def _telemetry_begin():
     """(collector, t0) when telemetry is active, else (None, None) — the
     disabled path costs one flags lookup per run (observability.stepstats)."""
@@ -214,7 +301,7 @@ class _CompiledBlock:
 
     def __init__(self, program, block, feed_names, fetch_names, scope, mesh=None,
                  data_axes=("dp",), feed_ranks=None, ops_override=None,
-                 zero1_axis=None):
+                 zero1_axis=None, instrument=True):
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         src_ops = block.ops if ops_override is None else ops_override
@@ -302,6 +389,21 @@ class _CompiledBlock:
         )
         self.zero1_axis = z1
         self._feed_ranks = dict(feed_ranks or {})
+
+        # FLAGS_tensor_stats instrumentation pass (observability/opprof.py):
+        # matched ops get output stats computed INSIDE the compiled step.
+        # The flag value is part of the executor cache key, so toggling it
+        # recompiles rather than serving a stale (un)instrumented block.
+        # instrument=False for wrappers that would drop the side output
+        # (_MultiStepBlock's scan body discards created).
+        self._tstat_spec = ()
+        self._tstat_traced = ()
+        if instrument:
+            pat = _flags_opprof()["tensor_stats"]
+            if pat:
+                from .observability import opprof as _opprof
+
+                self._tstat_spec = _opprof.stats_spec(self.ops, pat)
 
         run = self._build_run(ops_, feed_want, mesh, z1)
 
@@ -411,9 +513,49 @@ class _CompiledBlock:
             # an op may legally omit a declared output slot (lowering returns
             # None) — only bind names that actually materialized
             created = {n: env[n] for n in self.created_persistables if n in env}
+            if self._tstat_spec:
+                stats = self._trace_tensor_stats(env)
+                if stats is not None:
+                    # ride the created dict out of the jit: its sharding is
+                    # already None (XLA's choice) and __call__ pops the key
+                    # before it can reach the scope — ONE host sync per run,
+                    # same trick as the nan-guard stacked reduce
+                    from .observability.opprof import TENSOR_STATS_KEY
+
+                    created[TENSOR_STATS_KEY] = stats
             return fetches, new_mut, created, ctx.key
 
         return run
+
+    def _trace_tensor_stats(self, env):
+        """FLAGS_tensor_stats: stats rows [mean, std, absmax, nonfinite] for
+        every instrumented output present in the traced env, stacked into ONE
+        [n, 4] f32 array. Runs AT TRACE TIME inside _build_run; the matched
+        display names land on self (trace-time self mutation, the same
+        pattern as _PipelinedBlock.stage_plan) so __call__ can label the
+        host-side rows without retracing."""
+        names, rows = [], []
+        for display, var in self._tstat_spec:
+            v = env.get(var)
+            if v is None:
+                continue
+            a = jnp.asarray(v)
+            if not jnp.issubdtype(a.dtype, jnp.floating):
+                continue
+            x = a.astype(jnp.float32)
+            names.append(display)
+            rows.append(
+                jnp.stack([
+                    x.mean(),
+                    x.std(),
+                    jnp.abs(x).max() if x.size else jnp.float32(0),
+                    jnp.sum(~jnp.isfinite(x)).astype(jnp.float32),
+                ])
+            )
+        self._tstat_traced = tuple(names)
+        if not rows:
+            return None
+        return jnp.stack(rows)
 
     def __call__(self, scope, feed_arrays):
         ro = {n: scope.vars[n] for n in self.ro_names}
@@ -421,9 +563,29 @@ class _CompiledBlock:
         fetches, new_mut, created, new_key = self.jitted(
             feed_arrays, ro, mut, scope.rng_key
         )
+        stats = None
+        if self._tstat_spec and isinstance(created, dict):
+            from .observability.opprof import TENSOR_STATS_KEY
+
+            stats = created.pop(TENSOR_STATS_KEY, None)
         scope.vars.update(new_mut)
         scope.vars.update(created)
         scope.rng_key = new_key
+        if stats is not None:
+            from .observability import opprof as _opprof
+
+            try:
+                # the leg's single host sync: one small [n, 4] transfer
+                _opprof.record_tensor_stats(
+                    self._tstat_traced, np.asarray(stats)
+                )
+            except Exception as e:
+                if not getattr(_CompiledBlock, "_tstat_warned", False):
+                    _CompiledBlock._tstat_warned = True
+                    print(
+                        "tensor_stats record failed (disabled for this "
+                        "message): %r" % e, file=sys.stderr,
+                    )
         return fetches
 
 
@@ -481,9 +643,13 @@ class _PipelinedBlock(_CompiledBlock):
             "loss_name": loss_name, "n_micro": n_micro, "schedule": schedule,
         }
         self.stage_plan = None  # filled at first trace
+        # instrument=False: the pp schedule's shard_map body has no place
+        # for the straight-line stats side output (FLAGS_tensor_stats is a
+        # single-device/dp diagnosis knob, docs/observability.md)
         super().__init__(
             program, block, feed_names, fetch_names, scope,
             mesh=mesh, feed_ranks=feed_ranks, zero1_axis=zero1_axis,
+            instrument=False,
         )
 
     # packable boundary dtypes: everything is carried as f32 in the boundary
@@ -1045,10 +1211,13 @@ class _MultiStepBlock:
         self.steps_per_run = steps_per_run
         # reuse _CompiledBlock's whole analysis (state split, shardings) and
         # its raw lowering closure; its own .jitted is lazy and never compiled
+        # instrument=False: the scan body discards the created dict, which
+        # is the stats side channel (FLAGS_tensor_stats needs
+        # steps_per_run=1 — its one-sync-per-RUN contract is per run anyway)
         inner = _CompiledBlock(
             program, block, feed_names, fetch_names, scope,
             mesh=mesh, data_axes=data_axes, feed_ranks=feed_ranks,
-            zero1_axis=zero1_axis,
+            zero1_axis=zero1_axis, instrument=False,
         )
         if inner.created_persistables:
             raise RuntimeError(
@@ -1365,6 +1534,10 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
+        # monotonically counts run() calls — the "step index" the
+        # check_nan_inf / nan-provenance reports cite (the telemetry step
+        # counter only advances when telemetry is on)
+        self._run_seq = 0
 
     def close(self):
         """Reference Executor::Close (executor.cc:111-119): notify pservers
@@ -1401,6 +1574,7 @@ class Executor:
         # reader pull, dispatch, and the fetch conversion (which is where
         # the device sync lands under return_numpy / FLAGS_benchmark)
         _obs, _obs_t0 = _telemetry_begin()
+        self._run_seq += 1
         # force_multi: a reader pull that returned a 1-batch epoch tail still
         # runs through _MultiStepBlock so fetches keep their [k, ...] axis
         force_multi = False
@@ -1441,6 +1615,7 @@ class Executor:
             var = block.vars.get(name)
             feed_arrays[name] = _as_feed_array(value, var)
 
+        _opf = _flags_opprof()
         key = (
             program._uid,
             program._version,
@@ -1452,6 +1627,9 @@ class Executor:
             # k>1 an explicit stacked feed and a reader pull share the
             # compiled scan
             force_multi and steps_per_run == 1,
+            # toggling FLAGS_tensor_stats must recompile, not serve a stale
+            # (un)instrumented block
+            _opf["tensor_stats"],
         )
         from . import profiler as _prof
 
@@ -1467,7 +1645,8 @@ class Executor:
             with _prof.RecordEvent("run/block0"):
                 fetches = compiled(scope, _eager_cast_feeds(block, feed_arrays))
             return self._finish_run(
-                compiled, scope, fetch_names, fetches, return_numpy
+                compiled, scope, fetch_names, fetches, return_numpy,
+                step=self._run_seq,
             )
 
         compiled = self._cache.get(key) if use_program_cache else None
@@ -1523,6 +1702,11 @@ class Executor:
                     if scope.vars.get(n) is not None
                 }
 
+        # pre-step rng key: scope.rng_key is consumed by the run; the
+        # provenance replay must start from the same key to reproduce the
+        # step's randomness op for op
+        pre_key = scope.rng_key if _opf["nan_provenance"] else None
+
         with _prof.RecordEvent("run/block0"):
             fetches = compiled(scope, feed_arrays)
             if _prof.is_profiling() or _flags.get_flags("benchmark")["benchmark"]:
@@ -1540,6 +1724,14 @@ class Executor:
                 scope.vars[n] for n in mut_names if scope.vars.get(n) is not None
             ]
             if not _all_finite(watched):
+                if _opf["nan_provenance"]:
+                    # localize BEFORE the rollback erases the poisoned state;
+                    # the replay itself runs against the pre-step snapshot
+                    _localize_nan(
+                        compiled, scope, feed_arrays, pre_key,
+                        "resilience_nan_guard", step=self._run_seq,
+                        mut_override=guard_snapshot,
+                    )
                 nan_ok = self._skip_nan_step(scope, guard_snapshot)
         # correlation seed for profiler.device_op_profile: the block + feed
         # AVALS of the latest run (abstract shapes only — storing the
@@ -1557,7 +1749,8 @@ class Executor:
                 },
             )
         result = self._finish_run(
-            compiled, scope, fetch_names, fetches, return_numpy, nan_ok=nan_ok
+            compiled, scope, fetch_names, fetches, return_numpy, nan_ok=nan_ok,
+            step=self._run_seq, feed_arrays=feed_arrays, pre_key=pre_key,
         )
         if _obs is not None:
             _telemetry_record(
@@ -1620,10 +1813,14 @@ class Executor:
         return True
 
     @staticmethod
-    def _finish_run(compiled, scope, fetch_names, fetches, return_numpy, nan_ok=False):
+    def _finish_run(compiled, scope, fetch_names, fetches, return_numpy,
+                    nan_ok=False, step=None, feed_arrays=None, pre_key=None):
         """Shared run tail: FLAGS_check_nan_inf scan + numpy conversion.
         nan_ok: the resilience guard already handled this step's NaNs (state
-        rolled back) — don't let the check_nan_inf scan abort over them."""
+        rolled back) — don't let the check_nan_inf scan abort over them.
+        step/feed_arrays/pre_key feed the error report: the run index for the
+        message, and (under FLAGS_nan_provenance) the replay inputs for
+        first-bad-op localization."""
         from . import flags as _flags
 
         if not nan_ok and _flags.get_flags("check_nan_inf")["check_nan_inf"]:
@@ -1648,9 +1845,33 @@ class Executor:
                     if jnp.issubdtype(arr.dtype, jnp.floating) and not bool(
                         jnp.isfinite(arr).all()
                     ):
-                        raise FloatingPointError(
-                            "check_nan_inf: variable %r contains NaN/Inf" % name
+                        msg = (
+                            "check_nan_inf: variable %r contains NaN/Inf"
+                            % name
                         )
+                        writer = _last_writer(compiled, name)
+                        if writer is not None:
+                            msg += ", last written by op %s" % writer
+                        if step is not None:
+                            msg += " (run step %d)" % step
+                        if (
+                            feed_arrays is not None
+                            and _flags_opprof()["nan_provenance"]
+                        ):
+                            # best-effort: the donated step already advanced
+                            # the state, so this replays against POST-step
+                            # values — right op for a feed/activation NaN,
+                            # approximate for one born inside the update
+                            prov = _localize_nan(
+                                compiled, scope, feed_arrays, pre_key,
+                                "check_nan_inf", step=step,
+                            )
+                            if prov is not None:
+                                msg += (
+                                    "; first non-finite output at op #%s %s"
+                                    % (prov.get("op_index"), prov.get("op"))
+                                )
+                        raise FloatingPointError(msg)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
@@ -1693,9 +1914,13 @@ class _PerOpProfiledBlock:
         for name, value in feed_arrays.items():
             env[name] = value if isinstance(value, jax.Array) else jnp.asarray(value)
         ctx = registry.LowerCtx(scope.rng_key)
+        from .observability import opprof as _opprof
+
         for op in self.ops:
             opdef = registry.get(op.type)
-            with _prof.RecordEvent("op/%s" % op.type):
+            # display form ("<type>:<first output>") so the host-events table
+            # distinguishes op INSTANCES like the xplane leg does
+            with _prof.RecordEvent("op/%s" % _opprof.op_display_name(op)):
                 if opdef.is_host:
                     # host ops see a scratch scope view so env temporaries
                     # never leak into the real scope

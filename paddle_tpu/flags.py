@@ -38,6 +38,18 @@ Honored flags:
 - telemetry_log_every: > 0 prints one structured health line to stderr
   every N recorded steps (step ms, steps/s, loss if fetched, health counter
   deltas) — the "is it alive" signal for long runs; 0 (default) disables.
+- tensor_stats: glob over op display names ("<type>:<first output>"), op
+  types, or output var names. Matching ops get on-device output statistics
+  (mean/std/absmax/nonfinite count) computed INSIDE the compiled step and
+  streamed through the telemetry path with one host sync per run
+  (observability/opprof.py, docs/observability.md); "" (default) disables
+  and compiles the unmodified step.
+- nan_provenance: when the resilience NaN guard or FLAGS_check_nan_inf
+  trips, re-run that step's feed through an op-by-op interpreter walk to
+  localize the FIRST op emitting non-finite output, and write a provenance
+  record (op type/name, input stats, attrs, step) to the telemetry dir plus
+  a health/nan_provenance counter. Off (default): failures name only the
+  variable, as before.
 - eager_delete_tensor_gb / fraction_of_gpu_memory_to_use /
   paddle_num_threads: accepted for API compatibility; storage lifetime and
   threading are XLA/PJRT-owned here (documented no-ops).
@@ -64,6 +76,8 @@ _DEFAULTS = {
     "telemetry_dir": "",
     "telemetry_interval_steps": 50,
     "telemetry_log_every": 0,
+    "tensor_stats": "",
+    "nan_provenance": False,
 }
 
 _flags = {}

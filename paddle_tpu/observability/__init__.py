@@ -11,12 +11,16 @@ deployment is operated with:
              the input pipeline's stall time, the NaN guard, and the
              pipeline-parallel schedule (runtime bubble fraction);
 - export:    flag-gated JSONL event sink + Prometheus scrape file, per-host
-             shards with a rank-0 merged view (FLAGS_telemetry_dir).
+             shards with a rank-0 merged view (FLAGS_telemetry_dir);
+- opprof:    op-LEVEL attribution — per-op device-time/FLOPs profile
+             (op_profile records, tools/op_profile.py), FLAGS_tensor_stats
+             on-device output statistics, and FLAGS_nan_provenance
+             first-bad-op localization when a NaN guard trips.
 
 Live view: `python tools/monitor.py <telemetry_dir>`.
 """
 
-from . import export, registry, stepstats  # noqa: F401
+from . import export, opprof, registry, stepstats  # noqa: F401
 from .registry import Counter, Gauge, Histogram, MetricRegistry, default_registry
 from .stepstats import StepStats, StepStatsCollector, active, collector
 
@@ -33,4 +37,5 @@ __all__ = [
     "registry",
     "stepstats",
     "export",
+    "opprof",
 ]

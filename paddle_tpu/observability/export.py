@@ -174,6 +174,15 @@ class TelemetryExporter:
         os.replace(self._shard_path, self._shard_path + ".1")
         self._fh = open(self._shard_path, "a")
 
+    def write_record(self, record):
+        """Append one arbitrary (non-step) record to this host's shard —
+        the sink for op_profile / tensor_stats / nan_provenance records
+        (observability/opprof.py). The record must carry a "kind"; ts/host
+        are stamped like every other line."""
+        if not record.get("kind"):
+            raise ValueError("telemetry record needs a 'kind': %r" % (record,))
+        self._write(dict(record))
+
     def on_step(self, step_record, collector=None):
         self._write(step_record)
         self._steps_since_flush += step_record.get("n_steps", 1)

@@ -18,6 +18,7 @@ redesign collapses all three into one artifact — the JAX lowering:
 """
 
 import functools
+import re as _re
 
 import jax
 import jax.numpy as jnp
@@ -213,6 +214,23 @@ def _make_generic_grad(fwd_def):
 
 EMPTY_VAR_NAME = "@EMPTY@"  # reference core.kEmptyVarName
 
+# named_scope only keeps a conservative charset (jax drops e.g. '@', so
+# "x@GRAD" would silently become "x"); sanitize OURSELVES so the exact
+# string that lands in the HLO op_name metadata is predictable and the
+# parser (profiler._hlo_op_attribution) can invert it
+_SCOPE_UNSAFE = _re.compile(r"[^A-Za-z0-9_.=\-]")
+OUT_SCOPE_PREFIX = "out="
+
+
+def op_output_scope(op):
+    """Scope name carrying the op's identity (its first real output var) into
+    the HLO metadata, or None for ops with no named outputs. Ops themselves
+    are anonymous in fluid programs — outputs are the only stable handle."""
+    for name in op.output_arg_names:
+        if name != EMPTY_VAR_NAME:
+            return OUT_SCOPE_PREFIX + _SCOPE_UNSAFE.sub("_", name)
+    return None
+
 
 def lower_ops(ctx, ops, env):
     """Lower a list of ops into an env (name -> traced value), rebinding
@@ -235,9 +253,16 @@ def lower_ops(ctx, ops, env):
         # metadata — the correlation key profiler.device_op_profile uses to
         # fold XLA's per-HLO device timings back onto framework op types
         # (the reference correlates CUPTI kernels to ops the same way,
-        # platform/device_tracer.cc)
+        # platform/device_tracer.cc). A nested "out=<first output>" scope
+        # distinguishes op INSTANCES (profiler._hlo_op_attribution); the
+        # type-level parse skips it, so device_op_profile is unchanged.
+        out_scope = op_output_scope(op)
         with jax.named_scope(op.type):
-            outs = opdef.lower(ctx, ins, op.attrs)
+            if out_scope is None:
+                outs = opdef.lower(ctx, ins, op.attrs)
+            else:
+                with jax.named_scope(out_scope):
+                    outs = opdef.lower(ctx, ins, op.attrs)
         for slot, names in op.outputs.items():
             vals = outs.get(slot)
             if vals is None:

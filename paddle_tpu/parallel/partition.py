@@ -26,6 +26,7 @@ import numpy as np
 from ..framework import PIPELINE_STAGE_ATTR
 
 __all__ = [
+    "analytic_op_flops_bytes",
     "analytic_op_time_us",
     "stages_from_attrs",
     "balanced_partition",
@@ -46,8 +47,11 @@ def _bytes(aval):
     return _size(aval) * np.dtype(aval.dtype).itemsize
 
 
-def analytic_op_time_us(op_type, in_avals, out_avals):
-    """Roofline time estimate for one op: max(FLOP time, byte time).
+def analytic_op_flops_bytes(op_type, in_avals, out_avals):
+    """(flops, bytes) estimate for one op — the counting model underneath
+    analytic_op_time_us, exposed separately so observability/opprof.py can
+    report per-op FLOPs with the SAME numbers the pipeline partitioner
+    balances on.
 
     in_avals: {slot: [aval, ...]} of the op's inputs; out_avals likewise.
     Mirrors HloIndex.instr_flops' counting (tools/mfu_audit.py) at the
@@ -80,6 +84,13 @@ def analytic_op_time_us(op_type, in_avals, out_avals):
         out = flat_out[0]
         h = out.shape[-1] if out.shape else 1
         flops = 2 * _size(out) * int(h)
+    return flops, nbytes
+
+
+def analytic_op_time_us(op_type, in_avals, out_avals):
+    """Roofline time estimate for one op: max(FLOP time, byte time), from
+    analytic_op_flops_bytes against the measured v5e peaks."""
+    flops, nbytes = analytic_op_flops_bytes(op_type, in_avals, out_avals)
     return max(flops / _PEAK_MM_FLOPS_PER_US, nbytes / _PEAK_BW_BYTES_PER_US)
 
 

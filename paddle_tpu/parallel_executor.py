@@ -27,6 +27,7 @@ from .executor import (
     _MultiStepBlock,
     _PipelinedBlock,
     _as_feed_array,
+    _flags_opprof,
     _telemetry_begin,
     _telemetry_record,
     global_scope,
@@ -226,6 +227,9 @@ class ParallelExecutor:
             )
             if pp > 1
             else None,
+            # toggling FLAGS_tensor_stats must recompile (executor.py key
+            # carries the same term)
+            _flags_opprof()["tensor_stats"],
         )
         compiled = self._cache.get(key)
         _obs_cache_hit = compiled is not None

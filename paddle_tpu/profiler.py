@@ -174,34 +174,52 @@ def cuda_profiler(*args, **kwargs):
     yield
 
 
-def _hlo_op_map(hlo_text):
-    """instruction name -> framework op type, parsed from the compiled HLO's
-    op_name metadata (registry.lower_ops names every op's scope after its
-    type, so paths look like 'jit(run)/<op type>/<prim>' — sub-block ops
-    attribute to their enclosing control-flow op)."""
+def _hlo_op_attribution(hlo_text):
+    """instruction name -> (op type, output var name or None), parsed from
+    the compiled HLO's op_name metadata. registry.lower_ops emits
+    '.../<op type>/out=<first output>/...' nested scopes, so the first
+    non-wrapper segment is the op type and the segment after it (when it is
+    an 'out=' tag) names the op INSTANCE; sub-block ops attribute to their
+    enclosing control-flow op."""
     import re
 
     mapping = {}
     for m in re.finditer(r'%([\w.\-]+) = [^\n]*op_name="([^"]+)"', hlo_text):
         path = m.group(2).split("/")
         key = None
-        for seg in path:
+        out = None
+        for i, seg in enumerate(path):
             # skip jit/transform wrappers and arg-pytree paths like
             # "feeds['img']" / "mut_state['w_0']" (donation copies — those
             # group under their HLO opcode instead)
             if seg.startswith("jit(") or seg.startswith("transpose(") or "[" in seg:
                 continue
             key = seg
+            if i + 1 < len(path) and path[i + 1].startswith("out="):
+                out = path[i + 1][len("out="):]
             break
         if key:
-            mapping[m.group(1)] = key
+            mapping[m.group(1)] = (key, out)
     return mapping
 
 
-def _merge_device_plane_events(planes, events):
+def _hlo_op_map(hlo_text):
+    """instruction name -> framework op type (the type-level view of
+    _hlo_op_attribution, kept as device_op_profile's correlation key)."""
+    return {
+        instr: typ for instr, (typ, _out) in _hlo_op_attribution(hlo_text).items()
+    }
+
+
+def _merge_device_plane_events(planes, events, aux=None):
     """Fold one xplane's device planes into the shared `events` table
     ({instr_name: [count, total_ms, min_ms, max_ms]}). Separated from the
-    file loop so synthetic plane data can drive it in tests."""
+    file loop so synthetic plane data can drive it in tests.
+
+    `aux` (optional dict) additionally collects XLA cost-analysis stats the
+    trace carries per instruction — {instr_name: {"flops": f, "bytes": b}} —
+    without changing the 4-element row shape existing callers (mfu_audit,
+    device_op_profile) depend on."""
     for plane in planes:
         if "TPU" not in plane.name and "GPU" not in plane.name:
             continue
@@ -211,10 +229,14 @@ def _merge_device_plane_events(planes, events):
             for ev in line.events:
                 name = ev.name.lstrip("%").split(" ")[0]
                 dur_ms = None
+                flops = nbytes = None
                 for k, v in ev.stats or []:
                     if k == "device_duration_ps":
                         dur_ms = float(v) / 1e9
-                        break
+                    elif k == "flops":
+                        flops = float(v)
+                    elif k in ("bytes accessed", "bytes_accessed"):
+                        nbytes = float(v)
                 if dur_ms is None:
                     continue
                 row = events.setdefault(name, [0, 0.0, float("inf"), 0.0])
@@ -222,13 +244,23 @@ def _merge_device_plane_events(planes, events):
                 row[1] += dur_ms
                 row[2] = min(row[2], dur_ms)
                 row[3] = max(row[3], dur_ms)
+                if aux is not None and (flops is not None or nbytes is not None):
+                    # cost analysis is per-instruction, not per-execution:
+                    # keep the max seen, don't accumulate over repeats
+                    a = aux.setdefault(name, {"flops": 0.0, "bytes": 0.0})
+                    if flops is not None:
+                        a["flops"] = max(a["flops"], flops)
+                    if nbytes is not None:
+                        a["bytes"] = max(a["bytes"], nbytes)
     return events
 
 
-def device_instr_events(log_dir):
+def device_instr_events(log_dir, aux=None):
     """Per-HLO-instruction device timings from an xla_trace log dir:
     {instr_name: [count, total_ms, min_ms, max_ms]}. Shared base for
-    device_op_profile and tools/mfu_audit.py.
+    device_op_profile and tools/mfu_audit.py. Pass a dict as `aux` to also
+    collect per-instruction XLA cost-analysis stats when the trace carries
+    them (see _merge_device_plane_events).
 
     ALL xplane.pb files under the dir are merged — a trace session writes one
     per host (multi-host run) and a restarted/repeated trace leaves several;
@@ -247,7 +279,7 @@ def device_instr_events(log_dir):
     profile_data = _jprof.ProfileData
     events = {}
     for path in paths:
-        _merge_device_plane_events(profile_data.from_file(path).planes, events)
+        _merge_device_plane_events(profile_data.from_file(path).planes, events, aux=aux)
     return events
 
 

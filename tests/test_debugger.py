@@ -1,0 +1,122 @@
+"""paddle_tpu/debugger.py coverage: pprint over programs with control-flow
+sub-blocks, and draw_block_graphviz with and without the op_profile cost
+overlay."""
+
+import re
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import debugger, framework
+from paddle_tpu.observability import opprof
+
+
+def _while_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=4)
+        acc = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            acc2 = fluid.layers.elementwise_add(
+                acc, fluid.layers.fill_constant([1], "float32", 2.0)
+            )
+            fluid.layers.assign(acc2, acc)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+    return main
+
+
+def _train_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        p = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=p, label=y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main
+
+
+def test_pprint_program_with_sub_blocks():
+    main = _while_program()
+    assert main.num_blocks >= 2  # While body is its own block
+    text = debugger.pprint_program_codes(main)
+    # every block renders, top-level and sub-block ops both show
+    for i in range(main.num_blocks):
+        assert "block_%d {" % i in text
+    assert "while(" in text
+    assert "increment(" in text
+    # vars render with dtype/shape and persistable tag layout
+    assert re.search(r"var \S+\[\S+,\S+\]", text)
+
+
+def test_pprint_hides_backward_by_default():
+    main = _train_program()
+    shown = debugger.pprint_program_codes(main)
+    full = debugger.pprint_program_codes(main, show_backward=True)
+    assert "_grad(" not in shown
+    assert "_grad(" in full
+    assert len(full) > len(shown)
+
+
+def test_graphviz_without_costs(tmp_path):
+    main = _while_program()
+    out = tmp_path / "g.dot"
+    block = main.global_block()
+    hot_var = block.ops[0].output_arg_names[0]
+    dot = debugger.draw_block_graphviz(
+        block, highlights=[hot_var], path=str(out)
+    )
+    assert out.read_text() == dot
+    assert dot.startswith("digraph G {") and dot.rstrip().endswith("}")
+    # op boxes keep the default fill when no costs are given
+    assert '"op_0_fill_constant"' in dot
+    assert "#d2e5ff" in dot and "(ms" not in dot
+    # the highlighted var is red, others not
+    assert re.search(r'"v_%s" \[label="%s" shape=ellipse style=filled '
+                     r'fillcolor="#ffd2d2"\]' % (re.escape(hot_var),
+                                                 re.escape(hot_var)), dot)
+
+
+def test_graphviz_with_cost_mapping(tmp_path):
+    main = _train_program()
+    block = main.global_block()
+    muls = [op for op in block.ops if op.type == "mul"]
+    assert muls
+    mul_disp = opprof.op_display_name(muls[0])
+    costs = {mul_disp: 8.0, "mean": 1.0}
+    dot = debugger.draw_block_graphviz(
+        block, path=str(tmp_path / "g.dot"), costs=costs
+    )
+    # instance-matched op labeled with its ms and heat-colored hottest (red)
+    assert "mul\\n(8.00 ms)" in dot
+    assert "#ff8466" in dot
+    # type-level fallback: every mean op picks up the type cost
+    assert "mean\\n(1.00 ms)" in dot
+    # unmatched ops keep the default fill
+    assert "#d2e5ff" in dot
+
+
+def test_graphviz_accepts_op_profile_record(tmp_path):
+    main = _train_program()
+    block = main.global_block()
+    mul_disp = opprof.op_display_name(
+        next(op for op in block.ops if op.type == "mul")
+    )
+    record = {
+        "kind": "op_profile",
+        "ops": [
+            {"op": mul_disp, "total_ms": 4.0, "count": 1},
+            {"op": "no_such_op:zzz", "total_ms": 9.0, "count": 1},
+        ],
+    }
+    dot = debugger.draw_block_graphviz(
+        block, path=str(tmp_path / "g.dot"), costs=record
+    )
+    assert "mul\\n(4.00 ms)" in dot
+    assert "no_such_op" not in dot

@@ -238,8 +238,10 @@ def test_profile_ops_mode():
         fluid.set_flags({"profile_ops": False})
         fluid.profiler.reset_profiler()
     np.testing.assert_allclose(per_op, jitted, rtol=1e-5)
-    assert any(name.endswith("op/mul") for name in table), table.keys()
-    assert any(name.endswith("op/relu") for name in table), table.keys()
+    # events are "op/<type>:<output>" (display form) — assert the op TYPES
+    # were attributed without pinning the instance suffix
+    assert any("op/mul" in name for name in table), table.keys()
+    assert any("op/relu" in name for name in table), table.keys()
 
 
 def test_device_op_profile_correlation(tmp_path):

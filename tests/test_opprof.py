@@ -1,0 +1,521 @@
+"""Op-level attribution tests (observability/opprof.py + executor wiring):
+HLO op_name attribution, the op_profile record/CLI/timeline/monitor path,
+FLAGS_tensor_stats on-device output statistics, and FLAGS_nan_provenance
+first-bad-op localization through both the resilience guard and
+FLAGS_check_nan_inf."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu import profiler
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.observability import opprof
+from paddle_tpu.observability import registry as obs_registry
+from paddle_tpu.observability import stepstats as obs_stepstats
+from paddle_tpu.resilience import health
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TOOLS = os.path.join(HERE, "..", "tools")
+
+FLAG_DEFAULTS = {
+    "tensor_stats": "",
+    "nan_provenance": False,
+    "resilience_nan_guard": False,
+    "check_nan_inf": False,
+    "profile_ops": False,
+    "telemetry_dir": "",
+}
+
+
+@pytest.fixture(autouse=True)
+def _opprof_defaults():
+    """All attribution flags off and the process-global stashes/collector
+    clean around every test."""
+
+    def clear():
+        pt.set_flags(dict(FLAG_DEFAULTS))
+        profiler.reset_profiler()
+        col = obs_stepstats.collector()
+        col.close()
+        col.reset()
+        health.reset()
+        reg = obs_registry.default_registry()
+        for name in reg.names():
+            reg.get(name).clear()
+        with opprof._lock:
+            opprof._last_tensor_stats = None
+            opprof._last_provenance = None
+
+    clear()
+    yield
+    clear()
+
+
+def _mlp_program(act="relu"):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act=act)
+        p = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=p, label=y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(rng, batch=8):
+    return {
+        "x": rng.randn(batch, 4).astype("float32"),
+        "y": rng.randn(batch, 1).astype("float32"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# op identity + matching
+# ---------------------------------------------------------------------------
+
+
+def test_display_name_and_match():
+    main, _, _ = _mlp_program()
+    ops = list(main.global_block().ops)
+    muls = opprof.match_ops(ops, "mul")
+    assert muls and all(o.type == "mul" for o in muls)
+    disp = opprof.op_display_name(muls[0])
+    assert disp.startswith("mul:") and ":" in disp
+    # glob over instance names and over output vars both hit
+    assert opprof.match_ops(main.global_block(), "mul:*") == muls
+    out_var = muls[0].output_arg_names[0]
+    assert muls[0] in opprof.match_ops(ops, out_var)
+    assert opprof.match_ops(ops, "no_such_op_zzz") == []
+
+
+def test_iter_block_ops_recurses_into_while():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=4)
+        acc = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            acc2 = fluid.layers.elementwise_add(
+                acc, fluid.layers.fill_constant([1], "float32", 2.0)
+            )
+            fluid.layers.assign(acc2, acc)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+    all_types = {op.type for op in opprof.iter_block_ops(main.global_block())}
+    top_types = {op.type for op in main.global_block().ops}
+    assert "while" in top_types
+    # sub-block ops are reachable through the walk but not at top level
+    assert "increment" in all_types and "increment" not in top_types
+    assert opprof.match_ops(main.global_block(), "increment")
+
+
+def test_stats_spec_dedups_by_output_var():
+    main, _, _ = _mlp_program()
+    spec = opprof.stats_spec(main.global_block().ops, "*")
+    names = [v for _, v in spec]
+    assert len(names) == len(set(names))
+    assert any(d.startswith("mul:") for d, _ in spec)
+
+
+# ---------------------------------------------------------------------------
+# leg 1: cost attribution
+# ---------------------------------------------------------------------------
+
+_HLO = "\n".join(
+    [
+        "HloModule jit_run",
+        '%dot.1 = f32[8,8] dot(...), op_name="jit(run)/mul/out=fc_0.tmp_0/dot"',
+        '%add.2 = f32[8,8] add(...), op_name="jit(run)/elementwise_add/add"',
+        '%copy.3 = f32[8,8] copy(...)',
+        '%dot.4 = f32[8,1] dot(...), op_name="jit(run)/mul/out=fc_1.tmp_0/dot"',
+    ]
+)
+
+
+def test_attribute_events_instances_types_and_fallback():
+    events = {
+        "dot.1": [2, 4.0, 1.5, 2.5],
+        "add.2": [1, 1.0, 1.0, 1.0],
+        "copy.3": [1, 0.5, 0.5, 0.5],
+        "dot.4": [1, 2.0, 2.0, 2.0],
+        "dot.1.clone": [1, 1.0, 1.0, 1.0],  # dotted suffix retries base instr
+    }
+    aux = {"dot.1": {"flops": 1024, "bytes": 4096}}
+    table = opprof.attribute_events(events, _HLO, aux=aux)
+    assert set(table) == {
+        "mul:fc_0.tmp_0",
+        "elementwise_add",
+        "hlo:copy",
+        "mul:fc_1.tmp_0",
+    }
+    row = table["mul:fc_0.tmp_0"]
+    assert row["type"] == "mul"
+    assert row["count"] == 3  # dot.1 (2) + dot.1.clone (1)
+    assert row["total_ms"] == pytest.approx(5.0)
+    assert row["flops"] == 1024 and row["bytes"] == 4096
+    assert table["hlo:copy"]["total_ms"] == pytest.approx(0.5)
+
+
+def test_build_record_pct_and_cost_fill():
+    events = {"dot.1": [1, 6.0, 6.0, 6.0], "add.2": [1, 2.0, 2.0, 2.0]}
+    table = opprof.attribute_events(events, _HLO)
+    costs = {
+        "mul:fc_0.tmp_0": (500, 2000),
+        "elementwise_add:conv.tmp_0": (0, 64),
+        "elementwise_add:conv.tmp_1": (0, 36),
+    }
+    rec = opprof.build_record(table, step_ms=10.0, step=7, costs=costs)
+    assert rec["kind"] == "op_profile" and rec["step"] == 7
+    assert rec["step_ms"] == 10.0
+    assert rec["total_device_ms"] == pytest.approx(8.0)
+    rows = {r["op"]: r for r in rec["ops"]}
+    # rows sorted by total_ms desc
+    assert rec["ops"][0]["op"] == "mul:fc_0.tmp_0"
+    assert rows["mul:fc_0.tmp_0"]["pct"] == pytest.approx(60.0)
+    assert rows["mul:fc_0.tmp_0"]["flops"] == 500  # analytic fill
+    # type-only attribution sums the instance-level analytic costs
+    assert rows["elementwise_add"]["bytes"] == 100
+    # without step_ms pct self-normalizes to the summed device time
+    rec2 = opprof.build_record(table)
+    assert rec2["ops"][0]["pct"] == pytest.approx(75.0)
+
+
+def test_program_op_costs_and_resolver():
+    main, _, _ = _mlp_program()
+    block = main.global_block()
+    ops = list(opprof.iter_block_ops(block))
+    feed = _feed(np.random.RandomState(0), batch=8)
+    costs = opprof.program_op_costs(ops, opprof.block_aval_resolver(block, feed))
+    mul_keys = [k for k in costs if k.startswith("mul:")]
+    assert mul_keys
+    # first fc: [8,4] @ [4,8] -> 2*8*8*4 flops
+    assert costs[mul_keys[0]][0] == 2 * 8 * 8 * 4
+    assert all(b > 0 for _, b in costs.values())
+
+
+def test_host_profile_from_profiled_run(tmp_path):
+    main, startup, loss = _mlp_program()
+    rng = np.random.RandomState(0)
+    feed = _feed(rng)
+    with scope_guard(Scope(seed=0)):
+        exe = fluid.Executor()
+        exe.run(startup)
+        pt.set_flags({"profile_ops": True})
+        profiler.start_profiler("All")
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        table, _ = profiler._aggregate()
+        rec = opprof.host_profile(
+            table=table, step_ms=50.0, block=main.global_block(),
+            feed_avals=feed,
+        )
+        profiler.stop_profiler(profile_path=str(tmp_path / "p.json"))
+    assert rec["source"] == "host_events"
+    ops = {r["op"]: r for r in rec["ops"]}
+    assert any(k.startswith("mul:") for k in ops)
+    assert any(k.startswith("sgd:") for k in ops)
+    # nested profiler paths (run/block0) never leak in as rows
+    assert all("/" not in k for k in ops)
+    mul = next(r for k, r in ops.items() if k.startswith("mul:"))
+    assert mul["flops"] > 0  # analytic fill via block/feed_avals
+
+
+def test_render_table_matches_cli_renderer():
+    """tools/op_profile.py keeps a paddle_tpu-free copy of render_table —
+    hold the two renderers identical."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import op_profile as cli
+    finally:
+        sys.path.pop(0)
+    events = {"dot.1": [1, 6.0, 6.0, 6.0], "copy.3": [2, 1.0, 0.4, 0.6]}
+    rec = opprof.build_record(opprof.attribute_events(events, _HLO), step_ms=9.0)
+    assert opprof.render_table(rec, top=5) == cli.render_table(rec, top=5)
+    assert "mul:fc_0.tmp_0" in opprof.render_table(rec)
+    assert "coverage" in opprof.render_table(rec)
+
+
+def test_op_profile_cli_and_timeline_track(tmp_path):
+    events = {"dot.1": [1, 6.0, 6.0, 6.0], "add.2": [1, 2.0, 2.0, 2.0]}
+    rec = opprof.build_record(opprof.attribute_events(events, _HLO), step_ms=10.0)
+    rec["ts"] = 100.0
+    shard = tmp_path / "telemetry-host0.jsonl"
+    shard.write_text(
+        json.dumps({"kind": "step", "step": 1, "ts": 99.0, "host": 0,
+                    "wall_ms": 10.0}) + "\n" + json.dumps(rec) + "\n"
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "op_profile.py"),
+         "--dir", str(tmp_path), "--top", "3"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "mul:fc_0.tmp_0" in r.stdout and "total device ms" in r.stdout
+    # --json round-trips the record
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "op_profile.py"),
+         "--file", str(shard), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0 and json.loads(r.stdout)["kind"] == "op_profile"
+    # a dir with no op_profile records is a clean failure
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "op_profile.py"),
+         "--dir", str(empty)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+
+    tl = tmp_path / "timeline.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "timeline.py"),
+         "--telemetry_path", str(shard), "--timeline_path", str(tl)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    trace = json.loads(tl.read_text())["traceEvents"]
+    spans = [e for e in trace if e.get("cat") == "op_profile"]
+    assert [s["name"] for s in spans] == ["mul:fc_0.tmp_0", "elementwise_add"]
+    # laid end to end in rank order, widths = total ms
+    assert spans[0]["ts"] == 0 and spans[0]["dur"] == pytest.approx(6000.0)
+    assert spans[1]["ts"] == pytest.approx(6000.0)
+    # counter tracks still present next to the op track
+    assert any(e.get("ph") == "C" for e in trace)
+
+
+def test_monitor_renders_top_ops(tmp_path):
+    sys.path.insert(0, TOOLS)
+    try:
+        import monitor
+    finally:
+        sys.path.pop(0)
+    records = [
+        {"kind": "step", "step": 1, "ts": 1.0, "host": 0, "wall_ms": 5.0},
+        {"kind": "op_profile", "ts": 2.0, "host": 0,
+         "ops": [{"op": "mul:fc_0.tmp_0", "total_ms": 6.0, "pct": 60.0},
+                 {"op": "elementwise_add", "total_ms": 2.0, "pct": 20.0}]},
+    ]
+    summary = monitor.summarize(records)
+    assert summary["top_ops"][0][0] == "mul:fc_0.tmp_0"
+    out = monitor.render(summary)
+    assert "op/mul:fc_0.tmp_0" in out and "60.0%" in out
+
+
+# ---------------------------------------------------------------------------
+# leg 2: tensor stats
+# ---------------------------------------------------------------------------
+
+
+def test_tensor_stats_values_and_record(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        w = fluid.layers.create_parameter([4, 3], "float32", name="w")
+        y = fluid.layers.mul(x, w)
+        z = fluid.layers.relu(y)
+    pt.set_flags({"tensor_stats": "*", "telemetry_dir": str(tmp_path)})
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.randn(5, 4).astype("float32")}
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (out,) = exe.run(main, feed=feed, fetch_list=[z.name])
+        w_host = np.asarray(scope.vars["w"])
+    stats = opprof.last_tensor_stats()
+    assert stats is not None
+    relu_key = next(k for k in stats if k.startswith("relu:"))
+    mul_key = next(k for k in stats if k.startswith("mul:"))
+    ref_mul = feed["x"] @ w_host
+    ref_relu = np.maximum(ref_mul, 0)
+    assert stats[mul_key]["mean"] == pytest.approx(ref_mul.mean(), abs=1e-5)
+    assert stats[mul_key]["std"] == pytest.approx(ref_mul.std(), abs=1e-5)
+    assert stats[relu_key]["absmax"] == pytest.approx(
+        np.abs(ref_relu).max(), abs=1e-5
+    )
+    assert stats[relu_key]["nonfinite"] == 0
+    np.testing.assert_allclose(out, ref_relu, rtol=1e-5)
+    # labelled gauges
+    snap = obs_registry.default_registry().snapshot()
+    assert "tensor_stats/absmax" in snap
+    assert any("relu" in label for label in snap["tensor_stats/absmax"]["values"])
+    # telemetry record
+    obs_stepstats.collector().flush()
+    shard = tmp_path / "telemetry-host0.jsonl"
+    recs = [json.loads(l) for l in shard.read_text().splitlines() if l.strip()]
+    ts_recs = [r for r in recs if r["kind"] == "tensor_stats"]
+    assert ts_recs and mul_key in ts_recs[-1]["ops"]
+
+
+def test_tensor_stats_counts_nonfinite():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        r = fluid.layers.relu(x)
+    pt.set_flags({"tensor_stats": "relu*"})
+    bad = np.ones((2, 4), np.float32)
+    bad[0, 0] = np.nan
+    bad[1, 2] = np.inf
+    with scope_guard(Scope(seed=0)):
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed={"x": bad}, fetch_list=[r.name])
+    stats = opprof.last_tensor_stats()
+    (row,) = stats.values()
+    assert row["nonfinite"] == 2
+
+
+def test_tensor_stats_glob_filters_and_toggle_recompiles():
+    main, startup, loss = _mlp_program()
+    rng = np.random.RandomState(0)
+    feed = _feed(rng)
+    with scope_guard(Scope(seed=0)):
+        exe = fluid.Executor()
+        exe.run(startup)
+        pt.set_flags({"tensor_stats": "mul:*"})
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        stats = opprof.last_tensor_stats()
+        assert stats and all(k.startswith("mul:") for k in stats)
+        # toggling off must recompile (flag is in the cache key), and the
+        # uninstrumented run must not refresh the stash
+        with opprof._lock:
+            opprof._last_tensor_stats = None
+        pt.set_flags({"tensor_stats": ""})
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        assert opprof.last_tensor_stats() is None
+
+
+def test_tensor_stats_off_by_default_no_instrumentation():
+    main, startup, loss = _mlp_program()
+    rng = np.random.RandomState(0)
+    feed = _feed(rng)
+    with scope_guard(Scope(seed=0)):
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        compiled = next(iter(exe._cache.values()))
+        assert compiled._tstat_spec == ()
+    assert opprof.last_tensor_stats() is None
+
+
+# ---------------------------------------------------------------------------
+# leg 3: NaN provenance
+# ---------------------------------------------------------------------------
+
+
+def test_provenance_via_nan_guard():
+    main, startup, loss = _mlp_program()
+    pt.set_flags({"nan_provenance": True, "resilience_nan_guard": True})
+    rng = np.random.RandomState(0)
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed=_feed(rng), fetch_list=[loss.name])  # clean step
+        assert opprof.last_provenance() is None
+        wname = next(n for n in scope.vars if n.endswith(".w_0"))
+        w_before = np.asarray(scope.vars[wname])
+        bad = _feed(rng)
+        bad["x"][:] = np.nan
+        exe.run(main, feed=bad, fetch_list=[loss.name])
+        # guard rolled the step back AND provenance localized the first op
+        np.testing.assert_array_equal(np.asarray(scope.vars[wname]), w_before)
+    prov = opprof.last_provenance()
+    assert prov is not None
+    assert prov["kind"] == "nan_provenance"
+    assert prov["reason"] == "resilience_nan_guard"
+    # x feeds the first fc's mul — the first op to emit non-finite output
+    assert prov["op_type"] == "mul" and prov["op_index"] == 0
+    assert prov["op"].startswith("mul:")
+    assert prov["input_stats"]["x"]["nonfinite"] > 0
+    assert prov["step"] is not None
+    assert health.get("nan_provenance") == 1
+    assert health.get("nan_steps_skipped") == 1
+
+
+def test_check_nan_inf_reports_writer_step_and_provenance():
+    main, startup, loss = _mlp_program()
+    pt.set_flags({"check_nan_inf": True, "nan_provenance": True})
+    rng = np.random.RandomState(0)
+    bad = _feed(rng)
+    bad["x"][:] = np.nan
+    with scope_guard(Scope(seed=0)):
+        exe = fluid.Executor()
+        exe.run(startup)
+        with pytest.raises(FloatingPointError) as ei:
+            exe.run(main, feed=bad, fetch_list=[loss.name])
+    msg = str(ei.value)
+    assert "check_nan_inf" in msg
+    assert "last written by op" in msg
+    assert "run step" in msg
+    assert "first non-finite output at op #0 mul:" in msg
+    prov = opprof.last_provenance()
+    assert prov is not None and prov["reason"] == "check_nan_inf"
+
+
+def test_check_nan_inf_message_without_provenance_flag():
+    main, startup, loss = _mlp_program()
+    pt.set_flags({"check_nan_inf": True})
+    rng = np.random.RandomState(0)
+    bad = _feed(rng)
+    bad["x"][:] = np.nan
+    with scope_guard(Scope(seed=0)):
+        exe = fluid.Executor()
+        exe.run(startup)
+        with pytest.raises(FloatingPointError) as ei:
+            exe.run(main, feed=bad, fetch_list=[loss.name])
+    msg = str(ei.value)
+    assert "last written by op" in msg and "run step" in msg
+    assert "first non-finite" not in msg
+    assert opprof.last_provenance() is None
+
+
+def test_provenance_off_by_default():
+    main, startup, loss = _mlp_program()
+    pt.set_flags({"resilience_nan_guard": True})
+    rng = np.random.RandomState(0)
+    bad = _feed(rng)
+    bad["x"][:] = np.nan
+    with scope_guard(Scope(seed=0)):
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed=bad, fetch_list=[loss.name])
+    assert opprof.last_provenance() is None
+    assert health.get("nan_steps_skipped") == 1
+
+
+def test_localize_nonfinite_walks_in_program_order():
+    """Unit-level: the walker stops at the FIRST op whose output is bad,
+    even when later ops also produce non-finite values."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        a = fluid.layers.log(x)        # log of negatives -> nan
+        fluid.layers.sqrt(a)           # also nan, but downstream
+    import jax
+
+    env = {"x": np.full((2, 4), -1.0, np.float32)}
+    ops = [
+        op for op in main.global_block().ops
+        if op.type not in ("feed", "fetch")
+    ]
+    prov = opprof.localize_nonfinite(ops, env, jax.random.key(0), step=11)
+    assert prov is not None
+    assert prov["op_type"] == "log" and prov["op_index"] == 0
+    assert prov["step"] == 11
+    # clean inputs -> no finding
+    env = {"x": np.ones((2, 4), np.float32)}
+    assert opprof.localize_nonfinite(ops, env, jax.random.key(0)) is None

@@ -178,13 +178,15 @@ def test_dump_timeline_roundtrip(tmp_path, capsys):
 
 
 def _plane(device_name, instrs):
-    """Synthetic xplane: instrs = [(name, duration_ps), ...]."""
-    events = [
-        types.SimpleNamespace(
-            name=name, stats=[("device_duration_ps", ps)]
-        )
-        for name, ps in instrs
-    ]
+    """Synthetic xplane: instrs = [(name, duration_ps), ...] or
+    [(name, duration_ps, extra_stats_dict), ...]."""
+    events = []
+    for row in instrs:
+        name, ps = row[0], row[1]
+        stats = [("device_duration_ps", ps)]
+        if len(row) > 2:
+            stats.extend(row[2].items())
+        events.append(types.SimpleNamespace(name=name, stats=stats))
     line = types.SimpleNamespace(name="XLA Ops", events=events)
     return types.SimpleNamespace(name=device_name, lines=[line])
 
@@ -203,6 +205,45 @@ def test_merge_device_plane_events_accumulates():
     )
     assert events["fusion.1"] == [2, 6.0, 2.0, 4.0]  # count,total,min,max ms
     assert events["fusion.2"] == [1, 1.0, 1.0, 1.0]
+
+
+def test_merge_device_plane_events_collects_cost_aux():
+    """The xplane cost-analysis stats (flops / bytes accessed) land in the
+    aux dict, MAXed per instruction — cost analysis is a per-instruction
+    property, not per-execution, so replicas must not sum."""
+    events, aux = {}, {}
+    profiler._merge_device_plane_events(
+        [_plane("TPU:0", [("%dot.1", 2e9, {"flops": 128, "bytes accessed": 64}),
+                          ("%add.2", 1e9)])],
+        events, aux=aux,
+    )
+    profiler._merge_device_plane_events(
+        [_plane("TPU:1", [("%dot.1", 3e9, {"flops": 128, "bytes_accessed": 96})])],
+        events, aux=aux,
+    )
+    assert events["dot.1"] == [2, 5.0, 2.0, 3.0]
+    assert aux["dot.1"] == {"flops": 128, "bytes": 96}
+    assert "add.2" not in aux
+    # aux=None callers (the PR-10 correlation path) keep the old behavior
+    profiler._merge_device_plane_events(
+        [_plane("TPU:0", [("%dot.1", 1e9, {"flops": 128})])], events
+    )
+    assert events["dot.1"][0] == 3
+
+
+def test_hlo_op_attribution_instances():
+    hlo = "\n".join([
+        'HloModule jit_run',
+        '%dot.5 = f32[8,8] dot(...), op_name="jit(run)/mul/out=fc_0.tmp_0/dot"',
+        '%exp.6 = f32[8,8] exponential(...), op_name="jit(run)/softmax/exp"',
+        '%copy.7 = f32[8,8] copy(...)',
+    ])
+    att = profiler._hlo_op_attribution(hlo)
+    assert att["dot.5"] == ("mul", "fc_0.tmp_0")
+    assert att["exp.6"] == ("softmax", None)
+    assert "copy.7" not in att
+    # the PR-10 type-only map is derived from the same parse
+    assert profiler._hlo_op_map(hlo) == {"dot.5": "mul", "exp.6": "softmax"}
 
 
 def test_device_instr_events_merges_all_xplane_files(tmp_path, monkeypatch):

@@ -70,6 +70,7 @@ def summarize(records, window=200):
     """
     steps = [r for r in records if r.get("kind") == "step"]
     snaps = [r for r in records if r.get("kind") == "snapshot"]
+    opprofs = [r for r in records if r.get("kind") == "op_profile"]
     recent = steps[-window:]
 
     summary = {
@@ -89,7 +90,20 @@ def summarize(records, window=200):
         "cache_hits": None,
         "cache_misses": None,
         "health": {},
+        "top_ops": [],
     }
+
+    if opprofs:
+        # latest profile wins; keep the top rows for the display
+        last_prof = opprofs[-1]
+        summary["top_ops"] = [
+            (
+                r.get("op", "?"),
+                float(r.get("total_ms", 0.0)),
+                float(r.get("pct", 0.0)),
+            )
+            for r in last_prof.get("ops", [])[:5]
+        ]
 
     if recent:
         walls = sorted(float(r.get("wall_ms", 0.0)) for r in recent)
@@ -191,6 +205,8 @@ def render(summary):
         )
     for name in sorted(summary["health"]):
         rows.append(("health/" + name, str(summary["health"][name])))
+    for op, total_ms, pct in summary.get("top_ops", []):
+        rows.append(("op/" + op, "%.3f ms (%.1f%%)" % (total_ms, pct)))
 
     width = max(len(k) for k, _ in rows)
     lines = ["=== telemetry monitor (%d step records) ===" % summary["n_steps"]]

@@ -95,6 +95,53 @@ def _counter_events(records, pid):
     return out
 
 
+def _op_profile_events(records, pid):
+    """The LATEST op_profile record (observability/opprof.py) → one span
+    track: each op's total device ms laid end to end in rank order, so the
+    chrome-trace bar widths read as the per-op time breakdown. The lane
+    carries FLOPs/bytes/% in args for the tooltip."""
+    ops = None
+    for r in records:
+        if r.get("kind") == "op_profile" and r.get("ops"):
+            ops = r["ops"]  # later records win: profiles refine over a run
+    if not ops:
+        return [], None
+    out = []
+    cursor = 0.0
+    for rank, row in enumerate(ops):
+        dur_us = float(row.get("total_ms", 0.0)) * 1e3
+        if dur_us <= 0:
+            continue
+        out.append(
+            {
+                "name": row.get("op", "?"),
+                "cat": "op_profile",
+                "ph": "X",
+                "pid": pid,
+                "tid": 0,
+                "ts": cursor,
+                "dur": dur_us,
+                "args": {
+                    "rank": rank,
+                    "count": row.get("count", 0),
+                    "mean_ms": row.get("mean_ms", 0.0),
+                    "flops": row.get("flops", 0),
+                    "bytes": row.get("bytes", 0),
+                    "pct": row.get("pct", 0.0),
+                },
+            }
+        )
+        cursor += dur_us
+    meta = {
+        "name": "thread_name",
+        "ph": "M",
+        "pid": pid,
+        "tid": 0,
+        "args": {"name": "op attribution (total device ms, ranked)"},
+    }
+    return out, meta
+
+
 def convert(profile_path, timeline_path, telemetry_path=None):
     trace_events = []
     metadata = []
@@ -125,7 +172,8 @@ def convert(profile_path, timeline_path, telemetry_path=None):
                 )
         pid += 1
     if telemetry_path:
-        for off, (name, path) in enumerate(_load(telemetry_path)):
+        named = _load(telemetry_path)
+        for off, (name, path) in enumerate(named):
             tpid = pid + off
             metadata.append(
                 {
@@ -135,7 +183,22 @@ def convert(profile_path, timeline_path, telemetry_path=None):
                     "args": {"name": name + ":telemetry"},
                 }
             )
-            trace_events.extend(_counter_events(_read_jsonl(path), tpid))
+            records = _read_jsonl(path)
+            trace_events.extend(_counter_events(records, tpid))
+            # op_profile records get a dedicated span track (per-op device
+            # time breakdown) under their own pid, next to the counters
+            op_events, op_meta = _op_profile_events(records, tpid + len(named))
+            if op_events:
+                metadata.append(
+                    {
+                        "name": "process_name",
+                        "ph": "M",
+                        "pid": tpid + len(named),
+                        "args": {"name": name + ":op_profile"},
+                    }
+                )
+                metadata.append(op_meta)
+                trace_events.extend(op_events)
     with open(timeline_path, "w") as f:
         json.dump({"traceEvents": metadata + trace_events}, f)
     return len(trace_events)
