@@ -35,7 +35,13 @@ from .framework import Program, Variable, convert_np_dtype
 from .ops import registry
 from .ops.registry import EMPTY_VAR_NAME
 
-__all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
+__all__ = [
+    "Executor",
+    "Scope",
+    "global_scope",
+    "scope_guard",
+    "aot_serve_lowering",
+]
 
 
 def _flags_profile_ops():
@@ -587,6 +593,39 @@ class _CompiledBlock:
                         "message): %r" % e, file=sys.stderr,
                     )
         return fetches
+
+
+def aot_serve_lowering(program, feed_names, fetch_names, scope):
+    """Donation-free forward lowering for ahead-of-time serving.
+
+    The serving side (inference.export_compiled, serving.engine) needs the
+    block's pure lowering WITHOUT the training executor's buffer-donation
+    jit: a serving replica calls the same compiled variant from many request
+    threads, so parameters must stay valid across calls. Returns
+    (serve, ro, mut) where `serve(feeds, ro, mut) -> [fetches]` is a
+    jit/export-able closure over the block's op lowerings, and ro/mut are the
+    scope's read-only / block-rewritten persistables, passed as ARGUMENTS
+    (not baked constants) so one artifact serves any parameter values of the
+    same shapes. The scope's rng key is captured at trace time — inference
+    programs are pruned of training-only stochastic ops by clone(for_test),
+    so the key never advances.
+    """
+    block = program.global_block()
+    compiled = _CompiledBlock(
+        program, block, list(feed_names), list(fetch_names), scope,
+        instrument=False,
+    )
+    ro = {n: scope.vars[n] for n in compiled.ro_names}
+    mut = {n: scope.vars[n] for n in compiled.mut_names}
+    rng_key = scope.rng_key
+
+    def serve(feeds, ro_, mut_):
+        # compiled.fn is the un-jitted lowering: (feeds, ro, mut, key) ->
+        # (fetches, new_mut, created, key); serving wants fetches only
+        fetches, _, _, _ = compiled.fn(feeds, ro_, mut_, rng_key)
+        return fetches
+
+    return serve, ro, mut
 
 
 class _PipelinedBlock(_CompiledBlock):

@@ -50,6 +50,13 @@ Honored flags:
   record (op type/name, input stats, attrs, step) to the telemetry dir plus
   a health/nan_provenance counter. Off (default): failures name only the
   variable, as before.
+- serving_cache_dir: default persistent compile-cache directory for the
+  serving runtime (serving/compile_cache.py): ServingEngine instances built
+  without an explicit cache_dir store/load serialized jax.export artifacts
+  here, and JAX's persistent XLA-executable cache is pointed at its xla/
+  subdir — a warm replica cold-starts without tracing or compiling
+  (docs/serving.md); "" (default) disables the persistent layer (variants
+  still cache in-process).
 - eager_delete_tensor_gb / fraction_of_gpu_memory_to_use /
   paddle_num_threads: accepted for API compatibility; storage lifetime and
   threading are XLA/PJRT-owned here (documented no-ops).
@@ -78,6 +85,7 @@ _DEFAULTS = {
     "telemetry_log_every": 0,
     "tensor_stats": "",
     "nan_provenance": False,
+    "serving_cache_dir": "",
 }
 
 _flags = {}

@@ -17,8 +17,6 @@ subgraphs for a faster runtime) IS XLA compilation here, so:
   needs no Python model code, just the artifact.
 """
 
-import os
-
 import numpy as np
 
 from . import framework, io
@@ -48,6 +46,13 @@ class Predictor:
         missing = [n for n in self.feed_names if n not in feed]
         if missing:
             raise ValueError("missing feeds: %s" % missing)
+        unknown = sorted(set(feed) - set(self.feed_names))
+        if unknown:
+            # a typo'd feed name silently dropped into exe.run would serve
+            # garbage from the default-initialized var instead
+            raise ValueError(
+                "unknown feeds: %s (model takes %s)" % (unknown, self.feed_names)
+            )
         with scope_guard(self.scope):
             outs = self.exe.run(
                 self.program, feed=feed, fetch_list=self.fetch_names
@@ -65,48 +70,36 @@ class Predictor:
 def export_compiled(model_dir, example_feed, out_path, place=None, params_filename=None):
     """AOT-compile the inference program for the example feed shapes and
     serialize the compiled artifact (StableHLO via jax.export) together with
-    the parameters — deployable without the model-building code."""
+    the parameters — deployable without the model-building code. Returns the
+    path ACTUALLY written (np.savez appends `.npz` when out_path lacks it).
+
+    The lowering is executor.aot_serve_lowering and the artifact format is
+    serving/compile_cache.py's — the same pieces the ServingEngine builds
+    its bucketed variants from; this is the single-shape offline flavor."""
     import jax
     from jax import export as jax_export
     import jax.numpy as jnp
 
+    from .executor import aot_serve_lowering
+    from .serving import compile_cache as _cc
+
     pred = Predictor(model_dir, place, params_filename=params_filename)
     with scope_guard(pred.scope):
-        from .executor import _CompiledBlock
-
         feed = {
             k: np.asarray(v) for k, v in zip(pred.feed_names, example_feed)
         } if isinstance(example_feed, (list, tuple)) else {
             k: np.asarray(v) for k, v in example_feed.items()
         }
-        block = pred.program.global_block()
-        compiled = _CompiledBlock(
-            pred.program, block, list(feed.keys()), pred.fetch_names, pred.scope
+        serve, ro, mut = aot_serve_lowering(
+            pred.program, list(feed.keys()), pred.fetch_names, pred.scope
         )
-        ro = {n: pred.scope.vars[n] for n in compiled.ro_names}
-        mut = {n: pred.scope.vars[n] for n in compiled.mut_names}
-        rng_key = pred.scope.rng_key
-
-        def serve(feeds, ro_, mut_):
-            # compiled.fn is the un-jitted lowering: (feeds, ro, mut, key) ->
-            # (fetches, new_mut, created, key); inference serves fetches only
-            fetches, _, _, _ = compiled.fn(feeds, ro_, mut_, rng_key)
-            return fetches
-
         exported = jax_export.export(jax.jit(serve))(
             {k: jnp.asarray(v) for k, v in feed.items()}, ro, mut
         )
         blob = exported.serialize()
-    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
-    np.savez(
-        out_path,
-        __stablehlo__=np.frombuffer(blob, np.uint8),
-        __feed_names__=np.array(list(feed.keys())),
-        __fetch_names__=np.array(pred.fetch_names),
-        **{"ro:" + k: np.asarray(v) for k, v in ro.items()},
-        **{"mut:" + k: np.asarray(v) for k, v in mut.items()},
+    return _cc.write_artifact(
+        out_path, blob, list(feed.keys()), pred.fetch_names, ro, mut
     )
-    return out_path
 
 
 class _CompiledPredictor:
@@ -130,17 +123,9 @@ class _CompiledPredictor:
 def load_compiled(path):
     """Deserialize an export_compiled artifact; serving needs only this file
     (the reference's fluid_lib_dist/TRT-engine deployment analog)."""
-    from jax import export as jax_export
-    import jax.numpy as jnp
+    from .serving import compile_cache as _cc
 
-    data = np.load(path if path.endswith(".npz") else path + ".npz")
-    exported = jax_export.deserialize(data["__stablehlo__"].tobytes())
-    feed_names = [str(s) for s in data["__feed_names__"]]
-    fetch_names = [str(s) for s in data["__fetch_names__"]]
-    ro = {
-        k[3:]: jnp.asarray(data[k]) for k in data.files if k.startswith("ro:")
-    }
-    mut = {
-        k[4:]: jnp.asarray(data[k]) for k in data.files if k.startswith("mut:")
-    }
-    return _CompiledPredictor(exported, feed_names, fetch_names, ro, mut)
+    d = _cc.read_artifact(path)
+    return _CompiledPredictor(
+        d["exported"], d["feed_names"], d["fetch_names"], d["ro"], d["mut"]
+    )

@@ -11,6 +11,7 @@ as .npy (one file per var) or a single .npz (`filename=` form, the reference's
 save_combine), and the program as JSON (`__model__`, the ProgramDesc analog).
 """
 
+import hashlib
 import json
 import os
 
@@ -30,6 +31,7 @@ __all__ = [
     "save_inference_model",
     "load_inference_model",
     "get_inference_program",
+    "inference_model_fingerprint",
 ]
 
 MODEL_FILENAME = "__model__"
@@ -316,6 +318,34 @@ def save_inference_model(
         filename=params_filename,
     )
     return doc["fetch_var_names"]
+
+
+def inference_model_fingerprint(dirname, model_filename=None):
+    """Stable sha256 over a saved inference model's PROGRAM plus the
+    parameters' STORED dtypes — the serving compile-cache identity
+    (serving/compile_cache.py).
+
+    Deliberately excludes parameter VALUES: compiled serving artifacts take
+    parameters as call arguments, so retrained weights of the same
+    shapes/dtypes reuse every cached executable (the whole point of a
+    persistent cache across model pushes). Shapes and compute dtypes ride
+    the program JSON; the per-var `.npy.dtype` sidecars (and legacy
+    `__dtypes__*.json` metas) are folded in because a bf16-stored parameter
+    loads as bf16 and changes the traced avals without touching the
+    program."""
+    h = hashlib.sha256()
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "rb") as f:
+        h.update(f.read())
+    meta = _load_dtype_meta(dirname)
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME)) as f:
+        doc = json.load(f)
+    program = Program.from_dict(doc)
+    for v in sorted(
+        (v for v in program.list_vars() if v.persistable), key=lambda v: v.name
+    ):
+        stored = _stored_dtype(dirname, v.name, meta)
+        h.update(("%s\x00%s\n" % (v.name, stored or "")).encode())
+    return h.hexdigest()
 
 
 def load_inference_model(

@@ -1,0 +1,41 @@
+"""Production serving runtime (ROADMAP item 1): the serving-side counterpart
+of the training executors.
+
+- engine.ServingEngine — AOT, donation-free, shape-bucketed forward executor
+  over a `save_inference_model` directory; bounded compiled-variant set, no
+  hot-path recompiles.
+- batcher.ContinuousBatcher — continuous dynamic request batching
+  (deadline-or-fill admission, bounded-queue backpressure, per-request
+  timeout, drain/shutdown).
+- compile_cache.CompileCache — persistent on-disk cache of serialized
+  jax.export artifacts (+ XLA executable cache) so replicas cold-start in
+  seconds; also owns the export_compiled artifact format.
+- server.ModelServer — stdlib multi-model HTTP front end
+  (`/v1/models/<name>:predict`, `/healthz`, `/metrics`).
+
+docs/serving.md covers the architecture, bucketing policy, cache layout and
+flags.
+"""
+
+from . import batcher, compile_cache, engine, server  # noqa: F401
+from .batcher import (  # noqa: F401
+    ContinuousBatcher,
+    QueueFullError,
+    RequestTimeout,
+    ServingFuture,
+    ShutdownError,
+)
+from .compile_cache import CompileCache  # noqa: F401
+from .engine import ServingEngine  # noqa: F401
+from .server import ModelServer  # noqa: F401
+
+__all__ = [
+    "ServingEngine",
+    "ContinuousBatcher",
+    "CompileCache",
+    "ModelServer",
+    "ServingFuture",
+    "QueueFullError",
+    "RequestTimeout",
+    "ShutdownError",
+]

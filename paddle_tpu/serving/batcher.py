@@ -1,0 +1,310 @@
+"""Continuous dynamic batcher: a background thread that packs concurrent
+requests into engine buckets.
+
+Request-handling model: Clipper's adaptive-batching frontend crossed with
+Orca's continuous admission — the dispatcher does not wait for a full batch
+boundary; it admits whatever is queued the moment either (a) enough rows are
+waiting to fill the largest bucket, or (b) the oldest request has waited
+`max_batch_delay_ms`. Padding to the power-of-two bucket is the engine's
+job; the batcher's job is the time/row tradeoff and the failure modes:
+
+- **backpressure**: the queue is bounded in ROWS (not requests — a single
+  512-row request is 512 rows of device debt). A full queue fast-fails
+  submit() with QueueFullError, the HTTP front end's 503.
+- **per-request timeout**: a request that ages past `timeout_ms` before its
+  batch executes fails with RequestTimeout (HTTP 504) instead of occupying
+  a bucket slot.
+- **drain/shutdown**: close(drain=True) stops admission, lets the worker
+  finish the queue, and joins it; close(drain=False) fails queued requests
+  with ShutdownError.
+
+Telemetry (PR 4 registry, `serving/<model>/...`): queue_ms and latency_ms
+histograms split queue wait from the engine's device_ms, queue-depth and
+in-flight gauges, and a `requests` counter labelled by outcome
+(ok/rejected/timeout/error/shutdown).
+"""
+
+import threading
+import time
+
+import numpy as np
+
+__all__ = [
+    "ContinuousBatcher",
+    "ServingFuture",
+    "QueueFullError",
+    "RequestTimeout",
+    "ShutdownError",
+]
+
+
+class QueueFullError(RuntimeError):
+    """Bounded request queue is full — fast-fail admission (HTTP 503)."""
+
+
+class RequestTimeout(RuntimeError):
+    """Request aged past its deadline before a batch executed (HTTP 504)."""
+
+
+class ShutdownError(RuntimeError):
+    """Batcher was closed without draining this request."""
+
+
+class ServingFuture:
+    """One request's result slot. result() blocks the CALLER's thread; the
+    dispatcher thread only ever sets."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._outputs = None
+        self._error = None
+
+    def _set_result(self, outputs):
+        self._outputs = outputs
+        self._done.set()
+
+    def _set_error(self, err):
+        self._error = err
+        self._done.set()
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise RequestTimeout("no result within %ss" % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._outputs
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "future", "t_submit")
+
+    def __init__(self, feed, rows):
+        self.feed = feed
+        self.rows = rows
+        self.future = ServingFuture()
+        self.t_submit = time.perf_counter()
+
+
+class ContinuousBatcher:
+    def __init__(self, engine, max_queue_rows=256, max_batch_delay_ms=5.0,
+                 timeout_ms=2000.0):
+        self.engine = engine
+        self.max_queue_rows = int(max_queue_rows)
+        self.max_batch_delay = float(max_batch_delay_ms) / 1e3
+        self.timeout = float(timeout_ms) / 1e3
+        self._cond = threading.Condition()
+        self._queue = []  # FIFO of _Request
+        self._queued_rows = 0
+        self._alive = True
+        self._draining = False
+
+        from ..observability import registry as _registry
+
+        reg = _registry.default_registry()
+        p = "serving/%s" % engine.name
+        self._m_queue_ms = reg.histogram(
+            p + "/queue_ms", "request wait in the batcher queue"
+        )
+        self._m_latency_ms = reg.histogram(
+            p + "/latency_ms", "request submit->result latency"
+        )
+        self._m_depth = reg.gauge(p + "/queue_rows", "rows waiting in queue")
+        self._m_inflight = reg.gauge(
+            p + "/inflight_rows", "rows in the engine call in progress"
+        )
+        self._m_requests = reg.counter(
+            p + "/requests", "requests by outcome label"
+        )
+        self._batches_dispatched = 0
+
+        self._worker = threading.Thread(
+            target=self._loop, name="batcher-%s" % engine.name, daemon=True
+        )
+        self._worker.start()
+
+    # ---- client side ------------------------------------------------------
+    def submit(self, feed):
+        """Enqueue one request (dict name->array or list zipped with the
+        engine's feed_names); returns a ServingFuture. Raises QueueFullError
+        when admission would exceed max_queue_rows, ShutdownError after
+        close()."""
+        if isinstance(feed, (list, tuple)):
+            feed = dict(zip(self.engine.feed_names, feed))
+        feed = {k: np.asarray(v) for k, v in feed.items()}
+        missing = [n for n in self.engine.feed_names if n not in feed]
+        if missing:
+            raise ValueError("missing feeds: %s" % missing)
+        unknown = sorted(set(feed) - set(self.engine.feed_names))
+        if unknown:
+            raise ValueError(
+                "unknown feeds: %s (model takes %s)"
+                % (unknown, self.engine.feed_names)
+            )
+        rows = {np.shape(a)[0] if np.ndim(a) else 1 for a in feed.values()}
+        if len(rows) != 1:
+            raise ValueError(
+                "feeds disagree on batch rows: %s"
+                % {n: np.shape(a) for n, a in feed.items()}
+            )
+        n = rows.pop()
+        if n < 1:
+            raise ValueError("empty batch")
+        if n > self.engine.max_batch:
+            raise ValueError(
+                "request rows %d exceed the largest bucket %d; split the "
+                "request" % (n, self.engine.max_batch)
+            )
+        req = _Request(feed, n)
+        with self._cond:
+            if not self._alive or self._draining:
+                self._m_requests.inc(outcome="shutdown")
+                raise ShutdownError("batcher is shut down")
+            if self._queued_rows + n > self.max_queue_rows:
+                self._m_requests.inc(outcome="rejected")
+                raise QueueFullError(
+                    "queue full (%d rows queued, limit %d)"
+                    % (self._queued_rows, self.max_queue_rows)
+                )
+            self._queue.append(req)
+            self._queued_rows += n
+            self._m_depth.set(self._queued_rows)
+            self._cond.notify_all()
+        return req.future
+
+    def run(self, feed, timeout=None):
+        """Synchronous convenience: submit + result."""
+        return self.submit(feed).result(
+            self.timeout * 2 if timeout is None else timeout
+        )
+
+    # ---- dispatcher -------------------------------------------------------
+    def _admit_locked(self):
+        """Pop the next batch: FIFO requests up to the largest bucket's rows
+        (requests are never split — each fits a bucket by submit's check)."""
+        batch = []
+        rows = 0
+        while self._queue:
+            nxt = self._queue[0]
+            if batch and rows + nxt.rows > self.engine.max_batch:
+                break
+            batch.append(self._queue.pop(0))
+            rows += nxt.rows
+        self._queued_rows -= rows
+        self._m_depth.set(self._queued_rows)
+        return batch, rows
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while self._alive and not self._queue:
+                    self._cond.wait(0.05)
+                if not self._queue:
+                    if not self._alive:
+                        return
+                    continue
+                # continuous admission: dispatch when the waiting rows can
+                # fill the largest bucket OR the oldest request's batch-delay
+                # deadline passes — never both idle and holding work
+                deadline = self._queue[0].t_submit + self.max_batch_delay
+                while (
+                    self._alive
+                    and self._queued_rows < self.engine.max_batch
+                    and time.perf_counter() < deadline
+                ):
+                    self._cond.wait(
+                        max(deadline - time.perf_counter(), 0.001)
+                    )
+                batch, rows = self._admit_locked()
+            if batch:
+                self._dispatch(batch, rows)
+
+    def _dispatch(self, batch, rows):
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if now - req.t_submit > self.timeout:
+                self._m_requests.inc(outcome="timeout")
+                req.future._set_error(
+                    RequestTimeout(
+                        "queued %.0f ms > timeout %.0f ms"
+                        % ((now - req.t_submit) * 1e3, self.timeout * 1e3)
+                    )
+                )
+            else:
+                live.append(req)
+        if not live:
+            return
+        for req in live:
+            self._m_queue_ms.observe((now - req.t_submit) * 1e3)
+        packed = {
+            n: np.concatenate([np.asarray(r.feed[n]) for r in live])
+            if any(np.ndim(r.feed[n]) for r in live)
+            else np.asarray([r.feed[n] for r in live])
+            for n in self.engine.feed_names
+        }
+        self._m_inflight.set(sum(r.rows for r in live))
+        self._batches_dispatched += 1
+        try:
+            outs = self.engine.run(packed)
+        except Exception as e:
+            for req in live:
+                self._m_requests.inc(outcome="error")
+                req.future._set_error(e)
+            return
+        finally:
+            self._m_inflight.set(0)
+        done = time.perf_counter()
+        if self._batches_dispatched % 32 == 0:
+            # periodic telemetry snapshot (flag-gated inside stepstats):
+            # serving has no training step to ride, so the batcher is the
+            # interval clock that lands serving/* metrics in the JSONL
+            # shards tools/monitor.py reads
+            try:
+                from ..observability import stepstats as _stepstats
+
+                if _stepstats.active():
+                    _stepstats.collector().flush()
+            except Exception:
+                pass
+        lo = 0
+        total = sum(r.rows for r in live)
+        for req in live:
+            part = [
+                o[lo:lo + req.rows]
+                if np.ndim(o) and np.shape(o)[0] == total
+                else o
+                for o in outs
+            ]
+            lo += req.rows
+            self._m_latency_ms.observe((done - req.t_submit) * 1e3)
+            self._m_requests.inc(outcome="ok")
+            req.future._set_result(part)
+
+    # ---- lifecycle --------------------------------------------------------
+    def close(self, drain=True, timeout=30.0):
+        """Stop admission; with drain, the worker finishes the queue before
+        exiting, else queued requests fail with ShutdownError."""
+        with self._cond:
+            self._draining = True
+            if not drain:
+                for req in self._queue:
+                    self._m_requests.inc(outcome="shutdown")
+                    req.future._set_error(ShutdownError("batcher closed"))
+                self._queued_rows = 0
+                self._queue = []
+                self._m_depth.set(0)
+            self._alive = False
+            self._cond.notify_all()
+        self._worker.join(timeout)
+        return not self._worker.is_alive()
+
+    def stats(self):
+        with self._cond:
+            return {
+                "queued_rows": self._queued_rows,
+                "batches_dispatched": self._batches_dispatched,
+                "alive": self._alive,
+            }

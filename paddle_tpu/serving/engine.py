@@ -1,0 +1,340 @@
+"""AOT serving engine: one model, a bounded set of shape-bucketed compiled
+variants, no hot-path recompiles.
+
+The training executors compile per exact feed shape and donate state — both
+wrong for serving: request batch sizes vary per call (an unbounded compile
+set), and a replica's parameters must survive every call. The engine
+instead:
+
+- loads a `save_inference_model` directory into a private Scope and lowers
+  it ONCE through executor.aot_serve_lowering (donation-free, params as
+  arguments);
+- pads every request to a small set of power-of-two buckets — batch dim
+  always, declared-dynamic (-1) trailing dims (sequence lengths) too — so
+  the number of compiled variants is bounded by the bucket grid, never by
+  traffic;
+- builds each variant through serving.compile_cache: a warm replica
+  deserializes `jax.export` artifacts and replays XLA executables from disk
+  instead of tracing (cold-start-from-cache, the SERVING bench's 5× bar);
+- pads with zeros and slices outputs back to the request's true rows, so
+  callers never see the bucket.
+
+Thread-safety: variant construction is locked; the compiled calls themselves
+are jax jitted functions, safe to invoke from any thread (the batcher
+serializes device work anyway). Telemetry (device-time histogram, batch-fill
+histogram, padded-rows counter, trace counter) rides the PR 4 registry under
+`serving/<model>/...`.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from .. import io as _io
+from ..executor import Executor, Scope, aot_serve_lowering, scope_guard
+from . import compile_cache as _cc
+
+__all__ = ["ServingEngine", "DEFAULT_BATCH_BUCKETS"]
+
+DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+# batch-fill ratio buckets: 0..1 in tenths
+_FILL_BUCKETS = tuple(i / 10.0 for i in range(1, 11))
+
+
+def _next_pow2(n):
+    n = max(int(n), 1)
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class ServingEngine:
+    """Shape-bucketed, donation-free forward executor for one saved model."""
+
+    def __init__(self, model_dir, name=None, place=None, params_filename=None,
+                 batch_buckets=None, cache_dir=None):
+        import jax
+
+        self.name = name or model_dir.rstrip("/").rsplit("/", 1)[-1]
+        self.scope = Scope()
+        exe = Executor(place)
+        with scope_guard(self.scope):
+            program, feed_names, fetch_vars = _io.load_inference_model(
+                model_dir, exe, params_filename=params_filename
+            )
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = [v.name for v in fetch_vars]
+        self.fingerprint = _io.inference_model_fingerprint(model_dir)
+
+        block = program.global_block()
+        self._var_shapes = {}
+        self._feed_dtypes = {}
+        for n in self.feed_names:
+            v = block.vars.get(n)
+            if v is None:
+                continue
+            self._var_shapes[n] = (
+                tuple(v.shape) if v.shape is not None else None
+            )
+            if v.dtype is not None:
+                self._feed_dtypes[n] = v.dtype
+
+        with scope_guard(self.scope):
+            self._serve, self._ro, self._mut = aot_serve_lowering(
+                program, self.feed_names, self.fetch_names, self.scope
+            )
+
+        buckets = batch_buckets or DEFAULT_BATCH_BUCKETS
+        self.batch_buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.batch_buckets or self.batch_buckets[0] < 1:
+            raise ValueError("batch_buckets must be positive: %r" % (buckets,))
+        self.max_batch = self.batch_buckets[-1]
+
+        if cache_dir is None:
+            from .. import flags as _flags
+
+            cache_dir = _flags.get_flags("serving_cache_dir")["serving_cache_dir"]
+        self.cache = _cc.CompileCache(cache_dir) if cache_dir else None
+
+        self._variants = {}
+        self._build_lock = threading.Lock()
+        self.traces = 0  # variants traced+compiled (not served from cache)
+        self.cache_hits = 0  # variants deserialized from the compile cache
+
+        from ..observability import registry as _registry
+
+        reg = _registry.default_registry()
+        p = "serving/%s" % self.name
+        self._m_device_ms = reg.histogram(
+            p + "/device_ms", "per-engine-call device time (padded bucket)"
+        )
+        self._m_fill = reg.histogram(
+            p + "/batch_fill", "real rows / bucket rows per engine call",
+            buckets=_FILL_BUCKETS,
+        )
+        self._m_rows = reg.counter(p + "/rows", "real request rows executed")
+        self._m_padded = reg.counter(
+            p + "/padded_rows", "padding-waste rows added to fill buckets"
+        )
+        self._m_traces = reg.counter(
+            p + "/traces", "serving variants traced (compile-cache misses)"
+        )
+        self._m_variants = reg.gauge(
+            p + "/variants", "compiled serving variants resident"
+        )
+
+    # ---- bucketing --------------------------------------------------------
+    def bucket_batch(self, n):
+        """Smallest configured bucket >= n (n > max_batch is chunked by
+        run())."""
+        for b in self.batch_buckets:
+            if n <= b:
+                return b
+        return self.max_batch
+
+    def _bucket_shape(self, name, shape):
+        """Padded shape for one feed: batch dim -> bucket; trailing dims the
+        program declares dynamic (-1) -> next power of two (sequence
+        buckets); concrete trailing dims pass through."""
+        declared = self._var_shapes.get(name)
+        out = [self.bucket_batch(shape[0])]
+        for i, d in enumerate(shape[1:], start=1):
+            dd = (
+                declared[i]
+                if declared is not None and len(declared) == len(shape)
+                else None
+            )
+            out.append(_next_pow2(d) if dd in (-1, None) else int(d))
+        return tuple(out)
+
+    def _feed_dtype(self, name):
+        dt = self._feed_dtypes.get(name, "float32")
+        if dt == "bfloat16":
+            import jax.numpy as jnp
+
+            return jnp.bfloat16
+        return np.dtype(dt)
+
+    # ---- variants ---------------------------------------------------------
+    def _variant(self, avals):
+        """Compiled callable for one padded-shape signature, building through
+        the persistent cache on first sight. `avals` is {feed name:
+        jax.ShapeDtypeStruct}."""
+        import jax
+        from jax import export as jax_export
+
+        vkey = tuple(
+            sorted((n, s.shape, str(s.dtype)) for n, s in avals.items())
+        )
+        fn = self._variants.get(vkey)
+        if fn is not None:
+            return fn
+        with self._build_lock:
+            fn = self._variants.get(vkey)
+            if fn is not None:
+                return fn
+
+            def build():
+                self.traces += 1
+                self._m_traces.inc()
+                return jax_export.export(jax.jit(self._serve))(
+                    avals, self._ro, self._mut
+                )
+
+            if self.cache is not None:
+                ck = _cc.variant_key(
+                    self.fingerprint,
+                    {n: (s.shape, s.dtype) for n, s in avals.items()},
+                    self.fetch_names,
+                )
+                exported, hit = self.cache.get_or_build(
+                    ck, build,
+                    meta={
+                        "model": self.name,
+                        "feeds": {
+                            n: [list(s.shape), str(s.dtype)]
+                            for n, s in avals.items()
+                        },
+                        "fetches": self.fetch_names,
+                    },
+                )
+                if hit:
+                    self.cache_hits += 1
+            else:
+                exported = build()
+
+            # AOT-compile the wrapper for this exact signature: the variant
+            # is a jax Compiled object, so warmup pays the full
+            # StableHLO->executable step up front (a disk hit when the xla/
+            # persistent cache is warm) and the hot path can never retrace
+            fn = jax.jit(
+                lambda feeds, ro, mut, _call=exported.call: _call(feeds, ro, mut)
+            ).lower(avals, self._ro, self._mut).compile()
+            self._variants[vkey] = fn
+            self._m_variants.set(len(self._variants))
+            return fn
+
+    def warmup(self, example_feed=None):
+        """Precompile every batch bucket so the hot path never traces.
+
+        Builds (does not execute) each bucket's variant — compilation is what
+        the hot path must never re-pay; running zeros through the model would
+        only add device time. Trailing dims come from the program's declared
+        var shapes; models with dynamic (-1) trailing dims need
+        `example_feed` (one array per feed name) to pin them. Returns the
+        number of variants built."""
+        import jax
+
+        shapes = {}
+        for n in self.feed_names:
+            if example_feed is not None and n in example_feed:
+                shapes[n] = tuple(np.asarray(example_feed[n]).shape[1:])
+                continue
+            declared = self._var_shapes.get(n)
+            if declared is None or any(d in (-1, None) for d in declared[1:]):
+                raise ValueError(
+                    "feed %r has dynamic non-batch dims %r: warmup needs an "
+                    "example_feed to pin them" % (n, declared)
+                )
+            shapes[n] = tuple(int(d) for d in declared[1:])
+        for b in self.batch_buckets:
+            avals = {
+                n: jax.ShapeDtypeStruct(
+                    self._bucket_shape(n, (b,) + shapes[n]),
+                    self._feed_dtype(n),
+                )
+                for n in self.feed_names
+            }
+            self._variant(avals)
+        return len(self._variants)
+
+    # ---- serving ----------------------------------------------------------
+    def run(self, feed):
+        """Serve one feed dict (or list zipped with feed_names): pad to the
+        bucket, execute the compiled variant, slice outputs back to the true
+        row count. Returns numpy arrays for the model's fetch targets."""
+        if isinstance(feed, (list, tuple)):
+            feed = dict(zip(self.feed_names, feed))
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise ValueError("missing feeds: %s" % missing)
+        unknown = sorted(set(feed) - set(self.feed_names))
+        if unknown:
+            raise ValueError(
+                "unknown feeds: %s (model takes %s)" % (unknown, self.feed_names)
+            )
+        arrays = {n: np.asarray(feed[n]) for n in self.feed_names}
+        rows = {np.shape(a)[0] if np.ndim(a) else 1 for a in arrays.values()}
+        if len(rows) != 1:
+            raise ValueError(
+                "feeds disagree on batch rows: %s"
+                % {n: np.shape(a) for n, a in arrays.items()}
+            )
+        n = rows.pop()
+        if n == 0:
+            raise ValueError("empty batch")
+        if n > self.max_batch:
+            # oversize request: chunk through the largest bucket. Batch-major
+            # outputs concatenate; non-batch outputs (rare for inference —
+            # e.g. a scalar mean) keep the last chunk's value.
+            outs = None
+            for lo in range(0, n, self.max_batch):
+                part = self._run_bucket(
+                    {k: a[lo:lo + self.max_batch] for k, a in arrays.items()}
+                )
+                if outs is None:
+                    outs = [[o] for o in part]
+                else:
+                    for acc, o in zip(outs, part):
+                        acc.append(o)
+            return [
+                np.concatenate(acc) if np.ndim(acc[0]) else acc[-1]
+                for acc in outs
+            ]
+        return self._run_bucket(arrays)
+
+    def _run_bucket(self, arrays):
+        import jax
+
+        n = next(iter(arrays.values())).shape[0]
+        padded = {}
+        avals = {}
+        for name, a in arrays.items():
+            a = np.ascontiguousarray(a, dtype=self._feed_dtype(name))
+            shape = self._bucket_shape(name, a.shape)
+            if tuple(a.shape) != shape:
+                buf = np.zeros(shape, dtype=a.dtype)
+                buf[tuple(slice(0, d) for d in a.shape)] = a
+                a = buf
+            padded[name] = a
+            avals[name] = jax.ShapeDtypeStruct(shape, a.dtype)
+        bucket = next(iter(padded.values())).shape[0]
+
+        fn = self._variant(avals)
+        t0 = time.perf_counter()
+        outs = fn(padded, self._ro, self._mut)
+        outs = [np.asarray(o) for o in outs]
+        self._m_device_ms.observe((time.perf_counter() - t0) * 1e3)
+        self._m_rows.inc(n)
+        self._m_padded.inc(bucket - n)
+        self._m_fill.observe(n / float(bucket))
+        # slice only batch-major outputs back to the true rows; outputs that
+        # don't carry the padded batch dim (scalar stats) pass through
+        return [
+            o[:n] if np.ndim(o) and o.shape[0] == bucket else o for o in outs
+        ]
+
+    def stats(self):
+        """Variant/compile accounting for benches and smoke tests."""
+        out = {
+            "variants": len(self._variants),
+            "traces": self.traces,
+            "cache_hits": self.cache_hits,
+        }
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        return out

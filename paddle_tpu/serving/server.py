@@ -1,0 +1,265 @@
+"""Multi-model HTTP serving front end (stdlib http.server, no deps).
+
+One process hosts many models, each an (engine, batcher) pair; request
+threads (ThreadingHTTPServer, one per connection) block on the batcher's
+future while the dispatcher packs buckets — the Clipper frontend shape on
+the reference's server-demo role (paddle/fluid/inference demos served one
+Run() per request; here requests from all connections share device batches).
+
+Routes:
+- ``POST /v1/models/<name>:predict`` — body either JSON
+  ``{"inputs": {feed: nested list, ...}}`` or a raw ``.npz`` payload
+  (Content-Type ``application/x-npz``; one array per feed name). JSON
+  replies as ``{"outputs": {fetch: nested list}, "latency_ms": float}``;
+  npz requests reply as npz bytes.
+- ``GET /healthz`` — 200 once every model's engine is constructed; body
+  lists models and variant counts.
+- ``GET /v1/models`` — model metadata (feeds, fetches, buckets, stats).
+- ``GET /metrics`` — the PR 4 registry's Prometheus text exposition (same
+  content observability/export.py writes to the scrape file).
+
+Failure mapping: unknown model -> 404, malformed body -> 400, queue full
+(backpressure) -> 503 with Retry-After, request timeout -> 504.
+"""
+
+import io as _stdio
+import json
+import threading
+import time
+
+import numpy as np
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .batcher import ContinuousBatcher, QueueFullError, RequestTimeout
+from .engine import ServingEngine
+
+__all__ = ["ModelServer"]
+
+PREDICT_PREFIX = "/v1/models/"
+
+
+class _Hosted:
+    __slots__ = ("engine", "batcher")
+
+    def __init__(self, engine, batcher):
+        self.engine = engine
+        self.batcher = batcher
+
+
+class ModelServer:
+    """Host N models behind one threaded HTTP listener."""
+
+    def __init__(self, host="127.0.0.1", port=0, request_timeout_ms=5000.0):
+        self.host = host
+        self._port = port
+        self.request_timeout = float(request_timeout_ms) / 1e3
+        self._models = {}
+        self._httpd = None
+        self._thread = None
+        from ..observability import registry as _registry
+
+        self._registry = _registry.default_registry()
+        self._m_http = self._registry.counter(
+            "serving/http/requests", "HTTP requests by code label"
+        )
+
+    # ---- model hosting ----------------------------------------------------
+    def add_model(self, name, model_dir=None, engine=None, warmup=True,
+                  warmup_feed=None, batcher_opts=None, **engine_opts):
+        """Register a model. Either pass a prebuilt `engine` or a
+        `model_dir` (plus ServingEngine kwargs). Warmup precompiles every
+        bucket before the model is visible, so the serving hot path never
+        traces."""
+        if engine is None:
+            if model_dir is None:
+                raise ValueError("add_model needs model_dir or engine")
+            engine = ServingEngine(model_dir, name=name, **engine_opts)
+        if warmup:
+            engine.warmup(example_feed=warmup_feed)
+        batcher = ContinuousBatcher(engine, **(batcher_opts or {}))
+        self._models[name] = _Hosted(engine, batcher)
+        return engine
+
+    def models(self):
+        return sorted(self._models)
+
+    # ---- lifecycle --------------------------------------------------------
+    def start(self):
+        """Bind + serve on a daemon thread; returns the bound port (useful
+        with port=0)."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # one handler class per ModelServer instance: the closure is the
+            # routing table
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _reply(self, code, body, content_type="application/json"):
+                server._m_http.inc(code=str(code))
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                if code == 503:
+                    self.send_header("Retry-After", "1")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, code, obj):
+                self._reply(code, json.dumps(obj).encode())
+
+            def do_GET(self):
+                try:
+                    if self.path == "/healthz":
+                        self._reply_json(200, server._healthz())
+                    elif self.path == "/v1/models":
+                        self._reply_json(200, server._describe())
+                    elif self.path == "/metrics":
+                        self._reply(
+                            200,
+                            server._registry.to_prometheus().encode(),
+                            content_type="text/plain; version=0.0.4",
+                        )
+                    else:
+                        self._reply_json(404, {"error": "no route %s" % self.path})
+                except Exception as e:  # handler thread must answer, not die
+                    self._reply_json(500, {"error": repr(e)})
+
+            def do_POST(self):
+                try:
+                    code, body, ctype = server._predict(
+                        self.path,
+                        self.headers.get("Content-Type", ""),
+                        self.rfile.read(
+                            int(self.headers.get("Content-Length", 0))
+                        ),
+                    )
+                    self._reply(code, body, content_type=ctype)
+                except Exception as e:
+                    self._reply_json(500, {"error": repr(e)})
+
+        self._httpd = ThreadingHTTPServer((self.host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="model-server", daemon=True
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    @property
+    def url(self):
+        return "http://%s:%d" % (self.host, self.port)
+
+    def stop(self, drain=True):
+        """Shut the listener, then drain (or fail out) every batcher."""
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(10.0)
+            self._httpd = None
+        ok = True
+        for hosted in self._models.values():
+            ok = hosted.batcher.close(drain=drain) and ok
+        return ok
+
+    # ---- request handling (thread-safe, called from handler threads) ------
+    def _healthz(self):
+        return {
+            "status": "ok",
+            "models": {
+                name: {"variants": h.engine.stats()["variants"]}
+                for name, h in self._models.items()
+            },
+        }
+
+    def _describe(self):
+        return {
+            name: {
+                "feeds": h.engine.feed_names,
+                "fetches": h.engine.fetch_names,
+                "batch_buckets": list(h.engine.batch_buckets),
+                "stats": h.engine.stats(),
+                "batcher": h.batcher.stats(),
+            }
+            for name, h in self._models.items()
+        }
+
+    def _predict(self, path, content_type, body):
+        """(status, reply bytes, content type) for one predict POST."""
+        if not (path.startswith(PREDICT_PREFIX) and path.endswith(":predict")):
+            return 404, json.dumps({"error": "no route %s" % path}).encode(), \
+                "application/json"
+        name = path[len(PREDICT_PREFIX):-len(":predict")]
+        hosted = self._models.get(name)
+        if hosted is None:
+            return 404, json.dumps(
+                {"error": "unknown model %r (have %s)" % (name, self.models())}
+            ).encode(), "application/json"
+
+        as_npz = "npz" in content_type or content_type == "application/octet-stream"
+        try:
+            if as_npz:
+                data = np.load(_stdio.BytesIO(body), allow_pickle=False)
+                feed = {k: data[k] for k in data.files}
+            else:
+                doc = json.loads(body.decode() or "{}")
+                inputs = doc.get("inputs")
+                if not isinstance(inputs, dict):
+                    raise ValueError('body needs {"inputs": {feed: array}}')
+                feed = {
+                    k: np.asarray(v, dtype=hosted.engine._feed_dtype(k))
+                    if k in hosted.engine._feed_dtypes
+                    else np.asarray(v)
+                    for k, v in inputs.items()
+                }
+        except Exception as e:
+            return 400, json.dumps({"error": "bad payload: %r" % e}).encode(), \
+                "application/json"
+
+        t0 = time.perf_counter()
+        try:
+            future = hosted.batcher.submit(feed)
+        except QueueFullError as e:
+            return 503, json.dumps({"error": str(e)}).encode(), \
+                "application/json"
+        except ValueError as e:
+            return 400, json.dumps({"error": str(e)}).encode(), \
+                "application/json"
+        try:
+            outs = future.result(self.request_timeout)
+        except RequestTimeout as e:
+            return 504, json.dumps({"error": str(e)}).encode(), \
+                "application/json"
+        except Exception as e:
+            return 500, json.dumps({"error": repr(e)}).encode(), \
+                "application/json"
+        latency_ms = (time.perf_counter() - t0) * 1e3
+
+        if as_npz:
+            buf = _stdio.BytesIO()
+            np.savez(
+                buf,
+                **{
+                    n: np.asarray(o, dtype=np.float32)
+                    if "bfloat16" in str(np.asarray(o).dtype)
+                    else np.asarray(o)
+                    for n, o in zip(hosted.engine.fetch_names, outs)
+                },
+            )
+            return 200, buf.getvalue(), "application/x-npz"
+        return 200, json.dumps(
+            {
+                "outputs": {
+                    n: np.asarray(o, dtype=np.float64).tolist()
+                    if "bfloat16" in str(np.asarray(o).dtype)
+                    else np.asarray(o).tolist()
+                    for n, o in zip(hosted.engine.fetch_names, outs)
+                },
+                "latency_ms": latency_ms,
+            }
+        ).encode(), "application/json"

@@ -1,0 +1,316 @@
+"""Serving runtime tier (paddle_tpu/serving/, docs/serving.md): engine /
+Predictor / Executor output parity, bucketing + padding invisibility,
+persistent compile-cache second-boot hits, continuous-batcher semantics
+(backpressure, timeout, drain), the multi-model HTTP front end, and the two
+inference.py regressions (export_compiled return path, unknown-feed
+rejection)."""
+
+import io as stdio
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework, inference
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.serving import (
+    ContinuousBatcher,
+    ModelServer,
+    QueueFullError,
+    RequestTimeout,
+    ServingEngine,
+)
+
+
+def _save_mlp(tmp_path, name="m", width=6, out_dim=3, seed=3, prefix="srv"):
+    """Build + save a small softmax MLP; returns (model_dir, main, scope) so
+    tests can also run the raw Executor path for three-way parity."""
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(
+                name="%s_x" % prefix, shape=[width], dtype="float32"
+            )
+            h = fluid.layers.fc(input=x, size=8, act="relu")
+            y = fluid.layers.fc(input=h, size=out_dim, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    model_dir = str(tmp_path / name)
+    scope = Scope(seed=seed)
+    with scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            model_dir, ["%s_x" % prefix], [y], exe, main_program=main
+        )
+    return model_dir, main, scope, "%s_x" % prefix, y.name
+
+
+def test_export_compiled_returns_written_path(tmp_path):
+    """Regression: np.savez appends .npz when out_path lacks it — the
+    returned path must be the file that exists, both ways."""
+    import os
+
+    model_dir, _, _, xname, _ = _save_mlp(tmp_path, prefix="ep")
+    feed = {xname: np.random.RandomState(0).rand(2, 6).astype("float32")}
+
+    bare = inference.export_compiled(model_dir, feed, str(tmp_path / "art"))
+    assert bare.endswith(".npz") and os.path.exists(bare)
+    suffixed = inference.export_compiled(
+        model_dir, feed, str(tmp_path / "art2.npz")
+    )
+    assert suffixed == str(tmp_path / "art2.npz") and os.path.exists(suffixed)
+    # both round-trip through load_compiled
+    (o1,) = inference.load_compiled(bare).run(feed)
+    (o2,) = inference.load_compiled(suffixed).run(feed)
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+
+def test_predictor_rejects_unknown_feeds(tmp_path):
+    """Typo'd feed names must raise like missing ones do, not be silently
+    dropped."""
+    model_dir, _, _, xname, _ = _save_mlp(tmp_path, prefix="uf")
+    pred = inference.Predictor(model_dir)
+    ok = {xname: np.zeros((1, 6), np.float32)}
+    pred.run(ok)  # sanity
+    with pytest.raises(ValueError, match="missing feeds"):
+        pred.run({})
+    with pytest.raises(ValueError, match="unknown feeds.*oops"):
+        pred.run(dict(ok, oops=np.zeros(3)))
+
+
+def test_engine_parity_three_way(tmp_path):
+    """Predictor vs ServingEngine vs raw Executor.run agree, including a
+    batch size that forces padding (3 rows -> bucket 4)."""
+    model_dir, main, scope, xname, yname = _save_mlp(tmp_path, prefix="p3")
+    feed = {xname: np.random.RandomState(1).rand(3, 6).astype("float32")}
+
+    with scope_guard(scope):
+        (ref,) = fluid.Executor().run(main, feed=feed, fetch_list=[yname])
+    (pred_out,) = inference.Predictor(model_dir).run(feed)
+    eng = ServingEngine(model_dir, name="p3", batch_buckets=(1, 2, 4))
+    (eng_out,) = eng.run(feed)
+
+    assert eng_out.shape == (3, 3)  # bucket padding sliced away
+    np.testing.assert_allclose(pred_out, np.asarray(ref), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(eng_out, np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_engine_parity_bf16_params(tmp_path):
+    """A model whose params were stored through the _bf16_safe_save path
+    (bf16 value -> f32 payload + dtype sidecar) loads as bf16 in BOTH the
+    Predictor and the engine and serves identical outputs."""
+    import jax.numpy as jnp
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="bf_x", shape=[6], dtype="float32")
+            y = fluid.layers.fc(input=x, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    model_dir = str(tmp_path / "bf16")
+    scope = Scope(seed=7)
+    with scope_guard(scope):
+        exe.run(startup)
+        # quantize every param to bf16 IN SCOPE, then save: save_vars routes
+        # through _bf16_safe_save and records the dtype sidecars
+        for n, v in list(scope.vars.items()):
+            if np.asarray(v).dtype == np.float32 and np.ndim(v):
+                scope.set_var(n, jnp.asarray(v, jnp.bfloat16))
+        fluid.io.save_inference_model(
+            model_dir, ["bf_x"], [y], exe, main_program=main
+        )
+
+    pred = inference.Predictor(model_dir)
+    assert any(
+        "bfloat16" in str(np.asarray(v).dtype)
+        for v in pred.scope.vars.values()
+    ), "params did not restore as bf16"
+    feed = {"bf_x": np.random.RandomState(2).rand(4, 6).astype("float32")}
+    (pred_out,) = pred.run(feed)
+    eng = ServingEngine(model_dir, name="bf16", batch_buckets=(4,))
+    (eng_out,) = eng.run(feed)
+    np.testing.assert_allclose(eng_out, pred_out, rtol=1e-2, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(eng_out, np.float32).sum(axis=1),
+                               1.0, rtol=1e-2)
+
+
+def test_compile_cache_hit_on_second_boot(tmp_path):
+    """First boot traces every bucket and writes artifacts; a second engine
+    on the same cache dir deserializes all of them (zero traces) and still
+    serves parity."""
+    model_dir, _, _, xname, _ = _save_mlp(tmp_path, prefix="cc")
+    cache_dir = str(tmp_path / "cache")
+    feed = {xname: np.random.RandomState(3).rand(2, 6).astype("float32")}
+
+    eng1 = ServingEngine(
+        model_dir, name="cc1", batch_buckets=(1, 2), cache_dir=cache_dir
+    )
+    eng1.warmup()
+    assert eng1.traces == 2 and eng1.cache_hits == 0
+    (out1,) = eng1.run(feed)
+
+    eng2 = ServingEngine(
+        model_dir, name="cc2", batch_buckets=(1, 2), cache_dir=cache_dir
+    )
+    eng2.warmup()
+    assert eng2.traces == 0, "second boot must not trace"
+    assert eng2.cache_hits == 2
+    (out2,) = eng2.run(feed)
+    np.testing.assert_allclose(out2, out1, rtol=1e-6)
+
+
+def test_engine_bucketing_and_oversize_chunking(tmp_path):
+    """bucket_batch picks the smallest fitting bucket; requests larger than
+    the top bucket chunk through it and concatenate transparently."""
+    model_dir, _, _, xname, _ = _save_mlp(tmp_path, prefix="bk")
+    eng = ServingEngine(model_dir, name="bk", batch_buckets=(1, 2, 4))
+    assert [eng.bucket_batch(n) for n in (1, 2, 3, 4)] == [1, 2, 4, 4]
+
+    feed = {xname: np.random.RandomState(4).rand(10, 6).astype("float32")}
+    (out,) = eng.run(feed)  # 10 rows through a max bucket of 4
+    assert out.shape == (10, 3)
+    (ref,) = inference.Predictor(model_dir).run(feed)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # the variant set is bounded by the bucket grid
+    assert eng.stats()["variants"] <= len(eng.batch_buckets)
+
+
+def test_batcher_backpressure_timeout_and_drain(tmp_path):
+    model_dir, _, _, xname, _ = _save_mlp(tmp_path, prefix="bt")
+    eng = ServingEngine(model_dir, name="bt", batch_buckets=(1, 2, 4))
+    eng.warmup()
+
+    # unknown / mismatched feeds fail at submit, not in the dispatcher
+    b = ContinuousBatcher(eng, max_queue_rows=4, max_batch_delay_ms=1.0)
+    with pytest.raises(ValueError, match="unknown feeds"):
+        b.submit({xname: np.zeros((1, 6), np.float32), "oops": np.zeros(3)})
+    with pytest.raises(ValueError, match="exceed the largest bucket"):
+        b.submit({xname: np.zeros((5, 6), np.float32)})
+
+    b.close()
+
+    # backpressure: the dispatcher waits out max_batch_delay for fill, so a
+    # queue bounded at 2 rows rejects the third row deterministically
+    slow = ContinuousBatcher(eng, max_queue_rows=2, max_batch_delay_ms=500.0)
+    f1 = slow.submit({xname: np.zeros((2, 6), np.float32)})  # fills the queue
+    with pytest.raises(QueueFullError):
+        slow.submit({xname: np.zeros((1, 6), np.float32)})
+    f1.result(5.0)
+    slow.close()
+
+    # per-request timeout: a dispatcher facing an empty engine queue applies
+    # the deadline at dispatch time
+    t = ContinuousBatcher(
+        eng, max_queue_rows=64, max_batch_delay_ms=80.0, timeout_ms=1.0
+    )
+    fut = t.submit({xname: np.zeros((1, 6), np.float32)})
+    with pytest.raises(RequestTimeout):
+        fut.result(5.0)  # aged past 1 ms while the batcher waited for fill
+    t.close()
+
+    # drain: queued work is answered before the worker exits
+    d = ContinuousBatcher(eng, max_queue_rows=64, max_batch_delay_ms=50.0)
+    futs = [d.submit({xname: np.zeros((1, 6), np.float32)}) for _ in range(6)]
+    assert d.close(drain=True)
+    assert all(f.done() for f in futs)
+    assert all(f.result(0.1)[0].shape == (1, 3) for f in futs)
+
+
+def test_model_server_two_models_http(tmp_path):
+    """End-to-end HTTP: two models in one process, JSON and npz payloads,
+    404 on unknown model, live /metrics, clean drain on stop."""
+    d1, _, _, x1, _ = _save_mlp(tmp_path, name="m1", width=6, out_dim=3,
+                                prefix="s1")
+    d2, _, _, x2, _ = _save_mlp(tmp_path, name="m2", width=10, out_dim=4,
+                                prefix="s2")
+    srv = ModelServer(port=0)
+    srv.add_model("alpha", d1, batch_buckets=(1, 2, 4))
+    srv.add_model("beta", d2, batch_buckets=(1, 2, 4))
+    port = srv.start()
+    base = "http://127.0.0.1:%d" % port
+    try:
+        health = json.load(urllib.request.urlopen(base + "/healthz"))
+        assert health["status"] == "ok"
+        assert set(health["models"]) == {"alpha", "beta"}
+
+        # JSON predict against alpha
+        req = urllib.request.Request(
+            base + "/v1/models/alpha:predict",
+            data=json.dumps(
+                {"inputs": {x1: np.ones((2, 6)).tolist()}}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        doc = json.load(urllib.request.urlopen(req))
+        out = np.asarray(list(doc["outputs"].values())[0])
+        assert out.shape == (2, 3)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+        # npz predict against beta
+        buf = stdio.BytesIO()
+        np.savez(buf, **{x2: np.ones((3, 10), np.float32)})
+        req = urllib.request.Request(
+            base + "/v1/models/beta:predict",
+            data=buf.getvalue(),
+            headers={"Content-Type": "application/x-npz"},
+        )
+        got = np.load(stdio.BytesIO(urllib.request.urlopen(req).read()))
+        assert [got[k].shape for k in got.files] == [(3, 4)]
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    base + "/v1/models/nope:predict", data=b"{}",
+                    headers={"Content-Type": "application/json"},
+                )
+            )
+        assert e.value.code == 404
+
+        prom = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "serving_alpha_latency_ms" in prom.replace("/", "_")
+    finally:
+        assert srv.stop(drain=True)
+
+
+def test_server_concurrent_requests_no_hot_recompiles(tmp_path):
+    """Concurrent mixed-shape clients share device batches; after warmup the
+    engines never trace again (the zero-hot-path-recompiles invariant)."""
+    d1, _, _, xname, _ = _save_mlp(tmp_path, name="mc", prefix="mc")
+    srv = ModelServer(port=0)
+    eng = srv.add_model(
+        "gamma", d1, batch_buckets=(1, 2, 4),
+        batcher_opts={"max_batch_delay_ms": 2.0},
+    )
+    traces_after_warmup = eng.traces
+    port = srv.start()
+    base = "http://127.0.0.1:%d" % port
+    errors = []
+
+    def client(i):
+        try:
+            rows = 1 + (i % 3)
+            req = urllib.request.Request(
+                base + "/v1/models/gamma:predict",
+                data=json.dumps(
+                    {"inputs": {xname: np.ones((rows, 6)).tolist()}}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            doc = json.load(urllib.request.urlopen(req, timeout=30))
+            assert np.asarray(list(doc["outputs"].values())[0]).shape[0] == rows
+        except Exception as e:  # pragma: no cover - surfaced via errors list
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    try:
+        assert not errors, errors
+        assert eng.traces == traces_after_warmup, "hot path recompiled"
+    finally:
+        srv.stop(drain=True)

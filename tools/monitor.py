@@ -61,6 +61,84 @@ def _percentile(sorted_vals, q):
     return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
 
 
+def _hist_percentile(rec, q):
+    """Percentile estimate from a snapshot histogram record ({buckets,
+    counts, count, max}) — same linear-in-bucket interpolation the live
+    Histogram.percentile uses, reproduced here so the monitor stays
+    stdlib-only."""
+    count = rec.get("count") or 0
+    if not count:
+        return None
+    buckets = rec.get("buckets") or []
+    counts = rec.get("counts") or []
+    hmax = rec.get("max")
+    target = count * q / 100.0
+    cum = 0
+    lo = 0.0
+    for i, ub in enumerate(buckets):
+        prev = cum
+        cum += counts[i]
+        if cum >= target:
+            est = lo + (target - prev) / max(counts[i], 1) * (ub - lo)
+            return min(est, hmax) if hmax is not None else est
+        lo = ub
+    return hmax
+
+
+def _serving_summary(metrics):
+    """Per-model serving stats from a snapshot's metric dump: {model:
+    {p50/p99 latency, queue p50, device p50, fill, rows, padded, outcome
+    counts, traces, variants}} keyed off the serving/<model>/... namespace
+    (serving/compile_cache and serving/http are runtime-wide, not models)."""
+    models = {}
+    for name in metrics:
+        parts = name.split("/")
+        if len(parts) != 3 or parts[0] != "serving":
+            continue
+        if parts[1] in ("compile_cache", "http"):
+            continue
+        models.setdefault(parts[1], {})[parts[2]] = metrics[name]
+
+    def scalar(rec, label=""):
+        if not rec or "values" not in rec:
+            return None
+        vals = rec["values"]
+        if label:
+            return vals.get(label)
+        return vals.get("", sum(vals.values()) if vals else None)
+
+    out = {}
+    for model, m in sorted(models.items()):
+        lat = m.get("latency_ms") or {}
+        row = {
+            "p50_ms": _hist_percentile(lat, 50) if lat else None,
+            "p99_ms": _hist_percentile(lat, 99) if lat else None,
+            "queue_p50_ms": _hist_percentile(m.get("queue_ms") or {}, 50)
+            if m.get("queue_ms") else None,
+            "device_p50_ms": _hist_percentile(m.get("device_ms") or {}, 50)
+            if m.get("device_ms") else None,
+            "queue_rows": scalar(m.get("queue_rows")),
+            "inflight_rows": scalar(m.get("inflight_rows")),
+            "rows": scalar(m.get("rows")),
+            "padded_rows": scalar(m.get("padded_rows")),
+            "traces": scalar(m.get("traces")),
+            "variants": scalar(m.get("variants")),
+            "ok": scalar(m.get("requests"), "outcome=ok"),
+            "rejected": scalar(m.get("requests"), "outcome=rejected"),
+            "timeout": scalar(m.get("requests"), "outcome=timeout"),
+        }
+        fill = m.get("batch_fill") or {}
+        if fill.get("count"):
+            row["fill_mean"] = fill.get("sum", 0.0) / fill["count"]
+        out[model] = row
+
+    cc_hits = scalar(metrics.get("serving/compile_cache/hits"))
+    cc_miss = scalar(metrics.get("serving/compile_cache/misses"))
+    if out and (cc_hits is not None or cc_miss is not None):
+        out["_compile_cache"] = {"hits": cc_hits or 0, "misses": cc_miss or 0}
+    return out
+
+
 def summarize(records, window=200):
     """Aggregate the record stream into the monitor's display fields.
 
@@ -91,6 +169,7 @@ def summarize(records, window=200):
         "cache_misses": None,
         "health": {},
         "top_ops": [],
+        "serving": {},
     }
 
     if opprofs:
@@ -153,6 +232,7 @@ def summarize(records, window=200):
         if summary["bubble"] is None and bub:
             summary["bubble"] = bub.get("bubble")
             summary["bubble_analytic"] = bub.get("analytic")
+        summary["serving"] = _serving_summary(metrics)
         summary["health"] = dict(last.get("health", {}))
         memrec = last.get("mem", {})
         if memrec.get("mem_peak_bytes"):
@@ -203,6 +283,39 @@ def render(summary):
                 ),
             )
         )
+    serving = dict(summary.get("serving") or {})
+    cc = serving.pop("_compile_cache", None)
+    for model, s in sorted(serving.items()):
+        outcomes = "%s ok / %s rej / %s to" % (
+            _fmt(s.get("ok"), "{:.0f}", "0"),
+            _fmt(s.get("rejected"), "{:.0f}", "0"),
+            _fmt(s.get("timeout"), "{:.0f}", "0"),
+        )
+        rows.append((
+            "serve/" + model,
+            "p50 %s ms p99 %s ms (queue %s + device %s) | %s" % (
+                _fmt(s.get("p50_ms")),
+                _fmt(s.get("p99_ms")),
+                _fmt(s.get("queue_p50_ms")),
+                _fmt(s.get("device_p50_ms")),
+                outcomes,
+            ),
+        ))
+        rows.append((
+            "serve/%s fill" % model,
+            "%s mean fill, %s pad rows, depth %s, %s variants (%s traces)" % (
+                _fmt(s.get("fill_mean")),
+                _fmt(s.get("padded_rows"), "{:.0f}"),
+                _fmt(s.get("queue_rows"), "{:.0f}"),
+                _fmt(s.get("variants"), "{:.0f}"),
+                _fmt(s.get("traces"), "{:.0f}", "0"),
+            ),
+        ))
+    if cc:
+        rows.append((
+            "serve/compile cache",
+            "%d hit / %d miss" % (cc["hits"], cc["misses"]),
+        ))
     for name in sorted(summary["health"]):
         rows.append(("health/" + name, str(summary["health"][name])))
     for op, total_ms, pct in summary.get("top_ops", []):

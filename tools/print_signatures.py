@@ -40,6 +40,7 @@ MODULES = [
     "paddle_tpu.async_executor",
     "paddle_tpu.lod_tensor",
     "paddle_tpu.inference",
+    "paddle_tpu.serving",
     "paddle_tpu.contrib",
     "paddle_tpu.contrib.memory_usage_calc",
 ]
