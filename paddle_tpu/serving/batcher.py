@@ -6,7 +6,10 @@ Orca's continuous admission — the dispatcher does not wait for a full batch
 boundary; it admits whatever is queued the moment either (a) enough rows are
 waiting to fill the largest bucket, or (b) the oldest request has waited
 `max_batch_delay_ms`. Padding to the power-of-two bucket is the engine's
-job; the batcher's job is the time/row tradeoff and the failure modes:
+job; admitted requests that disagree on dynamic trailing dims (mixed
+sequence lengths) are packed and executed per same-trailing-shape group, so
+mixed-length traffic costs extra engine calls, never failed requests. The
+batcher's job is the time/row tradeoff and the failure modes:
 
 - **backpressure**: the queue is bounded in ROWS (not requests — a single
   512-row request is 512 rows of device debt). A full queue fast-fails
@@ -199,8 +202,10 @@ class ContinuousBatcher:
     def _loop(self):
         while True:
             with self._cond:
+                # untimed: submit() and close() notify, so an empty queue
+                # costs zero wakeups
                 while self._alive and not self._queue:
-                    self._cond.wait(0.05)
+                    self._cond.wait()
                 if not self._queue:
                     if not self._alive:
                         return
@@ -239,23 +244,44 @@ class ContinuousBatcher:
             return
         for req in live:
             self._m_queue_ms.observe((now - req.t_submit) * 1e3)
+        # requests may disagree on dynamic trailing dims (sequence lengths);
+        # np.concatenate across mixed trailing shapes raises and would fail
+        # the whole batch, so pack and execute one same-trailing-shape group
+        # at a time (FIFO order preserved within and across groups)
+        groups = {}
+        for req in live:
+            sig = tuple(
+                tuple(np.shape(req.feed[n])[1:])
+                for n in self.engine.feed_names
+            )
+            groups.setdefault(sig, []).append(req)
+        self._m_inflight.set(sum(r.rows for r in live))
+        try:
+            for members in groups.values():
+                self._run_group(members)
+        finally:
+            self._m_inflight.set(0)
+
+    def _run_group(self, live):
+        """Execute one same-trailing-shape group and answer its futures."""
         packed = {
-            n: np.concatenate([np.asarray(r.feed[n]) for r in live])
-            if any(np.ndim(r.feed[n]) for r in live)
-            else np.asarray([r.feed[n] for r in live])
+            n: np.concatenate(
+                [np.atleast_1d(np.asarray(r.feed[n])) for r in live]
+            )
             for n in self.engine.feed_names
         }
-        self._m_inflight.set(sum(r.rows for r in live))
         self._batches_dispatched += 1
         try:
             outs = self.engine.run(packed)
         except Exception as e:
+            # a fresh exception per future: the same instance re-raised from
+            # several caller threads would share (and mutate) one traceback
             for req in live:
                 self._m_requests.inc(outcome="error")
-                req.future._set_error(e)
+                err = RuntimeError("engine failed: %s" % (repr(e),))
+                err.__cause__ = e
+                req.future._set_error(err)
             return
-        finally:
-            self._m_inflight.set(0)
         done = time.perf_counter()
         if self._batches_dispatched % 32 == 0:
             # periodic telemetry snapshot (flag-gated inside stepstats):

@@ -9,15 +9,34 @@ instead:
 - loads a `save_inference_model` directory into a private Scope and lowers
   it ONCE through executor.aot_serve_lowering (donation-free, params as
   arguments);
-- pads every request to a small set of power-of-two buckets — batch dim
-  always, declared-dynamic (-1) trailing dims (sequence lengths) too — so
-  the number of compiled variants is bounded by the bucket grid, never by
-  traffic;
+- pads every request's batch dim to a small set of power-of-two buckets and
+  slices outputs back to the true rows, so the number of compiled variants
+  is bounded by the bucket grid, never by traffic;
 - builds each variant through serving.compile_cache: a warm replica
   deserializes `jax.export` artifacts and replays XLA executables from disk
   instead of tracing (cold-start-from-cache, the SERVING bench's 5× bar);
-- pads with zeros and slices outputs back to the request's true rows, so
-  callers never see the bucket.
+Batch-dim padding is invisible to callers: every op in a forward program is
+row-independent along the batch dim, so padded rows never contaminate real
+rows and slicing them away restores the exact unpadded result.
+
+Declared-dynamic TRAILING dims (-1 in the program's var shape — sequence
+lengths) are a different story. Zero-padding a sequence changes the output
+of any model that reduces across it (softmax attention, mean-pooling,
+layernorm over time): the engine has no mask plumbing, so the padded
+positions would participate in the math. The `trailing_pad` policy makes
+that hazard explicit:
+
+- ``"exact"`` (default): dynamic trailing dims are never padded — each
+  distinct trailing shape compiles its own variant, so results are correct
+  for EVERY model. The variant count is bounded by the bucket grid times
+  the distinct trailing shapes in traffic; clients wanting a bounded set
+  should quantize sequence lengths themselves (that quantization belongs
+  where the mask/real-length knowledge lives).
+- ``"pow2"``: trailing dims pad to the next power of two with zeros —
+  bounded variants under arbitrary lengths, but ONLY sound for models
+  proven padding-invariant along those dims (e.g. masked attention that
+  consumes an explicit length feed). Opting in asserts that proof; the
+  engine cannot check it.
 
 Thread-safety: variant construction is locked; the compiled calls themselves
 are jax jitted functions, safe to invoke from any thread (the batcher
@@ -55,8 +74,14 @@ class ServingEngine:
     """Shape-bucketed, donation-free forward executor for one saved model."""
 
     def __init__(self, model_dir, name=None, place=None, params_filename=None,
-                 batch_buckets=None, cache_dir=None):
+                 batch_buckets=None, cache_dir=None, trailing_pad="exact"):
         import jax
+
+        if trailing_pad not in ("exact", "pow2"):
+            raise ValueError(
+                "trailing_pad must be 'exact' or 'pow2', got %r" % (trailing_pad,)
+            )
+        self.trailing_pad = trailing_pad
 
         self.name = name or model_dir.rstrip("/").rsplit("/", 1)[-1]
         self.scope = Scope()
@@ -137,22 +162,31 @@ class ServingEngine:
         return self.max_batch
 
     def _bucket_shape(self, name, shape):
-        """Padded shape for one feed: batch dim -> bucket; trailing dims the
-        program declares dynamic (-1) -> next power of two (sequence
-        buckets); concrete trailing dims pass through."""
-        declared = self._var_shapes.get(name)
+        """Padded shape for one feed: batch dim -> bucket; trailing dims pass
+        through exactly unless trailing_pad='pow2', in which case dims the
+        program declares dynamic (-1) pad to the next power of two — sound
+        ONLY for padding-invariant models (see the module docstring)."""
         out = [self.bucket_batch(shape[0])]
-        for i, d in enumerate(shape[1:], start=1):
-            dd = (
-                declared[i]
-                if declared is not None and len(declared) == len(shape)
-                else None
-            )
-            out.append(_next_pow2(d) if dd in (-1, None) else int(d))
+        if self.trailing_pad == "pow2":
+            declared = self._var_shapes.get(name)
+            for i, d in enumerate(shape[1:], start=1):
+                dd = (
+                    declared[i]
+                    if declared is not None and len(declared) == len(shape)
+                    else None
+                )
+                out.append(_next_pow2(d) if dd in (-1, None) else int(d))
+        else:
+            out.extend(int(d) for d in shape[1:])
         return tuple(out)
 
-    def _feed_dtype(self, name):
-        dt = self._feed_dtypes.get(name, "float32")
+    def _feed_dtype(self, name, default=None):
+        """The program's declared dtype for a feed, or `default` when the
+        program declares none (the request array then keeps its own dtype —
+        an undeclared integer id feed must not silently become float32)."""
+        dt = self._feed_dtypes.get(name)
+        if dt is None:
+            return default
         if dt == "bfloat16":
             import jax.numpy as jnp
 
@@ -230,9 +264,12 @@ class ServingEngine:
         import jax
 
         shapes = {}
+        dtypes = {}
         for n in self.feed_names:
             if example_feed is not None and n in example_feed:
-                shapes[n] = tuple(np.asarray(example_feed[n]).shape[1:])
+                ex = np.asarray(example_feed[n])
+                shapes[n] = tuple(ex.shape[1:])
+                dtypes[n] = self._feed_dtype(n, default=ex.dtype)
                 continue
             declared = self._var_shapes.get(n)
             if declared is None or any(d in (-1, None) for d in declared[1:]):
@@ -241,11 +278,11 @@ class ServingEngine:
                     "example_feed to pin them" % (n, declared)
                 )
             shapes[n] = tuple(int(d) for d in declared[1:])
+            dtypes[n] = self._feed_dtype(n, default=np.dtype("float32"))
         for b in self.batch_buckets:
             avals = {
                 n: jax.ShapeDtypeStruct(
-                    self._bucket_shape(n, (b,) + shapes[n]),
-                    self._feed_dtype(n),
+                    self._bucket_shape(n, (b,) + shapes[n]), dtypes[n]
                 )
                 for n in self.feed_names
             }
@@ -304,7 +341,12 @@ class ServingEngine:
         padded = {}
         avals = {}
         for name, a in arrays.items():
-            a = np.ascontiguousarray(a, dtype=self._feed_dtype(name))
+            dt = self._feed_dtype(name)
+            a = (
+                np.ascontiguousarray(a)
+                if dt is None
+                else np.ascontiguousarray(a, dtype=dt)
+            )
             shape = self._bucket_shape(name, a.shape)
             if tuple(a.shape) != shape:
                 buf = np.zeros(shape, dtype=a.dtype)
@@ -334,6 +376,7 @@ class ServingEngine:
             "variants": len(self._variants),
             "traces": self.traces,
             "cache_hits": self.cache_hits,
+            "trailing_pad": self.trailing_pad,
         }
         if self.cache is not None:
             out["cache"] = self.cache.stats()

@@ -137,6 +137,139 @@ def test_engine_parity_bf16_params(tmp_path):
                                1.0, rtol=1e-2)
 
 
+def _save_seq_mlp(tmp_path, name="sq", width=6, out_dim=3, seed=5,
+                  prefix="sq"):
+    """Softmax MLP over a MEAN-POOLED dynamic sequence dim ((-1, -1, width)
+    input): the canonical padding-SENSITIVE model — zero rows added along
+    the sequence dim change the mean, so it distinguishes the trailing_pad
+    policies."""
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(
+                name="%s_x" % prefix, shape=[-1, width], dtype="float32"
+            )
+            pooled = fluid.layers.reduce_mean(x, dim=1)
+            y = fluid.layers.fc(input=pooled, size=out_dim, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    model_dir = str(tmp_path / name)
+    scope = Scope(seed=seed)
+    with scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            model_dir, ["%s_x" % prefix], [y], exe, main_program=main
+        )
+    return model_dir, main, scope, "%s_x" % prefix, y.name
+
+
+def test_engine_dynamic_seq_trailing_pad_policies(tmp_path):
+    """A seq-reducing model must serve EXACT results under the default
+    trailing_pad='exact' for non-power-of-two sequence lengths; the opt-in
+    'pow2' mode visibly changes them (the documented padding-invariance
+    requirement), guarding against zero-padding ever becoming the default
+    again."""
+    model_dir, main, scope, xname, yname = _save_seq_mlp(tmp_path)
+    rng = np.random.RandomState(11)
+    feed5 = {xname: rng.rand(3, 5, 6).astype("float32")}  # seq 5: not pow2
+    feed7 = {xname: rng.rand(2, 7, 6).astype("float32")}
+
+    with scope_guard(scope):
+        (ref5,) = fluid.Executor().run(main, feed=feed5, fetch_list=[yname])
+        (ref7,) = fluid.Executor().run(main, feed=feed7, fetch_list=[yname])
+
+    eng = ServingEngine(model_dir, name="sq", batch_buckets=(1, 2, 4))
+    assert eng.trailing_pad == "exact"
+    (out5,) = eng.run(feed5)
+    (out7,) = eng.run(feed7)
+    np.testing.assert_allclose(out5, np.asarray(ref5), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out7, np.asarray(ref7), rtol=1e-5, atol=1e-6)
+    # exact mode: one variant per (bucket, trailing shape) actually seen
+    assert eng._bucket_shape(xname, (3, 5, 6)) == (4, 5, 6)
+
+    pow2 = ServingEngine(
+        model_dir, name="sq2", batch_buckets=(1, 2, 4), trailing_pad="pow2"
+    )
+    assert pow2._bucket_shape(xname, (3, 5, 6)) == (4, 8, 6)
+    (p5,) = pow2.run(feed5)  # mean over 3 zero rows of padding: wrong here
+    assert not np.allclose(p5, np.asarray(ref5), rtol=1e-3), (
+        "pow2 trailing padding should alter a seq-reducing model's output; "
+        "if this now passes, the invariance caveat in engine.py is stale"
+    )
+    with pytest.raises(ValueError, match="trailing_pad"):
+        ServingEngine(model_dir, trailing_pad="sometimes")
+
+
+def test_batcher_mixed_seq_lengths_one_batch(tmp_path):
+    """Concurrent requests with different dynamic sequence lengths admitted
+    into ONE batch must each get their own correct result (the dispatcher
+    packs per trailing-shape group instead of concatenating across shapes
+    and 500-ing the whole batch)."""
+    model_dir, main, scope, xname, yname = _save_seq_mlp(tmp_path, name="mx",
+                                                         prefix="mx")
+    rng = np.random.RandomState(13)
+    feeds = [
+        {xname: rng.rand(rows, seq, 6).astype("float32")}
+        for rows, seq in [(1, 5), (2, 7), (1, 5)]
+    ]
+    refs = []
+    with scope_guard(scope):
+        for f in feeds:
+            (r,) = fluid.Executor().run(main, feed=f, fetch_list=[yname])
+            refs.append(np.asarray(r))
+
+    eng = ServingEngine(model_dir, name="mx", batch_buckets=(1, 2, 4))
+    # a long batch delay guarantees all three land in the same admission
+    b = ContinuousBatcher(eng, max_queue_rows=64, max_batch_delay_ms=300.0)
+    futs = [b.submit(f) for f in feeds]
+    try:
+        for fut, f, ref in zip(futs, feeds, refs):
+            (out,) = fut.result(10.0)
+            assert out.shape == ref.shape
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    finally:
+        b.close()
+
+
+def test_batcher_engine_error_is_fresh_per_request(tmp_path):
+    """An engine failure must surface as a DISTINCT exception object on each
+    future (chained to the original), not one shared instance re-raised
+    from several caller threads."""
+    model_dir, _, _, xname, _ = _save_mlp(tmp_path, prefix="er")
+    eng = ServingEngine(model_dir, name="er", batch_buckets=(1, 2, 4))
+    boom = ValueError("kaboom")
+
+    def failing_run(feed):
+        raise boom
+
+    eng.run = failing_run
+    b = ContinuousBatcher(eng, max_queue_rows=64, max_batch_delay_ms=200.0)
+    futs = [b.submit({xname: np.zeros((1, 6), np.float32)}) for _ in range(2)]
+    errs = []
+    try:
+        for fut in futs:
+            with pytest.raises(RuntimeError, match="kaboom") as ei:
+                fut.result(10.0)
+            errs.append(ei.value)
+    finally:
+        b.close()
+    assert errs[0] is not errs[1]
+    assert errs[0].__cause__ is boom and errs[1].__cause__ is boom
+
+
+def test_engine_keeps_dtype_when_program_declares_none(tmp_path):
+    """A feed whose program var declares no dtype must pass through with the
+    request array's own dtype instead of a silent float32 cast."""
+    model_dir, _, _, xname, _ = _save_mlp(tmp_path, prefix="dt")
+    eng = ServingEngine(model_dir, name="dt", batch_buckets=(1, 2))
+    assert eng._feed_dtype("not_a_feed") is None
+    eng._feed_dtypes.clear()  # simulate an undeclared-dtype program var
+    (out,) = eng.run({xname: np.ones((2, 6), np.int32)})
+    assert out.shape == (2, 3)
+    assert any("int32" in str(k) for k in eng._variants), (
+        "int32 feed was cast instead of compiling an int32 variant"
+    )
+
+
 def test_compile_cache_hit_on_second_boot(tmp_path):
     """First boot traces every bucket and writes artifacts; a second engine
     on the same cache dir deserializes all of them (zero traces) and still
