@@ -31,6 +31,64 @@ def _bucket(n, buckets=(1, 2, 4, 8, 16, 32, 64, 128)):
     return ((n + 127) // 128) * 128
 
 
+def _assemble_batch(batch, used):
+    """Pack samples into fixed-shape arrays: dense float slots stack to
+    (b, dim); sparse id slots pad to a bucketed max length with -1
+    (= lookup_table padding_idx, zero vector) so XLA sees few shapes."""
+    feeds = {}
+    for slot_idx, slot in used:
+        cols = [sample[slot_idx] for sample in batch]
+        if slot.type == "float":
+            dim = max(len(c) for c in cols)
+            arr = np.zeros((len(cols), dim), np.float32)
+            for i, c in enumerate(cols):
+                arr[i, : len(c)] = c
+        else:
+            width = _bucket(max(len(c) for c in cols))
+            arr = np.full((len(cols), width), -1, np.int64)
+            for i, c in enumerate(cols):
+                arr[i, : len(c)] = c
+        feeds[slot.name] = arr
+    return feeds
+
+
+class _FileShardDecode:
+    """DataRuntime decode_fn for the async filelist: shard = one input
+    file, parsed by the native feed (nthreads=1 inside the worker — the
+    parallelism IS the worker pool) and assembled into fixed-shape batches.
+    Deterministic per shard (single file, single parser thread), which the
+    crash-replay contract requires; module-level so it pickles under
+    spawn."""
+
+    def __init__(self, files, slot_types, used, batch_size):
+        self.files = list(files)
+        self.slot_types = slot_types
+        self.used = list(used)
+        self.batch_size = int(batch_size)
+
+    def __call__(self, shard_id):
+        from . import native
+
+        fname = self.files[shard_id]
+        feed = native.MultiSlotDataFeed(
+            self.slot_types, queue_capacity=4 * self.batch_size
+        )
+        feed.start([fname], nthreads=1)
+        batch = []
+        for sample in feed:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield _assemble_batch(batch, self.used)
+                batch = []
+        if batch:
+            yield _assemble_batch(batch, self.used)
+        feed.join()
+        if feed.file_errors():
+            raise IOError(
+                "async feed: input file %r could not be opened" % fname
+            )
+
+
 class AsyncExecutor:
     def __init__(self, place=None):
         self.place = place
@@ -45,11 +103,20 @@ class AsyncExecutor:
         fetch,
         debug=False,
         print_period=100,
+        num_workers=None,
     ):
         """Train over `filelist` until the feed drains. `fetch` vars are
         averaged per print period (reference async_executor.py:run / the
         worker's PrintFetchVars). Returns the list of per-period means of the
-        first fetch var."""
+        first fetch var.
+
+        num_workers > 0 (or FLAGS_data_num_workers) rides the native data
+        runtime (docs/data.md): each input file is a shard decoded by a
+        worker PROCESS — parse, batch assembly, and padding all leave the
+        trainer process, batches cross a shared-memory ring, and a killed
+        worker's files replay without sample loss. Batches then pad
+        per-file rather than globally (the last partial batch is per file).
+        Default (0) keeps the in-process native feed threads."""
         if isinstance(fetch, (str, framework.Variable)):
             fetch = [fetch]
         fetch_names = [
@@ -66,11 +133,6 @@ class AsyncExecutor:
                     "program has no var for used slot %r" % slot.name
                 )
             feed_vars.append(block.vars[slot.name])
-
-        feed = native.MultiSlotDataFeed(
-            data_feed.native_slot_types(), queue_capacity=4 * data_feed.batch_size
-        )
-        feed.start(list(filelist), nthreads=max(1, int(thread_num)))
 
         bs = data_feed.batch_size
         period_vals = []
@@ -101,6 +163,55 @@ class AsyncExecutor:
                 )
             period_vals.clear()
 
+        def consume(feeds_iter):
+            nonlocal step
+            for feeds in feeds_iter:
+                vals = self.executor.run(
+                    program,
+                    feed=feeds,
+                    fetch_list=fetch_names,
+                    scope=global_scope(),
+                    return_numpy=False,
+                )
+                step += 1
+                period_vals.append(list(vals))
+                if step % print_period == 0:
+                    flush(step)
+
+        if num_workers is None:
+            from .flags import get_flags
+
+            num_workers = int(get_flags()["data_num_workers"])
+        num_workers = int(num_workers or 0)
+
+        if num_workers > 0:
+            # native data runtime path: shard = file, decoded out-of-process
+            from .data import DataRuntime
+
+            files = list(filelist)
+            decode = _FileShardDecode(
+                files, data_feed.native_slot_types(), used, bs
+            )
+            rt = DataRuntime(
+                decode,
+                num_shards=len(files),
+                num_workers=min(num_workers, max(1, len(files))),
+                shuffle=False,  # filelist order is the shard order
+                name="asyncexec",
+            )
+            rt.start()
+            try:
+                consume(rt())
+            finally:
+                rt.close()
+            flush(step)
+            return results
+
+        feed = native.MultiSlotDataFeed(
+            data_feed.native_slot_types(), queue_capacity=4 * bs
+        )
+        feed.start(list(filelist), nthreads=max(1, int(thread_num)))
+
         def batches():
             it = iter(feed)
             while True:
@@ -120,21 +231,12 @@ class AsyncExecutor:
         from .py_reader import PyReader
 
         staging = PyReader([v.name for v in feed_vars], capacity=2)
-        staging.decorate_tensor_provider(batches)
+        # num_workers=0 pins the in-process staging thread: `batches`
+        # closes over the live native feed and cannot move to a process
+        staging.decorate_tensor_provider(batches, num_workers=0)
         staging.start()
         try:
-            for feeds in staging():
-                vals = self.executor.run(
-                    program,
-                    feed=feeds,
-                    fetch_list=fetch_names,
-                    scope=global_scope(),
-                    return_numpy=False,
-                )
-                step += 1
-                period_vals.append(list(vals))
-                if step % print_period == 0:
-                    flush(step)
+            consume(staging())
         finally:
             staging.reset()
         flush(step)
@@ -150,21 +252,4 @@ class AsyncExecutor:
         return results
 
     def _assemble(self, batch, used, feed_vars):
-        """Pack samples into fixed-shape arrays: dense float slots stack to
-        (b, dim); sparse id slots pad to a bucketed max length with -1
-        (= lookup_table padding_idx, zero vector) so XLA sees few shapes."""
-        feeds = {}
-        for (slot_idx, slot), var in zip(used, feed_vars):
-            cols = [sample[slot_idx] for sample in batch]
-            if slot.type == "float":
-                dim = max(len(c) for c in cols)
-                arr = np.zeros((len(cols), dim), np.float32)
-                for i, c in enumerate(cols):
-                    arr[i, : len(c)] = c
-            else:
-                width = _bucket(max(len(c) for c in cols))
-                arr = np.full((len(cols), width), -1, np.int64)
-                for i, c in enumerate(cols):
-                    arr[i, : len(c)] = c
-            feeds[slot.name] = arr
-        return feeds
+        return _assemble_batch(batch, used)

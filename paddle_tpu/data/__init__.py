@@ -1,0 +1,27 @@
+"""Native data runtime: multiprocess decode workers + shared-memory ring
+buffer + async double-buffered device feed (docs/data.md).
+
+The paper's L2 AsyncExecutor/DataFeed layer rebuilt TPU-first: decode
+parallelism moves to processes (the GIL owns threads), the hand-off is a
+shared-memory ring of batch slabs (zero pickling of payloads), datasets
+shard per host and per worker deterministically, and batch k+1 is
+device_put while step k runs. ``PyReader.decorate_paddle_reader(...,
+num_workers=N)`` is the drop-in front end; ``DataRuntime`` is the native
+shard-based API; ``AsyncExecutor.run`` rides the same pool for its
+filelist. ``cache_epoch`` (PR 3) remains the opt-in fast path for datasets
+that fit in HBM — this runtime is for the ones that don't.
+"""
+
+from .ring import RingBuffer, SlabOverflowError, TornSlotError
+from .runtime import DataRuntime
+from .sharding import epoch_shard_order, host_shards, worker_shards
+
+__all__ = [
+    "DataRuntime",
+    "RingBuffer",
+    "SlabOverflowError",
+    "TornSlotError",
+    "epoch_shard_order",
+    "host_shards",
+    "worker_shards",
+]

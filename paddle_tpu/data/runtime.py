@@ -1,0 +1,538 @@
+"""DataRuntime: the trainer-side orchestrator of the native data runtime.
+
+Paper/reference analog: the L2 AsyncExecutor/DataFeed layer — N parser
+threads filling a native blocking queue the trainer pops. The TPU-native
+composition here (docs/data.md):
+
+    decode workers (processes, workers.py)
+        -> shared-memory ring slabs (ring.py; payload never pickled)
+        -> drain thread: seqlock-validate, copy out, dedupe, release slot,
+           async jax.device_put (batch k+1 transfers while step k runs)
+        -> bounded staged queue of device-resident batches
+        -> next_batch() (Executor / ParallelExecutor / PyReader pull here)
+
+Exactly-once contract: every (shard, batch index) is delivered at most once
+(consumer-side dedupe) and at least once (authoritative parent-side shard
+assignment: a dead worker's outstanding shards are re-queued with
+``skip`` = batches already received, and decode is deterministic per
+shard). SIGKILLing a worker mid-epoch therefore loses nothing and
+duplicates nothing — tests/test_data_runtime.py proves this with a real
+kill, in the style of tests/test_resilience.py.
+
+Observability (docs/observability.md): the runtime feeds the PR 4 metric
+registry — data/ring_occupancy, data/bytes_per_sec, per-worker
+data/worker_busy_frac and data/batches_total, data/worker_restarts — and
+``next_batch`` records time blocked on the staged queue as feed-stall in
+StepStats, so `pyreader_frac` measures TRUE overlap end to end.
+"""
+
+import collections
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+
+from .ring import RingBuffer, TornSlotError
+from .sharding import epoch_shard_order, host_shards
+from .workers import WorkerPool
+
+__all__ = ["DataRuntime"]
+
+_OUTSTANDING_PER_WORKER = 2  # active shard + one prefetched assignment
+
+
+def _flags():
+    from ..flags import get_flags
+
+    return get_flags()
+
+
+def _registry():
+    from ..observability.registry import default_registry
+
+    return default_registry()
+
+
+class _Eof:
+    def __init__(self, gen):
+        self.gen = gen
+
+
+class _Error:
+    def __init__(self, gen, exc):
+        self.gen = gen
+        self.exc = exc
+
+
+def spec_bytes(batch_spec):
+    """Packed slab bytes for a {name: (shape, dtype)} batch spec."""
+    total = 0
+    for shape, dtype in batch_spec.values():
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return total
+
+
+class DataRuntime:
+    def __init__(self, decode_fn, num_shards, num_workers=None,
+                 ring_slots=None, slot_bytes=None, batch_spec=None,
+                 num_hosts=1, host_id=0, seed=0, shuffle=True,
+                 start_method=None, device_prefetch=None, stage_device=True,
+                 device_sharding=None, max_worker_restarts=None, name="data"):
+        """decode_fn(shard_id) -> iterable of {name: ndarray} batches; MUST
+        be deterministic per shard_id (the crash-replay contract) and must
+        not touch jax (it runs in worker processes). Under
+        FLAGS_data_start_method=spawn it must also be picklable."""
+        flags = _flags()
+        self.decode_fn = decode_fn
+        self.num_shards = int(num_shards)
+        self.num_workers = int(num_workers or flags["data_num_workers"] or 2)
+        self.ring_slots = int(
+            ring_slots or flags["data_ring_slots"]
+            or max(4, 2 * self.num_workers)
+        )
+        self.ring_slots = max(self.ring_slots, self.num_workers + 1)
+        self._slot_bytes = slot_bytes
+        self._batch_spec = batch_spec
+        self.num_hosts = int(num_hosts)
+        self.host_id = int(host_id)
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        self.prefetch = int(device_prefetch or flags["data_prefetch"] or 2)
+        self.stage_device = bool(stage_device)
+        self.device_sharding = device_sharding
+        self._start_method = start_method or flags["data_start_method"]
+        self._max_restarts = (
+            max_worker_restarts
+            if max_worker_restarts is not None
+            else flags["data_max_worker_restarts"]
+        )
+        self.name = name
+
+        self._ctx = None
+        self._ring = None
+        self._pool = None
+        self._drain = None
+        self._lock = threading.RLock()
+        self._gen = 0
+        self._epoch = -1
+        self._started = False
+        self._closed = False
+        self._staged = _queue.Queue(maxsize=max(1, self.prefetch))
+        self._stats_t0 = time.perf_counter()
+        self._stats_bytes = 0
+        # per-epoch accounting (under _lock)
+        self._pending = collections.deque()
+        self._assigned = {}  # worker -> [shard ids outstanding, in order]
+        self._received = {}  # shard -> contiguous received count
+        self._remaining = set()
+
+    # ------------------------------------------------------------------ setup
+    def _ensure_pool(self):
+        if self._pool is not None:
+            return
+        import multiprocessing as mp
+
+        if self._slot_bytes is None:
+            if self._batch_spec is not None:
+                self._slot_bytes = spec_bytes(self._batch_spec)
+            else:
+                self._slot_bytes = self._probe_slot_bytes()
+        # headroom: decode may bucket widths per batch; 25% + a page
+        self._slot_bytes = int(self._slot_bytes * 1.25) + 4096
+        self._ctx = mp.get_context(self._start_method)
+        self._ring = RingBuffer(self.ring_slots, self._slot_bytes, create=True)
+        self._pool = WorkerPool(
+            self._ctx, self.num_workers, self._ring.name, self.decode_fn,
+            max_restarts=self._max_restarts,
+        )
+        self._pool.start()
+        self._drain = threading.Thread(
+            target=self._drain_loop, daemon=True,
+            name="ptdata-drain-%s" % self.name,
+        )
+        self._drain.start()
+
+    def _probe_slot_bytes(self):
+        """Decode ONE batch of the first shard in the parent to size the
+        slabs. Costs one batch of decode; pass slot_bytes/batch_spec to
+        skip (mandatory when batch sizes vary upward after the first)."""
+        order = epoch_shard_order(self.num_shards, self.seed, 0, self.shuffle)
+        mine = host_shards(order, self.num_hosts, self.host_id)
+        if not mine:
+            return 1 << 16
+        for batch in self.decode_fn(mine[0]):
+            total = sum(
+                np.ascontiguousarray(v).nbytes for v in batch.values()
+            )
+            return max(total, 1 << 12)
+        return 1 << 16
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def started(self):
+        return self._started
+
+    def start(self, epoch=None):
+        """Begin an epoch: shuffle -> host shard -> assign to workers."""
+        if self._closed:
+            raise RuntimeError("DataRuntime is closed")
+        if self._started:
+            raise RuntimeError("epoch already running; call reset() first")
+        self._ensure_pool()
+        with self._lock:
+            self._epoch = self._epoch + 1 if epoch is None else int(epoch)
+            self._gen += 1
+            self._pool.set_generation(self._gen)
+            order = epoch_shard_order(
+                self.num_shards, self.seed, self._epoch, self.shuffle
+            )
+            mine = host_shards(order, self.num_hosts, self.host_id)
+            self._pending = collections.deque(mine)
+            self._remaining = set(mine)
+            self._received = {s: 0 for s in mine}
+            self._assigned = {w: [] for w in range(self.num_workers)}
+            self._started = True
+            if not mine:
+                self._staged.put(_Eof(self._gen))
+            else:
+                for w in range(self.num_workers):
+                    self._top_up(w)
+        try:
+            _registry().counter(
+                "data/epochs", "epochs started by the data runtime"
+            ).inc()
+        except Exception:  # noqa: BLE001 — telemetry must never break input
+            pass
+
+    def _top_up(self, worker):
+        """Assign pending shards to ``worker`` until it has its outstanding
+        quota. Caller holds _lock. Parent-side ``_assigned`` is the
+        authoritative record — a dead worker's outstanding shards are
+        recovered from here, never from worker acks."""
+        q = self._pool.queue(worker)
+        while self._pending and len(self._assigned[worker]) < _OUTSTANDING_PER_WORKER:
+            shard = self._pending.popleft()
+            self._assigned[worker].append(shard)
+            q.put((shard, self._received.get(shard, 0), self._gen))
+
+    def reset(self):
+        """Abort the running epoch (PyReader.reset contract): stale
+        generations drain harmlessly — workers abandon stale shards at the
+        next batch, and the drain thread releases stale slots on sight."""
+        with self._lock:
+            self._gen += 1
+            if self._pool is not None:
+                self._pool.set_generation(self._gen)
+            self._started = False
+            self._pending.clear()
+            self._remaining = set()
+            self._assigned = {w: [] for w in range(self.num_workers)}
+        while True:  # drop already-staged batches of the dead generation
+            try:
+                self._staged.get_nowait()
+            except _queue.Empty:
+                break
+
+    def close(self):
+        if self._closed:
+            return
+        self.reset()
+        self._closed = True
+        if self._pool is not None:
+            self._pool.stop()
+        if self._drain is not None:
+            self._drain.join(timeout=5)
+        if self._ring is not None:
+            self._ring.close()
+
+    def __del__(self):  # best-effort: unlink shm segments
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -------------------------------------------------------------- consumer
+    def next_batch(self):
+        """Next device-staged batch; raises EOFException at epoch end.
+        Blocking time here IS the input pipeline failing to keep up — it is
+        recorded as feed-stall (stepstats), the overlap ground truth."""
+        from ..py_reader import EOFException
+        from ..observability import stepstats as _ss
+
+        if not self._started:
+            raise RuntimeError("DataRuntime epoch not started")
+        t0 = time.perf_counter() if _ss.active() else None
+        while True:
+            try:
+                item = self._staged.get(timeout=5.0)
+            except _queue.Empty:
+                if self._drain is not None and not self._drain.is_alive():
+                    raise RuntimeError("data runtime drain thread died")
+                continue
+            if isinstance(item, (_Eof, _Error)) and item.gen != self._gen:
+                continue  # stale epoch leftovers
+            if isinstance(item, tuple) and item[0] != self._gen:
+                continue
+            break
+        if t0 is not None:
+            _ss.collector().add_feed_stall((time.perf_counter() - t0) * 1e3)
+        if isinstance(item, _Eof):
+            self._started = False
+            raise EOFException("data runtime epoch exhausted")
+        if isinstance(item, _Error):
+            self._started = False
+            raise item.exc
+        return item[1]
+
+    def __call__(self):
+        from ..py_reader import EOFException
+
+        try:
+            while True:
+                yield self.next_batch()
+        except EOFException:
+            return
+
+    # ----------------------------------------------------------- drain loop
+    def _put_control(self, item):
+        """Deliver an _Eof/_Error to the staged queue without deadlocking
+        against a full queue: give up as soon as its generation is stale
+        (next_batch drops stale control items anyway)."""
+        while True:
+            with self._lock:
+                if item.gen != self._gen:
+                    return
+            try:
+                self._staged.put(item, timeout=0.1)
+                return
+            except _queue.Full:
+                continue
+
+    def _stage(self, gen, feed):
+        """Optionally device_put (async — the transfer overlaps the running
+        step) and hand to the bounded staged queue, staying responsive to
+        generation bumps so an abort can't deadlock a full queue."""
+        if self.stage_device:
+            import jax
+
+            sharding = self.device_sharding
+            staged = {}
+            for k, v in feed.items():
+                sh = None
+                if sharding is not None:
+                    sh = sharding(v) if callable(sharding) else sharding
+                staged[k] = (
+                    jax.device_put(v, sh) if sh is not None else jax.device_put(v)
+                )
+            feed = staged
+        while True:
+            with self._lock:
+                if gen != self._gen:
+                    return
+            try:
+                self._staged.put((gen, feed), timeout=0.1)
+                return
+            except _queue.Full:
+                continue
+
+    def _drain_loop(self):
+        """Round-robin over the per-worker ready queues. Each queue has one
+        producer (its worker) and one consumer (this thread), so per-shard
+        batch indices arrive in order by construction — and a message is
+        always handled the moment it is fetched, BEFORE any supervisor
+        work, so a recovery grace-drain can never leapfrog a held batch
+        (the dedupe would drop it as a replay duplicate)."""
+        last_liveness = 0.0
+        while not self._closed:
+            did_work = False
+            for w in range(self.num_workers):
+                try:
+                    msg = self._pool.ready_queue(w).get_nowait()
+                except _queue.Empty:
+                    continue
+                except Exception:  # noqa: BLE001 — poisoned/dead queue:
+                    continue  # recovery will replace it
+                did_work = True
+                try:
+                    self._handle(msg)
+                except Exception as e:  # noqa: BLE001 — surface to trainer
+                    with self._lock:
+                        gen = self._gen
+                    self._staged.put(_Error(gen, e))
+            now = time.perf_counter()
+            if now - last_liveness > 0.25:
+                last_liveness = now
+                try:
+                    self._check_workers()
+                    self._update_gauges()
+                except Exception:  # noqa: BLE001 — supervisor must survive
+                    pass
+            if not did_work:
+                time.sleep(0.005)
+
+    def _handle(self, msg):
+        kind = msg.get("kind")
+        if kind == "batch":
+            self._handle_batch(msg)
+        elif kind == "shard_done":
+            eof_gen = None
+            with self._lock:
+                if msg["gen"] != self._gen:
+                    return
+                shard, worker = msg["shard"], msg["worker"]
+                if shard in self._remaining:
+                    self._remaining.discard(shard)
+                if shard in self._assigned.get(worker, []):
+                    self._assigned[worker].remove(shard)
+                self._top_up(worker)
+                if self._started and not self._remaining and not self._pending:
+                    eof_gen = self._gen
+            if eof_gen is not None:
+                self._put_control(_Eof(eof_gen))
+        elif kind == "error":
+            exc = RuntimeError(
+                "data worker %s failed decoding shard %s: %s\n%s"
+                % (msg["worker"], msg["shard"], msg["error"],
+                   msg.get("trace", ""))
+            )
+            with self._lock:
+                gen = self._gen
+            if msg["gen"] == gen:
+                self._put_control(_Error(gen, exc))
+        # shard_start is informational (workers ack assignments); the
+        # authoritative assignment record is parent-side _assigned
+
+    def _handle_batch(self, msg):
+        slot, seq = msg["slot"], msg["seq"]
+        with self._lock:
+            current = msg["gen"] == self._gen
+            # per-shard indices arrive in order from a single live worker;
+            # a crash-replay re-emits a contiguous prefix
+            dup = current and msg["index"] < self._received.get(msg["shard"], 0)
+        if not current or dup:
+            self._ring.release(slot)
+            if dup:
+                try:
+                    _registry().counter(
+                        "data/batches_dropped_dup",
+                        "crash-replay duplicates dropped by dedupe",
+                    ).inc()
+                except Exception:  # noqa: BLE001
+                    pass
+            return
+        try:
+            feed = self._ring.read(slot, msg["meta"], seq)
+        except TornSlotError:
+            # protocol kept us honest: never serve a torn slab. Do NOT
+            # release — a torn seq means the slot was already reclaimed
+            # and some writer may hold it now.
+            return
+        self._ring.release(slot)
+        # count the batch as received only once it is safely copied out —
+        # a torn read above must leave it claimable by the crash-replay
+        with self._lock:
+            if msg["gen"] != self._gen:
+                return
+            got = self._received.get(msg["shard"], 0)
+            self._received[msg["shard"]] = max(got, msg["index"] + 1)
+        self._account(msg)
+        self._stage(msg["gen"], feed)
+
+    def _account(self, msg):
+        try:
+            reg = _registry()
+            w = str(msg["worker"])
+            reg.counter(
+                "data/batches_total", "batches delivered by decode workers"
+            ).inc(1, worker=w)
+            reg.counter(
+                "data/bytes_total", "payload bytes through the shm ring"
+            ).inc(msg["bytes"])
+            busy, wait = msg.get("busy_ms", 0.0), msg.get("wait_ms", 0.0)
+            if busy + wait > 0:
+                reg.gauge(
+                    "data/worker_busy_frac",
+                    "decode time / (decode + ring-wait) per worker",
+                ).set(busy / (busy + wait), worker=w)
+            self._stats_bytes += msg["bytes"]
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _update_gauges(self):
+        reg = _registry()
+        reg.gauge(
+            "data/ring_occupancy",
+            "fraction of ring slots claimed (mid-write or undelivered)",
+        ).set(len(self._ring.owned_slots()) / float(self.ring_slots))
+        dt = time.perf_counter() - self._stats_t0
+        if dt >= 1.0:
+            reg.gauge(
+                "data/bytes_per_sec", "shm ring payload throughput"
+            ).set(self._stats_bytes / dt)
+            self._stats_t0 = time.perf_counter()
+            self._stats_bytes = 0
+
+    def _check_workers(self):
+        if self._pool is None:
+            return
+        for w in self._pool.dead_workers():
+            self._recover_worker(w)
+
+    def _recover_worker(self, w):
+        """A worker died. Recover in this order: (1) drain its straggler
+        messages, (2) re-queue its outstanding shards with skip=received,
+        (3) reclaim/scavenge its ring slots, (4) respawn under the retry
+        policy. docs/data.md#crash-isolation walks through why this is
+        exactly-once."""
+        try:
+            from ..resilience import health
+
+            health.incr("data_worker_death")
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            _registry().counter(
+                "data/worker_restarts", "decode worker respawns"
+            ).inc()
+        except Exception:  # noqa: BLE001
+            pass
+        # (1) grace-drain: messages the dead worker flushed before dying.
+        # Only ITS ready queue is read (per-worker queues), so the live
+        # workers' streams cannot be reordered by this drain; the queue is
+        # discarded on respawn, so nothing can straggle in later.
+        rq = self._pool.ready_queue(w)
+        while True:
+            try:
+                msg = rq.get(timeout=0.1)
+            except _queue.Empty:
+                break
+            except Exception:  # noqa: BLE001 — truncated pickle etc.
+                break
+            try:
+                self._handle(msg)
+            except Exception:  # noqa: BLE001
+                break
+        with self._lock:
+            # (2) outstanding shards back to pending, front of the line
+            for shard in reversed(self._assigned.get(w, [])):
+                if shard in self._remaining:
+                    self._pending.appendleft(shard)
+            self._assigned[w] = []
+            # (3) the dead worker's ring slots — mid-write (seq forced
+            # even; no descriptor carries the new seq) and committed-but-
+            # undelivered alike — go back to claimable
+            self._ring.reclaim_dead([w])
+            # (4) respawn with fresh queues (the old ones may hold a
+            # poisoned lock or a half-written pickle)
+            ok = self._pool.respawn(w)
+            if ok:
+                for ww in range(self.num_workers):
+                    self._top_up(ww)
+            exhausted = not ok and self._started
+            gen = self._gen
+        if exhausted:
+            self._put_control(_Error(gen, RuntimeError(
+                "data worker %d exceeded its restart budget (%s)"
+                % (w, self._pool.restart_policy.max_attempts)
+            )))

@@ -57,6 +57,20 @@ Honored flags:
   subdir — a warm replica cold-starts without tracing or compiling
   (docs/serving.md); "" (default) disables the persistent layer (variants
   still cache in-process).
+- data_num_workers: default worker count for the native data runtime
+  (paddle_tpu/data/, docs/data.md): PyReader.decorate_* calls that do not
+  pass num_workers explicitly use this many multiprocess decode workers;
+  0 (default) keeps the single-threaded feeder path.
+- data_ring_slots: shared-memory ring capacity in batch slabs; 0 (default)
+  auto-sizes to max(4, 2 * num_workers).
+- data_prefetch: device-staged batches held ahead of the consumer (the
+  double-buffer depth — batch k+1..k+prefetch transfer while step k runs).
+- data_start_method: multiprocessing start method for decode workers.
+  "fork" (default) is fast and accepts closures; use "spawn" when the
+  parent process already initialized a TPU backend (decode fns must then
+  be picklable module-level callables).
+- data_max_worker_restarts: respawn budget per worker slot under the
+  resilience retry policy before the runtime surfaces a fatal error.
 - eager_delete_tensor_gb / fraction_of_gpu_memory_to_use /
   paddle_num_threads: accepted for API compatibility; storage lifetime and
   threading are XLA/PJRT-owned here (documented no-ops).
@@ -86,6 +100,11 @@ _DEFAULTS = {
     "tensor_stats": "",
     "nan_provenance": False,
     "serving_cache_dir": "",
+    "data_num_workers": 0,
+    "data_ring_slots": 0,
+    "data_prefetch": 2,
+    "data_start_method": "fork",
+    "data_max_worker_restarts": 4,
 }
 
 _flags = {}

@@ -87,25 +87,46 @@ class GraphPyReader:
             readers = program._py_readers = []
         readers.append(self)
 
-    # delegate lifecycle to the async impl
-    def decorate_paddle_reader(self, reader, places=None):
+    # delegate lifecycle to the async impl (num_workers: the native data
+    # runtime — multiprocess decode + shm ring + device double-buffer;
+    # docs/data.md)
+    def decorate_paddle_reader(self, reader, places=None, num_workers=None,
+                               num_shards=None):
         from ..data_feeder import DataFeeder
 
         self._impl.set_feeder(DataFeeder(self.vars))
-        self._impl._paddle_reader = reader
+        self._impl.decorate_paddle_reader(
+            reader, num_workers=num_workers, num_shards=num_shards
+        )
+        self._impl._batched_tuples = False  # the DataFeeder assembles rows
         return self
 
-    def decorate_tensor_provider(self, reader):
-        return self._impl.decorate_tensor_provider(reader)
+    def decorate_tensor_provider(self, reader, num_workers=None,
+                                 num_shards=None):
+        return self._impl.decorate_tensor_provider(
+            reader, num_workers=num_workers, num_shards=num_shards
+        )
 
-    def decorate_batch_generator(self, reader, places=None):
-        return self._impl.decorate_batch_generator(reader)
+    def decorate_batch_generator(self, reader, places=None, num_workers=None,
+                                 num_shards=None):
+        return self._impl.decorate_batch_generator(
+            reader, num_workers=num_workers, num_shards=num_shards
+        )
+
+    def set_device_sharding(self, sharding):
+        return self._impl.set_device_sharding(sharding)
+
+    def push_back(self, batch):
+        return self._impl.push_back(batch)
 
     def start(self):
         return self._impl.start()
 
     def reset(self):
         return self._impl.reset()
+
+    def close(self):
+        return self._impl.close()
 
     def next_batch(self):
         return self._impl.next_batch()

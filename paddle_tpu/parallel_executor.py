@@ -149,6 +149,29 @@ class ParallelExecutor:
         """Number of ways the batch is split (the 'dp' axis extent)."""
         return self._mesh.shape.get("dp", self._mesh.size)
 
+    def _install_reader_sharding(self):
+        """Hand this PE's data-parallel placement to the program's readers
+        (data-runtime mode stages batches with it, so they arrive on the
+        mesh already split over 'dp' — no gather/re-scatter between the
+        staging thread and the compiled step). Per-array callable: fields
+        whose batch dim doesn't divide the mesh stay replicated."""
+        dp = self.device_count
+        if dp <= 1:
+            return
+        mesh = self._mesh
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def shard_for(arr):
+            shape = getattr(arr, "shape", None)
+            if not shape or shape[0] % dp != 0:
+                return None
+            spec = PartitionSpec("dp", *([None] * (len(shape) - 1)))
+            return NamedSharding(mesh, spec)
+
+        for reader in getattr(self._program, "_py_readers", []):
+            if hasattr(reader, "set_device_sharding"):
+                reader.set_device_sharding(shard_for)
+
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True,
             steps_per_run=1):
         """steps_per_run > 1 compiles k iterations into one SPMD XLA call
@@ -163,6 +186,7 @@ class ParallelExecutor:
             # pull staged batches from started py_readers, like Executor.run
             from .executor import _resolve_reader_feed
 
+            self._install_reader_sharding()
             feed, steps_per_run, force_multi = _resolve_reader_feed(
                 self._program, steps_per_run
             )

@@ -299,6 +299,29 @@ print("serving smoke ok: 72 requests, 0 hot-path recompiles, p99 %.1f ms"
       % p99)
 PY
 
+echo "== data-runtime smoke (docs/data.md) =="
+# a small uncached uint8 + token dataset streams through the native data
+# runtime (num_workers=2): the feed-stall fraction must stay under 0.2 on
+# CPU, and a SIGKILLed decode worker must lose/duplicate ZERO samples
+# (exactly-once crash replay). Long soak variants are marked `slow` in
+# tests/test_data_runtime.py and excluded from the tier-1 lane.
+JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+sys.path.insert(0, ".")
+from bench import run_reader_bench
+rec = run_reader_bench(smoke=True)
+img, tok = rec["image"], rec["tokens"]
+assert img["pyreader_frac_runtime"] < 0.2, img
+assert tok["pyreader_frac_tokens_runtime"] < 0.2, tok
+print("data smoke ok: runtime feed-stall frac uint8=%.3f tokens=%.3f "
+      "(%d workers, %d batches/epoch)"
+      % (img["pyreader_frac_runtime"], tok["pyreader_frac_tokens_runtime"],
+         rec["num_workers"], img["batches_per_epoch"]))
+PY
+JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_data_runtime.py::test_worker_kill_mid_epoch_loses_and_duplicates_nothing \
+    tests/test_data_runtime.py::test_pyreader_reset_generation_guard_regression
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

@@ -139,6 +139,48 @@ def _serving_summary(metrics):
     return out
 
 
+def _data_summary(metrics):
+    """Data-runtime stats from a snapshot's metric dump: the data/...
+    namespace written by paddle_tpu.data.runtime (ring occupancy and
+    throughput, per-worker batch counts and busy fractions, restart and
+    dedupe counters)."""
+    data = {}
+    for name in metrics:
+        parts = name.split("/")
+        if len(parts) == 2 and parts[0] == "data":
+            data[parts[1]] = metrics[name]
+    if not data:
+        return {}
+
+    def scalar(rec):
+        if not rec or not rec.get("values"):
+            return None
+        vals = rec["values"]
+        return vals.get("", sum(vals.values()))
+
+    def labelled(rec):
+        return (rec or {}).get("values") or {}
+
+    out = {
+        "epochs": scalar(data.get("epochs")),
+        "ring_occupancy": scalar(data.get("ring_occupancy")),
+        "bytes_per_sec": scalar(data.get("bytes_per_sec")),
+        "bytes_total": scalar(data.get("bytes_total")),
+        "restarts": scalar(data.get("worker_restarts")),
+        "dropped_dup": scalar(data.get("batches_dropped_dup")),
+        "workers": {},
+    }
+    busy = labelled(data.get("worker_busy_frac"))
+    batches = labelled(data.get("batches_total"))
+    for label in sorted(set(busy) | set(batches)):
+        wid = label.split("=", 1)[1] if "=" in label else label
+        out["workers"][wid] = {
+            "busy_frac": busy.get(label),
+            "batches": batches.get(label),
+        }
+    return out
+
+
 def summarize(records, window=200):
     """Aggregate the record stream into the monitor's display fields.
 
@@ -170,6 +212,7 @@ def summarize(records, window=200):
         "health": {},
         "top_ops": [],
         "serving": {},
+        "data": {},
     }
 
     if opprofs:
@@ -233,6 +276,7 @@ def summarize(records, window=200):
             summary["bubble"] = bub.get("bubble")
             summary["bubble_analytic"] = bub.get("analytic")
         summary["serving"] = _serving_summary(metrics)
+        summary["data"] = _data_summary(metrics)
         summary["health"] = dict(last.get("health", {}))
         memrec = last.get("mem", {})
         if memrec.get("mem_peak_bytes"):
@@ -316,6 +360,44 @@ def render(summary):
             "serve/compile cache",
             "%d hit / %d miss" % (cc["hits"], cc["misses"]),
         ))
+    data = summary.get("data") or {}
+    if data:
+        rows.append((
+            "data/ring",
+            "occupancy %s, %s/s (%s total), %s epochs" % (
+                _fmt(data.get("ring_occupancy")),
+                _fmt_bytes(data.get("bytes_per_sec")),
+                _fmt_bytes(data.get("bytes_total")),
+                _fmt(data.get("epochs"), "{:.0f}"),
+            ),
+        ))
+        workers = data.get("workers") or {}
+        if workers:
+            per_worker = " ".join(
+                "w%s:%s@%s" % (
+                    wid,
+                    _fmt(w.get("batches"), "{:.0f}"),
+                    _fmt(w.get("busy_frac"), "{:.0%}"),
+                )
+                for wid, w in sorted(
+                    workers.items(),
+                    key=lambda kv: (len(kv[0]), kv[0]),
+                )
+            )
+            rows.append((
+                "data/workers",
+                "%d reporting | batches@busy: %s" % (
+                    len(workers), per_worker,
+                ),
+            ))
+        if data.get("restarts") or data.get("dropped_dup"):
+            rows.append((
+                "data/recovery",
+                "%s worker restarts, %s dup batches dropped" % (
+                    _fmt(data.get("restarts"), "{:.0f}", "0"),
+                    _fmt(data.get("dropped_dup"), "{:.0f}", "0"),
+                ),
+            ))
     for name in sorted(summary["health"]):
         rows.append(("health/" + name, str(summary["health"][name])))
     for op, total_ms, pct in summary.get("top_ops", []):

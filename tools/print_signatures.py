@@ -41,6 +41,7 @@ MODULES = [
     "paddle_tpu.lod_tensor",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.data",
     "paddle_tpu.contrib",
     "paddle_tpu.contrib.memory_usage_calc",
 ]
