@@ -1145,7 +1145,214 @@ def run_reader_bench(smoke=False, num_workers=None):
     return record
 
 
+def _recsys_build(rows, fields, dim, is_sparse, use_distributed,
+                  optimizer="adam", layer_sizes=(32, 16)):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.models.deepfm import deepfm
+
+    main_p, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+        ids = fluid.layers.data(name="ids", shape=[fields, 1], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+        loss, pred, _ = deepfm(
+            ids, label, num_features=rows, num_fields=fields,
+            embedding_size=dim, layer_sizes=layer_sizes,
+            is_sparse=is_sparse, use_distributed=use_distributed,
+        )
+        if optimizer == "adam":
+            # bf16-stored moments: the TPU-native state precision; per-row
+            # sparse updates gather/cast/scatter them alongside the table
+            fluid.optimizer.Adam(
+                learning_rate=1e-3, moment_dtype="bfloat16"
+            ).minimize(loss)
+        else:
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main_p, startup, loss
+
+
+def _recsys_batches(rng, rows, fields, batch, n):
+    out = []
+    for _ in range(n):
+        ids = rng.randint(0, rows, (batch, fields, 1)).astype("int64")
+        label = (rng.rand(batch, 1) < 0.5).astype("float32")
+        out.append({"ids": ids, "label": label})
+    return out
+
+
+def _recsys_time(run_step, batches, warmup=2, windows=2, steps=6):
+    """min-over-windows ms/step (harness noise only ever adds time)."""
+    for i in range(warmup):
+        l = run_step(batches[i % len(batches)])
+    np.asarray(l)
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            l = run_step(batches[i % len(batches)])
+        np.asarray(l)
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best * 1e3
+
+
+def run_recsys_bench(smoke=False):
+    """Sparse embedding engine evidence pass (PR 8) → BENCH_recsys.json.
+
+    Three legs over the DeepFM CTR model (models/deepfm.py, two shared-id
+    tables fm_first[rows,1] + fm_emb[rows,dim]):
+
+    1. update-cost: dense Adam (full-table moment decay each step) vs
+       is_sparse=True (SelectedRows grads + per-row lazy-Adam updates) on one
+       device at <=1% rows touched per step — the sparse step must be
+       measurably faster since its optimizer cost is O(touched rows);
+    2. ep-sharded throughput: the same sparse model row-sharded over every
+       local device via ParallelExecutor + MeshConfig(ep=n) — headline
+       `embedding_rows_per_sec` (table rows gathered+updated per second,
+       batch*fields*2 tables per step);
+    3. parity: sparse ep-sharded SGD vs dense single-device SGD on identical
+       batches (the engine changes data layout, not math — SGD is
+       bit-exact; see tests/test_deepfm.py for the assertion-grade version).
+
+    Size accounting rides along: table + dense f32 Adam state vs the
+    per-chip share when row-sharded with bf16 moments (the "giant table"
+    claim — the table's dense state does not fit one chip's fair share)."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.parallel import MeshConfig
+
+    n_dev = jax.device_count()
+    if smoke:
+        rows, dim, fields, batch = 4096, 8, 6, 128
+        steps, layer_sizes = 4, (16,)
+    else:
+        rows, dim, fields, batch = 1 << 20, 32, 16, 512
+        steps, layer_sizes = 6, (32, 16)
+    if rows % max(n_dev, 1):
+        rows -= rows % n_dev  # row-sharding needs divisibility
+    rng = np.random.RandomState(0)
+    batches = _recsys_batches(rng, rows, fields, batch, 4)
+    record = {
+        "metric": "recsys_deepfm",
+        "mode": "smoke" if smoke else "full",
+        "table_rows": rows,
+        "embedding_dim": dim,
+        "num_fields": fields,
+        "batch_size": batch,
+        "devices": n_dev,
+        "rows_touched_frac": round(batch * fields / float(rows), 5),
+    }
+
+    exe = fluid.Executor(fluid.TPUPlace())
+
+    # ---- leg 1: dense vs sparse update cost, single device ----------------
+    for key, sparse in (("dense", False), ("sparse", True)):
+        main_p, startup, loss = _recsys_build(
+            rows, fields, dim, is_sparse=sparse, use_distributed=False,
+            layer_sizes=layer_sizes,
+        )
+        with scope_guard(Scope(seed=0)):
+            exe.run(startup)
+            ms = _recsys_time(
+                lambda feed: exe.run(main_p, feed=feed,
+                                     fetch_list=[loss.name],
+                                     return_numpy=False)[0],
+                batches, steps=steps,
+            )
+        record["%s_step_ms_1dev" % key] = round(ms, 2)
+    record["sparse_vs_dense_update_speedup_x"] = round(
+        record["dense_step_ms_1dev"] / record["sparse_step_ms_1dev"], 2
+    )
+
+    # ---- leg 2: ep-sharded sparse throughput ------------------------------
+    sharded_ms = None
+    if n_dev > 1:
+        main_p, startup, loss = _recsys_build(
+            rows, fields, dim, is_sparse=True, use_distributed=True,
+            layer_sizes=layer_sizes,
+        )
+        with scope_guard(Scope(seed=0)):
+            exe.run(startup)
+            pe = fluid.ParallelExecutor(
+                use_cuda=False, loss_name=loss.name, main_program=main_p,
+                mesh_config=MeshConfig(dp=1, ep=n_dev),
+            )
+            sharded_ms = _recsys_time(
+                lambda feed: pe.run([loss.name], feed=feed,
+                                    return_numpy=False)[0],
+                batches, steps=steps,
+            )
+        record["sharded_step_ms_ep%d" % n_dev] = round(sharded_ms, 2)
+    rows_per_step = batch * fields * 2  # both tables gather+update per id
+    best_ms = min(
+        m for m in (sharded_ms, record["sparse_step_ms_1dev"]) if m
+    )
+    record["embedding_rows_per_sec"] = round(rows_per_step / best_ms * 1e3, 1)
+
+    # ---- size accounting: the giant-table claim ---------------------------
+    fbytes = rows * dim * 4
+    table_bytes = fbytes + rows * 1 * 4  # fm_emb + fm_first
+    dense_state = 2 * (fbytes + rows * 4)  # two f32 moment sets, both tables
+    sharded_per_chip = (table_bytes + (fbytes + rows * 4)) // max(n_dev, 1)
+    # table f32 + 2x bf16 moments, row-sharded over the mesh
+    record["table_bytes"] = table_bytes
+    record["dense_opt_state_bytes"] = dense_state
+    record["sharded_table_plus_state_bytes_per_chip"] = sharded_per_chip
+    record["table_over_chip_state_share_x"] = round(
+        (table_bytes + dense_state) / float(sharded_per_chip), 2
+    )
+
+    # ---- leg 3: sparse ep-sharded vs dense 1-dev loss parity (SGD) --------
+    prows, pfields, pdim, pbatch = 2048, 4, 8, 64
+    if prows % max(n_dev, 1):
+        prows -= prows % n_dev
+    prng = np.random.RandomState(7)
+    pbatches = _recsys_batches(prng, prows, pfields, pbatch, 6)
+
+    def parity_losses(distributed):
+        main_p, startup, loss = _recsys_build(
+            prows, pfields, pdim, is_sparse=distributed,
+            use_distributed=distributed, optimizer="sgd", layer_sizes=(16,),
+        )
+        losses = []
+        with scope_guard(Scope(seed=3)):
+            exe.run(startup)
+            if distributed and n_dev > 1:
+                pe = fluid.ParallelExecutor(
+                    use_cuda=False, loss_name=loss.name, main_program=main_p,
+                    mesh_config=MeshConfig(dp=1, ep=n_dev),
+                )
+                step = lambda feed: pe.run([loss.name], feed=feed)[0]
+            else:
+                step = lambda feed: exe.run(
+                    main_p, feed=feed, fetch_list=[loss.name]
+                )[0]
+            for feed in pbatches:
+                losses.append(float(np.asarray(step(feed)).reshape(-1)[0]))
+        return losses
+
+    dense_l = parity_losses(False)
+    sparse_l = parity_losses(True)
+    diff = max(abs(a - b) for a, b in zip(dense_l, sparse_l))
+    record["parity_max_loss_diff"] = round(diff, 6)
+    record["parity_steps"] = len(pbatches)
+    return record
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "recsys":
+        # sparse-embedding-engine evidence pass (PR 8): writes
+        # BENCH_recsys.json next to this file; "smoke" keeps sizes CPU-CI
+        # friendly and skips the tracked-metric file
+        smoke = "smoke" in sys.argv[2:]
+        rec = run_recsys_bench(smoke=smoke)
+        if not smoke:
+            out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_recsys.json")
+            with open(out, "w") as f:
+                json.dump(rec, f, indent=1)
+        print(json.dumps(rec, indent=1))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "reader":
         # reader-pipeline evidence pass (ISSUE 7): uncached uint8-image and
         # token paths with and without the native data runtime; "smoke"

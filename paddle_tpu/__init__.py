@@ -38,6 +38,7 @@ from . import lod_tensor  # noqa: F401
 from . import contrib  # noqa: F401
 from . import inference  # noqa: F401
 from . import serving  # noqa: F401
+from . import embedding  # noqa: F401
 from . import flags  # noqa: F401
 from .flags import get_flags, set_flags
 from .lod_tensor import create_lod_tensor, create_random_int_lodtensor

@@ -98,6 +98,21 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
             if not contribs:
                 return None
             canonical = grad_var_name(name)
+            if len(contribs) > 1 and any(
+                getattr(block.vars.get(c), "is_selected_rows", False)
+                for c in contribs
+            ):
+                # the sparse grad maker (ops/sparse_ops.py) only emits a
+                # SelectedRows grad for single-consumer tables, so this is a
+                # bug guard, not a reachable path: `sum` over mixed
+                # dense/SelectedRows contributions would silently add a
+                # (cap, dim) values array to a (rows, dim) gradient
+                raise ValueError(
+                    "gradient of %r has %d contributions including a "
+                    "SelectedRows (sparse) one — sparse grads cannot be "
+                    "sum-merged; use is_sparse=False for multiply-consumed "
+                    "tables" % (name, len(contribs))
+                )
             if len(contribs) == 1:
                 if contribs[0] != canonical:
                     # single contribution under a renamed var: alias via assign

@@ -152,9 +152,16 @@ def append_gradient_clip_ops(param_grads):
     context = {}
     clips = []
     program = default_main_program()
-    for p, g in param_grads:
-        if g is None:
-            continue
+    # SelectedRows (sparse) grads pass through unclipped: the clip ops are
+    # dense rewrites, and norm-clipping a fixed-capacity values array with
+    # duplicate rows would mis-measure the true gradient anyway (the
+    # reference's ClipGradByGlobalNorm likewise ignored SelectedRows)
+    dense = [
+        pg
+        for pg in param_grads
+        if pg[1] is not None and not getattr(pg[1], "is_selected_rows", False)
+    ]
+    for p, g in dense:
         with program._optimized_guard([p, g]):
             clip_attr = getattr(p, "gradient_clip_attr", None) or _gradient_clip_attr
             if clip_attr is None:
@@ -163,10 +170,10 @@ def append_gradient_clip_ops(param_grads):
             clips.append(clip_attr)
 
     res = []
-    for (p, g), clip_attr in zip([pg for pg in param_grads if pg[1] is not None], clips):
+    for (p, g), clip_attr in zip(dense, clips):
         with program._optimized_guard([p, g]):
             res.append(clip_attr._create_operators(param=p, grad=g))
     for p, g in param_grads:
-        if g is None:
+        if g is None or getattr(g, "is_selected_rows", False):
             res.append((p, g))
     return res

@@ -1,0 +1,29 @@
+"""paddle_tpu.embedding — sparse embedding engine for recsys-scale tables.
+
+The TPU-native replacement for the reference's pserver distributed lookup
+table (SURVEY.md §2.7.5): row-sharded tables over the mesh `ep` axis,
+SelectedRows-style sparse gradients whose cost scales with touched rows, and
+per-row optimizer updates with row-sharded moments. See docs/embedding.md.
+"""
+
+from .engine import EmbeddingEngine
+from .lookup import sharded_embedding_lookup
+from .selected_rows import (
+    ROW_SENTINEL,
+    densify,
+    is_selected_rows,
+    mark_selected_rows,
+    merge_rows,
+    rows_var_name,
+)
+
+__all__ = [
+    "EmbeddingEngine",
+    "sharded_embedding_lookup",
+    "ROW_SENTINEL",
+    "densify",
+    "is_selected_rows",
+    "mark_selected_rows",
+    "merge_rows",
+    "rows_var_name",
+]

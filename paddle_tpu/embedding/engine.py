@@ -1,0 +1,258 @@
+"""EmbeddingEngine — row-sharded giant embedding tables as one object.
+
+Reference analog: the distributed lookup table stack — lookup_table_op.cc
+with is_distributed, distribute_transpiler._split_table_grad_and_add_send_vars
+sharding the table across pservers, parameter_prefetch.cc fetching rows by
+RPC, and lookup_sparse_table_op growing rows on demand. The TPU redesign
+collapses that machinery into one engine that owns:
+
+- **table creation**: one Parameter annotated `sharding_spec=(axis, None)` so
+  ParallelExecutor stores it row-sharded over the mesh's `ep` axis (GSPMD
+  placement, executor._CompiledBlock.state_sharding) — no pserver processes;
+- **forward**: the `distributed_lookup_table` op → gather over the local
+  shard + one psum (embedding/lookup.py) instead of an RPC prefetch;
+- **sparse backward**: `is_sparse=True` routes lookup_table_grad through the
+  SelectedRows analog (selected_rows.py) and per-row optimizer updates
+  (ops/sparse_ops.py) whose cost scales with ids-per-batch, not table rows;
+- **sharded checkpoints**: save/load the table plus its row-aligned optimizer
+  accumulators as N row-range shards with a manifest — the analog of the
+  pserver-side checkpoint_notify/table recovery, but just files.
+
+A table qualifies as "giant" when its dense optimizer state would not fit one
+chip; `state_bytes_per_device` quantifies that and feeds the embedding/
+gauges (observability registry) and BENCH_recsys.json.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from .. import framework
+from ..framework import default_main_program
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = ["EmbeddingEngine"]
+
+_MANIFEST = "EMBEDDING_MANIFEST.json"
+
+
+def _registry():
+    from ..observability.registry import default_registry
+
+    return default_registry()
+
+
+class EmbeddingEngine:
+    """One row-sharded embedding table + its training state.
+
+    Build-time (inside a program_guard): creates the Parameter and appends
+    lookup ops. Run-time (with a Scope): sharded checkpoint save/load and
+    byte accounting. The same program runs on any mesh — the op lowerings
+    fall back to the exact single-device computation when the mesh has no
+    `axis_name` extent (ops/parallel_ops.py).
+    """
+
+    def __init__(
+        self,
+        name,
+        num_rows,
+        dim,
+        dtype="float32",
+        axis_name="ep",
+        padding_idx=None,
+        is_sparse=True,
+        param_attr=None,
+    ):
+        from ..parallel import shard_parameter
+
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.dtype = dtype
+        self.axis_name = axis_name
+        self.is_sparse = bool(is_sparse)
+        # normalize like layers.embedding: -1 means "no padding row"
+        self.padding_idx = (
+            -1
+            if padding_idx is None
+            else int(padding_idx)
+            if padding_idx >= 0
+            else self.num_rows + int(padding_idx)
+        )
+        helper = LayerHelper("embedding_engine")
+        attr = param_attr if param_attr is not None else ParamAttr(name=name)
+        self.table = helper.create_parameter(
+            attr=attr, shape=[self.num_rows, self.dim], dtype=dtype, is_bias=False
+        )
+        shard_parameter(self.table, (axis_name, None))
+        self.name = name if name is not None else self.table.name
+        self._emit_static_gauges()
+
+    # ------------------------------------------------------------------ build
+    def lookup(self, ids):
+        """Append the sharded lookup; returns (ids.shape…, dim) activations.
+        ids with a trailing extent-1 dim have it folded away, like the dense
+        lookup_table op."""
+        helper = LayerHelper("embedding_engine")
+        out = helper.create_variable_for_type_inference(self.dtype)
+        helper.append_op(
+            type="distributed_lookup_table",
+            inputs={"W": [self.table.name], "Ids": [ids.name]},
+            outputs={"Out": [out.name]},
+            attrs={
+                "axis_name": self.axis_name,
+                "padding_idx": self.padding_idx,
+                "is_sparse": self.is_sparse,
+            },
+        )
+        if getattr(ids, "_len_name", None):
+            out._len_name = ids._len_name
+        return out
+
+    # ------------------------------------------------------------- accounting
+    def state_var_names(self, program=None):
+        """The table plus every row-aligned accumulator the optimizer hung off
+        it (moment vars share the table's (num_rows, dim) shape and its
+        `<table>_<slot>_acc` name prefix — optimizer._add_accumulator). Scalar
+        state (beta pows) is excluded: it is replicated, not row-sharded."""
+        block = (program or default_main_program()).global_block()
+        names = [self.table.name]
+        prefix = self.table.name + "_"
+        for v in block.vars.values():
+            # accumulator names are `<param>_<slot>_acc_<k>` (unique_name)
+            if (
+                v.name.startswith(prefix)
+                and "_acc" in v.name
+                and tuple(v.shape or ()) == (self.num_rows, self.dim)
+            ):
+                names.append(v.name)
+        return names
+
+    def table_bytes(self):
+        return self.num_rows * self.dim * _dtype_bytes(self.dtype)
+
+    def state_bytes_per_device(self, num_devices, program=None, scope=None):
+        """Per-chip HBM bytes for the table + row-aligned accumulators when
+        row-sharded over `num_devices` (the engine's placement). Compare with
+        num_devices=1 for the dense-resident requirement."""
+        total = 0
+        block = (program or default_main_program()).global_block()
+        for n in self.state_var_names(program):
+            v = block.vars[n]
+            total += self.num_rows * self.dim * _dtype_bytes(v.dtype)
+        return total // max(1, int(num_devices))
+
+    def _emit_static_gauges(self):
+        try:
+            _registry().gauge(
+                "embedding/table_rows",
+                help="rows in the sharded embedding table",
+            ).set(float(self.num_rows), table=self.name)
+            _registry().gauge(
+                "embedding/table_bytes",
+                help="global HBM bytes of the table (divide by ep for per-shard)",
+            ).set(float(self.table_bytes()), table=self.name)
+        except Exception:
+            pass  # observability must never break model build
+
+    # ------------------------------------------------------------ checkpoints
+    def save_sharded(self, scope, dirname, num_shards=1, program=None):
+        """Write the table and its row-aligned optimizer state as `num_shards`
+        row-range .npz shards + a manifest. Shard k holds rows
+        [k*rows/N, (k+1)*rows/N) of every array — the layout a future
+        multi-host restore reads back per-host without touching other shards
+        (the pserver checkpoint sharding, made into plain files). bf16 arrays
+        are stored as f32 (lossless widening) and cast back on load."""
+        os.makedirs(dirname, exist_ok=True)
+        names = self.state_var_names(program)
+        num_shards = int(num_shards)
+        if self.num_rows % num_shards:
+            raise ValueError(
+                "num_rows=%d not divisible by num_shards=%d"
+                % (self.num_rows, num_shards)
+            )
+        rows_per = self.num_rows // num_shards
+        dtypes = {}
+        arrays = {}
+        for n in names:
+            a = np.asarray(scope.find_var(n))
+            if a.shape != (self.num_rows, self.dim):
+                raise ValueError(
+                    "scope var %r has shape %s, expected %s"
+                    % (n, a.shape, (self.num_rows, self.dim))
+                )
+            dtypes[n] = str(a.dtype)
+            if "bfloat16" in str(a.dtype):
+                a = a.astype(np.float32)
+            arrays[n] = a
+        for k in range(num_shards):
+            lo, hi = k * rows_per, (k + 1) * rows_per
+            np.savez(
+                os.path.join(dirname, _shard_file(k, num_shards)),
+                **{n: arrays[n][lo:hi] for n in names},
+            )
+        manifest = {
+            "name": self.name,
+            "table": self.table.name,
+            "num_rows": self.num_rows,
+            "dim": self.dim,
+            "num_shards": num_shards,
+            "row_ranges": [
+                [k * rows_per, (k + 1) * rows_per] for k in range(num_shards)
+            ],
+            "arrays": dtypes,
+            "version": 1,
+        }
+        tmp = os.path.join(dirname, _MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(tmp, os.path.join(dirname, _MANIFEST))
+        return manifest
+
+    def load_sharded(self, scope, dirname):
+        """Reassemble every array from its row-range shards into the scope.
+        The next executor run re-places them onto the mesh (state_sharding),
+        so the on-disk shard count is independent of the run-time ep size."""
+        manifest = self.read_manifest(dirname)
+        if manifest["num_rows"] != self.num_rows or manifest["dim"] != self.dim:
+            raise ValueError(
+                "checkpoint table is %dx%d, engine is %dx%d"
+                % (
+                    manifest["num_rows"],
+                    manifest["dim"],
+                    self.num_rows,
+                    self.dim,
+                )
+            )
+        num_shards = manifest["num_shards"]
+        shards = [
+            np.load(os.path.join(dirname, _shard_file(k, num_shards)))
+            for k in range(num_shards)
+        ]
+        for n, dt in manifest["arrays"].items():
+            full = np.concatenate([s[n] for s in shards], axis=0)
+            if "bfloat16" in dt:
+                import jax.numpy as jnp
+
+                full = jnp.asarray(full, dtype=jnp.bfloat16)
+            scope.vars[n] = full
+        return manifest
+
+    @staticmethod
+    def read_manifest(dirname):
+        with open(os.path.join(dirname, _MANIFEST)) as f:
+            return json.load(f)
+
+
+def _shard_file(k, n):
+    return "embedding-%05d-of-%05d.npz" % (k, n)
+
+
+def _dtype_bytes(dtype):
+    d = str(dtype)
+    if "bfloat16" in d or d in ("float16", "f16"):
+        return 2
+    if d in ("float64", "int64", "f64"):
+        return 8
+    return 4

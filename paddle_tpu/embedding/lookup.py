@@ -1,0 +1,65 @@
+"""Row-sharded embedding lookup (the forward gather+psum leg of the engine).
+
+Reference analog: the distributed lookup table (SURVEY.md §2.7.5) — a
+high-dimensional embedding sharded across parameter servers, rows fetched by
+RPC prefetch (distributed/parameter_prefetch.cc:26) and gradients pushed as
+SelectedRows. TPU-native redesign: the table is row-sharded over a mesh axis;
+each rank gathers its local hits (out-of-range ids produce zeros) and a psum
+over the axis combines them — one ICI collective instead of an RPC round trip.
+
+Semantics match the dense lookup_table op (ops/core_ops.py) exactly:
+negative ids and padding_idx rows produce zeros, and the zero-masking
+preserves the table dtype (a bf16/fp16 table must not come back f32 — the
+old `jnp.where(..., 0.0)` could upcast under strict promotion rules and,
+worse, silently doubled the activation's HBM footprint).
+"""
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.collectives import shard_map
+
+__all__ = ["sharded_embedding_lookup"]
+
+
+def _local_lookup(table_shard, ids, axis_name, padding_idx=None):
+    """table_shard: (rows_local, d); ids: global int ids, any shape."""
+    rows_local = table_shard.shape[0]
+    me = lax.axis_index(axis_name)
+    offset = me * rows_local
+    flat = ids.reshape(-1).astype(jnp.int32)
+    local = flat - offset
+    # negative global ids are padding/masked slots (AsyncExecutor's bucketed
+    # batches): zero rows everywhere, like the dense op
+    in_range = (local >= 0) & (local < rows_local) & (flat >= 0)
+    if padding_idx is not None and int(padding_idx) != -1:
+        in_range = in_range & (flat != jnp.int32(padding_idx))
+    safe = jnp.clip(local, 0, rows_local - 1)
+    picked = jnp.take(table_shard, safe, axis=0)
+    zero = jnp.zeros((), picked.dtype)
+    picked = jnp.where(in_range[:, None], picked, zero)
+    out = picked.reshape(ids.shape + (table_shard.shape[1],))
+    return lax.psum(out, axis_name)
+
+
+def sharded_embedding_lookup(table, ids, mesh, axis_name="ep", padding_idx=None):
+    """table: (rows, d) global array sharded on rows over `axis_name`;
+    ids: int array whose leading dim is the batch — kept sharded over 'dp'
+    (when the mesh has it) so per-device work scales with batch/dp, not the
+    global batch. Returns (ids.shape..., d) with the same dp sharding.
+
+    padding_idx: already-normalized non-negative row index (or None/-1) whose
+    looked-up rows are zeros, matching the dense lookup_table attr."""
+    batch_spec = P(("dp",)) if "dp" in mesh.shape else P()
+    fn = shard_map(
+        functools.partial(
+            _local_lookup, axis_name=axis_name, padding_idx=padding_idx
+        ),
+        mesh=mesh,
+        in_specs=(P((axis_name,), None), batch_spec),
+        out_specs=batch_spec,
+    )
+    return fn(table, ids)

@@ -1,0 +1,102 @@
+"""SelectedRows — the sparse-gradient side structure, TPU-native.
+
+Reference analog: paddle/fluid/framework/selected_rows.h — a (rows, value)
+pair where `rows` lists the touched table rows and `value` holds one gradient
+row per entry; lookup_table_grad emits it when is_sparse=True, and the sparse
+optimizer kernels (sgd_op.h SparseSGDFunctor, adam_op.h SparseAdamFunctor)
+scatter only those rows. The pserver wire carried the same pair
+(sendrecvop_utils.cc SerializeToByteBuffer).
+
+On TPU the structure cannot be a dynamic ragged tensor — XLA shapes are
+static — so the analog is a *fixed-capacity* pair carried through the Program
+as two ordinary Variables:
+
+- values `<W>@GRAD`      : (capacity, dim), the cotangent rows, in the
+                           cotangent's dtype (bf16 stays bf16 on the wire);
+- rows   `<W>@GRAD@ROWS` : (capacity,) int32 global row ids, with
+                           ROW_SENTINEL (-1) for slots that must not
+                           contribute (negative/masked ids, padding_idx).
+
+`capacity` is the number of id slots in the step's batch (ids.size), so the
+memory/wire cost is O(batch * dim) instead of O(table_rows * dim) — the whole
+point of SelectedRows. Duplicate ids are NOT pre-merged in the grad op;
+`merge_rows` (the merge_selected_rows analog, operators/math/
+selected_rows_functor.cc MergeAdd) runs inside the optimizer lowering where
+the f32 accumulation is needed anyway.
+
+The values Variable is flagged in-Program (`is_selected_rows=True`, plus the
+rows var name and the table height) so backward.py, clip.py, regularizer.py
+and optimizer.py can recognise and route it without a new IR node type.
+"""
+
+import jax.numpy as jnp
+
+ROW_SENTINEL = -1
+
+__all__ = [
+    "ROW_SENTINEL",
+    "mark_selected_rows",
+    "is_selected_rows",
+    "rows_var_name",
+    "merge_rows",
+    "densify",
+]
+
+
+def mark_selected_rows(values_var, rows_name, height):
+    """Flag a Program Variable as the values half of a SelectedRows pair."""
+    values_var.is_selected_rows = True
+    values_var.selected_rows_rows = rows_name
+    values_var.selected_rows_height = int(height)
+    return values_var
+
+
+def is_selected_rows(var):
+    return bool(getattr(var, "is_selected_rows", False))
+
+
+def rows_var_name(values_name):
+    """Canonical rows-var name for a values var (reference kept both inside
+    one SelectedRows object; here they are sibling Variables)."""
+    return values_name + "@ROWS"
+
+
+def merge_rows(rows, values, height):
+    """Deduplicate rows and sum their value rows — MergeAdd, statically
+    shaped. Returns (uniq, summed):
+
+    - uniq   : (capacity,) int32, sorted unique row ids; sentinel/invalid
+               slots map to `height` (one past the last row) and unused
+               unique slots are filled with `height` too, so a single
+               OOB-dropping scatter handles both.
+    - summed : (capacity, dim) f32 — per-unique-row gradient sums. The f32
+               accumulator is the same bf16-swamping defence as the dense
+               lookup_table_grad (core_ops.py): repeated ids add exactly.
+    """
+    cap = int(rows.shape[0])
+    rows_m = jnp.where(rows < 0, height, rows).astype(jnp.int32)
+    uniq, inv = jnp.unique(
+        rows_m, size=cap, fill_value=height, return_inverse=True
+    )
+    inv = inv.reshape(-1)
+    summed = (
+        jnp.zeros((cap, values.shape[1]), jnp.float32)
+        .at[inv]
+        .add(values.astype(jnp.float32))
+    )
+    return uniq.astype(jnp.int32), summed
+
+
+def densify(rows, values, height, dtype=None):
+    """Scatter a SelectedRows pair into a dense (height, dim) gradient —
+    the reference's SelectedRows→LoDTensor merge for optimizers without a
+    sparse kernel. f32 accumulation, cast once at the end."""
+    dtype = dtype or values.dtype
+    cap = rows.shape[0]
+    safe = jnp.where(rows < 0, height, rows).astype(jnp.int32)
+    dense = (
+        jnp.zeros((height, values.shape[1]), jnp.float32)
+        .at[safe]
+        .add(values.astype(jnp.float32), mode="drop")
+    )
+    return dense.astype(dtype)

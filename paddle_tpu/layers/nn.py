@@ -158,9 +158,21 @@ def embedding(
     dtype="float32",
 ):
     """Embedding lookup (reference layers/nn.py embedding → lookup_table op).
-    `is_sparse` selects SelectedRows-style gradients in the reference; on TPU
-    the gradient is a dense scatter-add fused by XLA, and sharded tables are
-    provided by the parallel embedding path (parallel/)."""
+    `is_sparse=True` routes the gradient through the SelectedRows analog
+    (paddle_tpu/embedding/): a (rows, values) pair whose size scales with
+    ids-per-batch, consumed by per-row sgd/adagrad/adam updates — use it for
+    big tables touched sparsely. Dense gradients (the default) stay a single
+    fused scatter-add. `is_distributed=True` row-shards the table over the
+    mesh 'ep' axis via the EmbeddingEngine."""
+    if is_distributed:
+        return distributed_embedding(
+            input,
+            size,
+            param_attr=param_attr,
+            dtype=dtype,
+            is_sparse=is_sparse,
+            padding_idx=padding_idx,
+        )
     helper = LayerHelper("embedding", **locals())
     w = helper.create_parameter(
         attr=helper.param_attr, shape=size, dtype=dtype, is_bias=False
@@ -1150,26 +1162,34 @@ def ring_attention(q, k, v, causal=False, axis_name="sp", name=None):
 
 
 def distributed_embedding(
-    input, size, param_attr=None, dtype="float32", axis_name="ep", name=None
+    input,
+    size,
+    param_attr=None,
+    dtype="float32",
+    axis_name="ep",
+    is_sparse=True,
+    padding_idx=None,
+    name=None,
 ):
     """Row-sharded embedding (the reference's distributed lookup table,
-    SURVEY.md §2.7.5, re-done as mesh-sharded rows + psum). The table param is
-    annotated to shard over `axis_name`."""
-    from ..parallel import shard_parameter
+    SURVEY.md §2.7.5) on the EmbeddingEngine (paddle_tpu/embedding/): the
+    table param shards over `axis_name`, the forward is a local gather + one
+    psum, and with `is_sparse` (default) the backward emits a SelectedRows
+    pair consumed by per-row optimizer updates with row-sharded moments —
+    wire/HBM cost O(ids-per-batch) instead of O(table rows)."""
+    from ..embedding import EmbeddingEngine
 
-    helper = LayerHelper("distributed_embedding", name=name)
-    w = helper.create_parameter(
-        attr=param_attr, shape=size, dtype=dtype, is_bias=False
+    engine = EmbeddingEngine(
+        name=name,
+        num_rows=size[0],
+        dim=size[1],
+        dtype=dtype,
+        axis_name=axis_name,
+        padding_idx=padding_idx,
+        is_sparse=is_sparse,
+        param_attr=param_attr,
     )
-    shard_parameter(w, (axis_name, None))
-    out = helper.create_variable_for_type_inference(dtype)
-    helper.append_op(
-        type="distributed_lookup_table",
-        inputs={"W": [w.name], "Ids": [input.name]},
-        outputs={"Out": [out.name]},
-        attrs={"axis_name": axis_name},
-    )
-    return out
+    return engine.lookup(input)
 
 
 def autoincreased_step_counter(counter_name=None, begin=1, step=1):

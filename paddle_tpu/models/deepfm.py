@@ -1,9 +1,16 @@
 """DeepFM CTR model (BASELINE config 4; the reference era's CTR tier —
 dist_ctr.py / deep-and-wide models built on sparse lookup_table + logloss +
 AUC). FM second-order term uses the sum-square identity
-0.5 * ((Σv)² − Σv²) so everything is one dense XLA computation; embedding
-gradients are fused scatter-adds (SelectedRows' TPU-native equivalent —
-SURVEY.md §7.7), and sharded tables come from the parallel embedding path."""
+0.5 * ((Σv)² − Σv²) so everything is one dense XLA computation.
+
+Embedding routing (the PR 8 sparse engine, paddle_tpu/embedding/):
+- `is_sparse=True` makes both tables' gradients SelectedRows pairs with
+  per-row optimizer updates — cost O(batch·fields·dim), not O(num_features);
+- `use_distributed=True` row-shards both tables over the mesh `axis_name`
+  (EmbeddingEngine; requires num_features divisible by the axis extent);
+- `hash_size=N` routes raw ids through the PR 3 `hash` op (XXH32 mod N) so
+  an unbounded id space feeds a fixed-size table, and the tables are sized
+  by hash_size instead of num_features."""
 
 from .. import layers
 from ..param_attr import ParamAttr
@@ -16,22 +23,41 @@ def deepfm(
     num_fields=10,
     embedding_size=8,
     layer_sizes=(64, 32),
+    is_sparse=False,
+    use_distributed=False,
+    axis_name="ep",
+    hash_size=None,
 ):
     """feat_ids: (b, num_fields, 1) int ids into a shared feature space."""
+    if hash_size is not None:
+        # (b*f, num_hash=1, 1) bucket ids -> back to (b, f, 1)
+        flat = layers.reshape(feat_ids, [-1, 1])
+        hashed = layers.hash(flat, hash_size=hash_size, num_hash=1)
+        feat_ids = layers.reshape(hashed, [-1, num_fields, 1])
+        num_features = hash_size
+
+    def table(size, name):
+        if use_distributed:
+            return layers.distributed_embedding(
+                feat_ids,
+                size=size,
+                param_attr=ParamAttr(name=name),
+                axis_name=axis_name,
+                is_sparse=is_sparse,
+            )
+        return layers.embedding(
+            feat_ids,
+            size=size,
+            is_sparse=is_sparse,
+            param_attr=ParamAttr(name=name),
+        )
+
     # first-order term: per-feature scalar weights
-    first_emb = layers.embedding(
-        feat_ids,
-        size=[num_features, 1],
-        param_attr=ParamAttr(name="fm_first"),
-    )  # (b, f, 1)
+    first_emb = table([num_features, 1], "fm_first")  # (b, f, 1)
     y_first = layers.reduce_sum(layers.reshape(first_emb, [0, num_fields]), dim=[1], keep_dim=True)
 
     # second-order term via sum-square trick
-    emb = layers.embedding(
-        feat_ids,
-        size=[num_features, embedding_size],
-        param_attr=ParamAttr(name="fm_emb"),
-    )  # (b, f, k)
+    emb = table([num_features, embedding_size], "fm_emb")  # (b, f, k)
     summed = layers.reduce_sum(emb, dim=[1])  # (b, k)
     sum_sq = layers.square(summed)
     sq_sum = layers.reduce_sum(layers.square(emb), dim=[1])

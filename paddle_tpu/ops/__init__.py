@@ -4,6 +4,7 @@ from . import registry
 from . import core_ops  # noqa: F401 — registration side effects
 from . import sequence_ops  # noqa: F401 — registration side effects
 from . import parallel_ops  # noqa: F401 — registration side effects
+from . import sparse_ops  # noqa: F401 — registration side effects (after core/parallel: attaches lookup grad makers)
 from . import control_flow_ops  # noqa: F401 — registration side effects
 from . import loss_ops  # noqa: F401 — registration side effects
 from . import decode_ops  # noqa: F401 — registration side effects

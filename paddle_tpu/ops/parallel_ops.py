@@ -10,8 +10,8 @@ anywhere.
 
 import jax.numpy as jnp
 
+from ..embedding.lookup import sharded_embedding_lookup
 from ..parallel.ring_attention import ring_attention, ring_attention_sharded
-from ..parallel.sharded_embedding import sharded_embedding_lookup
 from .registry import register
 
 
@@ -32,15 +32,30 @@ def _ring_attention(ctx, ins, attrs):
 
 @register("distributed_lookup_table")
 def _distributed_lookup_table(ctx, ins, attrs):
+    """Forward of embedding.EmbeddingEngine.lookup: row-sharded gather+psum
+    over `axis_name` when the mesh has it, otherwise the exact dense lookup.
+    Semantics match lookup_table (negative ids and padding_idx → zero rows,
+    table dtype preserved) so the single-device fallback and the sharded path
+    are numerically interchangeable."""
     (w,) = ins["W"]
     (ids,) = ins["Ids"]
     axis = attrs.get("axis_name", "ep")
+    padding_idx = int(attrs.get("padding_idx", -1))
     flat = ids.reshape(ids.shape[:-1]) if ids.shape[-1] == 1 else ids
     mesh = ctx.mesh
     if mesh is not None and mesh.shape.get(axis, 1) > 1:
-        out = sharded_embedding_lookup(w, flat.astype(jnp.int32), mesh, axis_name=axis)
-    else:
-        out = jnp.take(w, flat.reshape(-1).astype(jnp.int32), axis=0).reshape(
-            flat.shape + (w.shape[1],)
+        out = sharded_embedding_lookup(
+            w, flat.astype(jnp.int32), mesh, axis_name=axis,
+            padding_idx=padding_idx if padding_idx != -1 else None,
         )
+    else:
+        fl = flat.reshape(-1).astype(jnp.int32)
+        out = jnp.take(w, fl, axis=0)
+        zero = jnp.zeros((), out.dtype)
+        mask = fl < 0
+        if padding_idx != -1:
+            pad = padding_idx if padding_idx >= 0 else padding_idx + w.shape[0]
+            mask = mask | (fl == pad)
+        out = jnp.where(mask[:, None], zero, out)
+        out = out.reshape(flat.shape + (w.shape[1],))
     return {"Out": [out]}

@@ -48,6 +48,53 @@ __all__ = [
 ]
 
 
+def _is_selected_rows(grad):
+    """True when backward emitted this grad as a SelectedRows pair
+    (embedding/selected_rows.py — is_sparse=True lookup tables)."""
+    return bool(getattr(grad, "is_selected_rows", False))
+
+
+def _param_shard_axis(param):
+    """Mesh axis the param is row-sharded over ('' when unsharded) — forwarded
+    to the sparse update op so it shard_maps the scatter per-rank."""
+    spec = getattr(param, "sharding_spec", None)
+    if spec:
+        first = spec[0]
+        if isinstance(first, (tuple, list)):
+            first = first[0] if first else None
+        if isinstance(first, str):
+            return first
+    return ""
+
+
+def _sparse_grad_io(param, grad):
+    """Extra inputs/attrs every *_sparse optimizer op takes."""
+    inputs = {"GradRows": [grad.selected_rows_rows]}
+    attrs = {
+        "axis_name": _param_shard_axis(param),
+        "param": param.name,
+    }
+    return inputs, attrs
+
+
+def _densify_grad(block, param, grad):
+    """SelectedRows → dense (rows, dim) grad for optimizers without a sparse
+    kernel. Keeps correctness, loses the O(touched-rows) cost."""
+    dense = block.create_var(
+        name=unique_name.generate(grad.name + "@DENSE"),
+        shape=param.shape,
+        dtype=grad.dtype,
+        persistable=False,
+    )
+    block.append_op(
+        type="selected_rows_to_dense",
+        inputs={"X": [grad.name], "Rows": [grad.selected_rows_rows]},
+        outputs={"Out": [dense.name]},
+        attrs={"height": int(param.shape[0])},
+    )
+    return dense
+
+
 class Optimizer:
     def __init__(self, learning_rate, regularization=None, name=None):
         if not isinstance(learning_rate, (float, Variable)):
@@ -110,6 +157,14 @@ class Optimizer:
             name=var_name, shape=shape, dtype=dtype, persistable=True
         )
         var.stop_gradient = True
+        # same-shape accumulators inherit the param's mesh placement: a
+        # row-sharded embedding table (sharding_spec=("ep", None)) gets its
+        # moments row-sharded alongside it — the ZeRO-along-ep composition
+        # (executor.state_sharding reads this spec); scalar accumulators
+        # (beta pows, shape [1]) stay replicated
+        spec = getattr(param, "sharding_spec", None)
+        if spec is not None and list(shape) == list(param.shape):
+            var.sharding_spec = tuple(spec)
         startup = default_startup_program().global_block()
         sv = startup.create_var(
             name=var_name, shape=shape, dtype=dtype, persistable=True
@@ -132,6 +187,8 @@ class Optimizer:
         pass
 
     def _create_optimization_pass(self, parameters_and_grads):
+        from .ops.sparse_ops import SPARSE_OPTIMIZER_TYPES
+
         program = default_main_program()
         block = program.global_block()
         self.helper = LayerHelper(self.__class__.__name__)
@@ -143,6 +200,17 @@ class Optimizer:
         for param_and_grad in parameters_and_grads:
             if param_and_grad[1] is None:
                 continue
+            if _is_selected_rows(param_and_grad[1]) and (
+                getattr(self, "type", None) not in SPARSE_OPTIMIZER_TYPES
+            ):
+                # no per-row kernel for this optimizer: densify the
+                # SelectedRows pair first (reference merges SelectedRows to
+                # LoDTensor before a dense apply the same way)
+                with program._optimized_guard(param_and_grad):
+                    param_and_grad = (
+                        param_and_grad[0],
+                        _densify_grad(block, *param_and_grad),
+                    )
             with program._optimized_guard(param_and_grad):
                 op = self._append_optimize_op(block, param_and_grad)
                 optimize_ops.append(op)
@@ -177,13 +245,23 @@ class SGDOptimizer(Optimizer):
 
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
+        inputs = {
+            "Param": [p.name],
+            "Grad": [g.name],
+            "LearningRate": [self._create_param_lr(param_and_grad).name],
+        }
+        if _is_selected_rows(g):
+            sp_in, sp_attrs = _sparse_grad_io(p, g)
+            inputs.update(sp_in)
+            return block.append_op(
+                type="sgd_sparse",
+                inputs=inputs,
+                outputs={"ParamOut": [p.name]},
+                attrs=sp_attrs,
+            )
         return block.append_op(
             type="sgd",
-            inputs={
-                "Param": [p.name],
-                "Grad": [g.name],
-                "LearningRate": [self._create_param_lr(param_and_grad).name],
-            },
+            inputs=inputs,
             outputs={"ParamOut": [p.name]},
         )
 
@@ -270,16 +348,24 @@ class AdagradOptimizer(Optimizer):
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
         moment = self._get_accumulator(self._moment_acc_str, p)
+        inputs = {
+            "Param": [p.name],
+            "Grad": [g.name],
+            "Moment": [moment.name],
+            "LearningRate": [self._create_param_lr(param_and_grad).name],
+        }
+        attrs = {"epsilon": self._epsilon}
+        op_type = "adagrad"
+        if _is_selected_rows(g):
+            sp_in, sp_attrs = _sparse_grad_io(p, g)
+            inputs.update(sp_in)
+            attrs.update(sp_attrs)
+            op_type = "adagrad_sparse"
         return block.append_op(
-            type="adagrad",
-            inputs={
-                "Param": [p.name],
-                "Grad": [g.name],
-                "Moment": [moment.name],
-                "LearningRate": [self._create_param_lr(param_and_grad).name],
-            },
+            type=op_type,
+            inputs=inputs,
             outputs={"ParamOut": [p.name], "MomentOut": [moment.name]},
-            attrs={"epsilon": self._epsilon},
+            attrs=attrs,
         )
 
 
@@ -336,27 +422,37 @@ class AdamOptimizer(Optimizer):
         m2 = self._get_accumulator(self._moment2_acc_str, p)
         b1p = self._get_accumulator(self._beta1_pow_acc_str, p)
         b2p = self._get_accumulator(self._beta2_pow_acc_str, p)
+        inputs = {
+            "Param": [p.name],
+            "Grad": [g.name],
+            "LearningRate": [self._create_param_lr(param_and_grad).name],
+            "Moment1": [m1.name],
+            "Moment2": [m2.name],
+            "Beta1Pow": [b1p.name],
+            "Beta2Pow": [b2p.name],
+        }
+        attrs = {
+            "beta1": self._beta1,
+            "beta2": self._beta2,
+            "epsilon": self._epsilon,
+        }
+        op_type = "adam"
+        if _is_selected_rows(g):
+            # lazy Adam (reference adam_op SparseAdamFunctor lazy_mode):
+            # untouched rows' params AND moments stay frozen this step
+            sp_in, sp_attrs = _sparse_grad_io(p, g)
+            inputs.update(sp_in)
+            attrs.update(sp_attrs)
+            op_type = "adam_sparse"
         return block.append_op(
-            type="adam",
-            inputs={
-                "Param": [p.name],
-                "Grad": [g.name],
-                "LearningRate": [self._create_param_lr(param_and_grad).name],
-                "Moment1": [m1.name],
-                "Moment2": [m2.name],
-                "Beta1Pow": [b1p.name],
-                "Beta2Pow": [b2p.name],
-            },
+            type=op_type,
+            inputs=inputs,
             outputs={
                 "ParamOut": [p.name],
                 "Moment1Out": [m1.name],
                 "Moment2Out": [m2.name],
             },
-            attrs={
-                "beta1": self._beta1,
-                "beta2": self._beta2,
-                "epsilon": self._epsilon,
-            },
+            attrs=attrs,
         )
 
     def _finish_update(self, block, parameters_and_grads):

@@ -1,50 +1,10 @@
-"""Row-sharded embedding lookup (embedding parallelism).
+"""Back-compat shim: the row-sharded lookup grew into the sparse embedding
+engine (paddle_tpu/embedding/ — EmbeddingEngine, SelectedRows gradients,
+per-row optimizer updates). The kernel itself now lives in
+embedding/lookup.py with dense-matching dtype/padding_idx/negative-id
+semantics; import from there (or use layers.distributed_embedding /
+embedding.EmbeddingEngine) in new code."""
 
-Reference analog: the distributed lookup table (SURVEY.md §2.7.5) — a
-high-dimensional embedding sharded across parameter servers, rows fetched by
-RPC prefetch (distributed/parameter_prefetch.cc:26) and gradients pushed as
-SelectedRows. TPU-native redesign: the table is row-sharded over a mesh axis;
-each rank gathers its local hits (out-of-range ids produce zeros) and a psum
-over the axis combines them — one ICI collective instead of an RPC round trip,
-and the backward pass is the mirrored scatter-add that GSPMD derives
-automatically from this forward.
-"""
-
-import functools
-
-import jax.numpy as jnp
-from jax import lax
-from jax.sharding import PartitionSpec as P
-
-from .collectives import shard_map
+from ..embedding.lookup import _local_lookup, sharded_embedding_lookup  # noqa: F401
 
 __all__ = ["sharded_embedding_lookup"]
-
-
-def _local_lookup(table_shard, ids, axis_name):
-    """table_shard: (rows_local, d); ids: global int ids, any shape."""
-    rows_local = table_shard.shape[0]
-    me = lax.axis_index(axis_name)
-    offset = me * rows_local
-    local = ids - offset
-    in_range = (local >= 0) & (local < rows_local)
-    safe = jnp.clip(local, 0, rows_local - 1)
-    picked = jnp.take(table_shard, safe.reshape(-1), axis=0)
-    picked = jnp.where(in_range.reshape(-1)[:, None], picked, 0.0)
-    out = picked.reshape(ids.shape + (table_shard.shape[1],))
-    return lax.psum(out, axis_name)
-
-
-def sharded_embedding_lookup(table, ids, mesh, axis_name="ep"):
-    """table: (rows, d) global array sharded on rows over `axis_name`;
-    ids: int array whose leading dim is the batch — kept sharded over 'dp'
-    (when the mesh has it) so per-device work scales with batch/dp, not the
-    global batch. Returns (ids.shape..., d) with the same dp sharding."""
-    batch_spec = P(("dp",)) if "dp" in mesh.shape else P()
-    fn = shard_map(
-        functools.partial(_local_lookup, axis_name=axis_name),
-        mesh=mesh,
-        in_specs=(P((axis_name,), None), batch_spec),
-        out_specs=batch_spec,
-    )
-    return fn(table, ids)

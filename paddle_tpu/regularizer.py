@@ -56,6 +56,14 @@ def append_regularization_ops(parameters_and_grads, regularization=None):
         if grad is None:
             params_and_grads.append((param, grad))
             continue
+        if getattr(grad, "is_selected_rows", False):
+            # weight decay on a SelectedRows grad would touch EVERY table row
+            # (the decay term is param-shaped), densifying the update and
+            # defeating the O(touched-rows) cost — the reference raised for
+            # this combination (regularization_op + SelectedRows); we skip
+            # decay on sparse tables instead
+            params_and_grads.append((param, grad))
+            continue
         regularization_term = None
         with program._optimized_guard([param, grad]):
             block = grad.block

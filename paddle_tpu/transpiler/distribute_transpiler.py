@@ -29,6 +29,15 @@ TPU-native redesign notes:
   rewritten to the mesh-sharded `distributed_lookup_table` op
   (parallel/sharded_embedding.py) rather than RPC prefetch
   (distributed/parameter_prefetch.cc:26).
+
+DEPRECATION (PR 8): for embedding-scale models, prefer
+`paddle_tpu.embedding.EmbeddingEngine` /
+`fluid.layers.distributed_embedding` over pserver mode. The engine
+row-shards the table over the mesh `ep` axis with SelectedRows-style sparse
+gradients and per-row optimizer updates inside the compiled SPMD step —
+no pserver processes, no RPC, sharded checkpoints included
+(docs/embedding.md). Pserver mode remains for reference parity and
+CPU-host sharding of tables too large for the pod's aggregate HBM.
 """
 
 from .. import framework

@@ -5,6 +5,12 @@ RoundRobin, HashName). Endpoints here name parameter-shard owners — on TPU a
 "pserver" is the host process owning a shard of the parameter/optimizer state
 (see distribute_transpiler.py) rather than a gRPC daemon, but the dispatch
 policy layer is identical.
+
+DEPRECATION (PR 8): embedding tables no longer need endpoint dispatch at
+all — `paddle_tpu.embedding.EmbeddingEngine` row-shards them over the mesh
+`ep` axis (GSPMD placement, docs/embedding.md), which supersedes HashName/
+RoundRobin placement for the distributed-lookup-table use case. These
+dispatchers remain for pserver-mode parameter sharding.
 """
 
 __all__ = ["PSDispatcher", "RoundRobin", "HashName"]

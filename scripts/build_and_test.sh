@@ -322,6 +322,25 @@ JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_data_runtime.py::test_worker_kill_mid_epoch_loses_and_duplicates_nothing \
     tests/test_data_runtime.py::test_pyreader_reset_generation_guard_regression
 
+echo "== recsys smoke (docs/embedding.md) =="
+# sparse embedding engine: DeepFM through the ep-sharded EmbeddingEngine on
+# the 8-device CPU mesh must report positive embedding throughput and the
+# sparse ep-sharded SGD trajectory must match dense single-device (the
+# SelectedRows path changes gradient layout, not math)
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'PY'
+import sys
+sys.path.insert(0, ".")
+from bench import run_recsys_bench
+rec = run_recsys_bench(smoke=True)
+assert rec["embedding_rows_per_sec"] > 0, rec
+assert rec["parity_max_loss_diff"] < 1e-4, rec
+print("recsys smoke ok: %.0f embedding rows/s (ep=%d), "
+      "sparse/dense parity diff %.2g over %d steps"
+      % (rec["embedding_rows_per_sec"], rec["devices"],
+         rec["parity_max_loss_diff"], rec["parity_steps"]))
+PY
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

@@ -1,5 +1,8 @@
 """DeepFM CTR training test (BASELINE config 4; reference dist_ctr.py-style
-smoke: logloss falls, AUC beats chance on learnable synthetic CTR data)."""
+smoke: logloss falls, AUC beats chance on learnable synthetic CTR data) plus
+the PR 8 sparse-embedding-engine suite: sparse-vs-dense and ep-sharded
+parity, sharded checkpoint round-trip, and the touched-rows-only update
+proof that distinguishes per-row (lazy) optimizer updates from dense ones."""
 
 import numpy as np
 
@@ -10,6 +13,9 @@ from paddle_tpu.models.deepfm import deepfm
 
 NUM_FEATURES = 2000
 NUM_FIELDS = 6
+
+# sharded-suite sizes: rows divisible by the 8-device test mesh
+SH_ROWS, SH_FIELDS, SH_DIM = 512, 4, 8
 
 
 def make_batch(rng, n=64):
@@ -54,3 +60,253 @@ def test_deepfm_trains_and_auc_beats_chance():
     neg = p[blabel[:, 0] == 0, 0]
     auc = (pos[:, None] > neg[None, :]).mean()
     assert auc > 0.65, auc
+
+
+# --------------------------------------------------------------------------
+# PR 8: sparse embedding engine
+# --------------------------------------------------------------------------
+
+
+def _sh_batches(n, batch=32, rows=SH_ROWS, seed=7):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.randint(0, rows, (batch, SH_FIELDS, 1)).astype("int64")
+        label = (rng.rand(batch, 1) < 0.5).astype("float32")
+        out.append({"ids": ids, "label": label})
+    return out
+
+
+def _build_deepfm_small(is_sparse, use_distributed, optimizer="sgd"):
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data(
+            name="ids", shape=[SH_FIELDS, 1], dtype="int64"
+        )
+        label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+        loss, _, _ = deepfm(
+            ids, label, num_features=SH_ROWS, num_fields=SH_FIELDS,
+            embedding_size=SH_DIM, layer_sizes=(16,),
+            is_sparse=is_sparse, use_distributed=use_distributed,
+        )
+        if optimizer == "sgd":
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        else:
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def test_deepfm_sparse_matches_dense_sgd():
+    """is_sparse=True changes the gradient data layout (SelectedRows pair +
+    per-row scatter update), not the math: SGD losses and the final table
+    must match the dense path bit-for-bit on one device."""
+    batches = _sh_batches(5)
+
+    def run(is_sparse):
+        main, startup, loss = _build_deepfm_small(is_sparse, False)
+        exe = fluid.Executor()
+        losses = []
+        scope = Scope(seed=3)
+        with scope_guard(scope):
+            exe.run(startup)
+            for feed in batches:
+                (l,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+                losses.append(float(l[0]))
+            table = np.asarray(scope.find_var("fm_emb")).copy()
+        return np.array(losses), table
+
+    dense_l, dense_t = run(False)
+    sparse_l, sparse_t = run(True)
+    np.testing.assert_allclose(sparse_l, dense_l, rtol=0, atol=0)
+    np.testing.assert_array_equal(sparse_t, dense_t)
+
+
+def test_deepfm_sharded_sparse_matches_dense_single_device():
+    """ep-sharded sparse DeepFM (EmbeddingEngine row shards + SelectedRows
+    grads + sharded per-row update) vs the dense single-device build on
+    identical batches: SGD trajectories must agree."""
+    import jax
+
+    from paddle_tpu.parallel import MeshConfig
+
+    batches = _sh_batches(5)
+
+    main_d, startup_d, loss_d = _build_deepfm_small(False, False)
+    exe = fluid.Executor()
+    dense_l = []
+    with scope_guard(Scope(seed=3)):
+        exe.run(startup_d)
+        for feed in batches:
+            (l,) = exe.run(main_d, feed=feed, fetch_list=[loss_d.name])
+            dense_l.append(float(l[0]))
+
+    main_s, startup_s, loss_s = _build_deepfm_small(True, True)
+    sparse_l = []
+    with scope_guard(Scope(seed=3)):
+        exe.run(startup_s)
+        pe = fluid.ParallelExecutor(
+            use_cuda=False, loss_name=loss_s.name, main_program=main_s,
+            mesh_config=MeshConfig(dp=1, ep=jax.device_count()),
+        )
+        for feed in batches:
+            (l,) = pe.run([loss_s.name], feed=feed)
+            sparse_l.append(float(np.asarray(l).reshape(-1)[0]))
+
+    np.testing.assert_allclose(sparse_l, dense_l, rtol=0, atol=1e-6)
+
+
+def test_embedding_engine_checkpoint_roundtrip(tmp_path):
+    """save_sharded writes the table + its row-aligned Adam moments as
+    row-range shards + manifest; load_sharded reassembles them exactly."""
+    from paddle_tpu.embedding import EmbeddingEngine
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[4, 1], dtype="int64")
+        eng = EmbeddingEngine("ck_tbl", 64, 8, is_sparse=True)
+        emb = eng.lookup(ids)
+        loss = fluid.layers.mean(emb)
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            feed = {"ids": rng.randint(0, 64, (16, 4, 1)).astype("int64")}
+            exe.run(main, feed=feed, fetch_list=[loss.name])
+        names = eng.state_var_names(main)
+        # table + both Adam moment accumulators ride in the checkpoint
+        assert eng.table.name in names and len(names) >= 3, names
+        saved = {n: np.asarray(scope.find_var(n)).copy() for n in names}
+        manifest = eng.save_sharded(
+            scope, str(tmp_path), num_shards=4, program=main
+        )
+        assert manifest["num_shards"] == 4
+        assert manifest["row_ranges"][0] == [0, 16]
+        for n in names:  # clobber, then restore from disk
+            scope.vars[n] = np.zeros_like(saved[n])
+        eng.load_sharded(scope, str(tmp_path))
+        for n in names:
+            np.testing.assert_array_equal(np.asarray(scope.vars[n]), saved[n])
+
+
+def test_sparse_adam_updates_only_touched_rows():
+    """The lazy-update proof: after a step whose batch hits only rows
+    {3, 7}, every other row of the table AND of both moment accumulators is
+    bit-identical to before the step (dense Adam would decay all moments and
+    move every row through the bias-corrected update)."""
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[2, 1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[64, 8], is_sparse=True,
+            param_attr=fluid.ParamAttr(name="tbl"),
+        )
+        loss = fluid.layers.mean(emb)
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe.run(startup)
+        # step 1: touch a spread of rows so moments become nonzero
+        rng = np.random.RandomState(1)
+        feed = {"ids": rng.randint(0, 64, (32, 2, 1)).astype("int64")}
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+
+        state_names = ["tbl"] + sorted(
+            n for n in scope.vars
+            if n.startswith("tbl_") and "_acc" in n
+            and np.asarray(scope.vars[n]).shape == (64, 8)
+        )
+        assert len(state_names) == 3, state_names  # table + 2 moments
+        before = {n: np.asarray(scope.find_var(n)).copy() for n in state_names}
+
+        # step 2: touch ONLY rows 3 and 7
+        feed = {"ids": np.array([[[3], [7]]] * 4, dtype="int64")}
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+
+        touched = np.zeros(64, bool)
+        touched[[3, 7]] = True
+        for n in state_names:
+            after = np.asarray(scope.find_var(n))
+            np.testing.assert_array_equal(
+                after[~touched], before[n][~touched],
+                err_msg="%s: untouched rows moved" % n,
+            )
+        assert not np.array_equal(
+            np.asarray(scope.find_var("tbl"))[touched], before["tbl"][touched]
+        ), "touched rows did not update"
+
+
+def test_sharded_lookup_dtype_and_padding():
+    """Satellite 1: the sharded gather preserves the table dtype (bf16 in,
+    bf16 out — no jnp.where upcast) and zeroes padding_idx and negative
+    ids exactly like the dense lookup_table op."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from paddle_tpu.embedding import sharded_embedding_lookup
+
+    n = jax.device_count()
+    mesh = Mesh(np.array(jax.devices()), ("ep",))
+    rows, dim = 4 * n, 4
+    table = jnp.arange(rows * dim, dtype=jnp.float32).reshape(rows, dim)
+    table = (table + 1.0).astype(jnp.bfloat16)  # every row nonzero
+    ids = jnp.array([[0], [2], [rows - 1], [-1]], dtype=jnp.int32)
+
+    out = sharded_embedding_lookup(table, ids, mesh, padding_idx=2)
+    assert out.dtype == jnp.bfloat16, out.dtype
+    out = np.asarray(out.astype(jnp.float32))
+    ref = np.asarray(table.astype(jnp.float32))
+    np.testing.assert_array_equal(out[0, 0], ref[0])
+    np.testing.assert_array_equal(out[2, 0], ref[rows - 1])
+    assert (out[1] == 0).all(), "padding_idx row must be zeros"
+    assert (out[3] == 0).all(), "negative id must produce zeros"
+
+
+def test_sparse_grad_optimizer_routing_parity():
+    """Adagrad consumes the SelectedRows pair natively (adagrad_sparse:
+    per-row moment accumulation — untouched rows see zero grad in dense
+    adagrad too, so sparse is bit-identical); Momentum is NOT sparse-aware,
+    so the grad routes through selected_rows_to_dense (densify) first and
+    must also match the dense build exactly."""
+    import pytest  # noqa: F401 — kept plain: two sub-cases in one run
+
+    batches = _sh_batches(3)
+    for make_opt in (
+        lambda: fluid.optimizer.Adagrad(learning_rate=0.05),
+        lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9),
+    ):
+        results = []
+        for is_sparse in (False, True):
+            main, startup = framework.Program(), framework.Program()
+            with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+                ids = fluid.layers.data(
+                    name="ids", shape=[SH_FIELDS, 1], dtype="int64"
+                )
+                label = fluid.layers.data(
+                    name="label", shape=[1], dtype="float32"
+                )
+                loss, _, _ = deepfm(
+                    ids, label, num_features=SH_ROWS, num_fields=SH_FIELDS,
+                    embedding_size=SH_DIM, layer_sizes=(16,),
+                    is_sparse=is_sparse,
+                )
+                make_opt().minimize(loss)
+            exe = fluid.Executor()
+            scope = Scope(seed=3)
+            losses = []
+            with scope_guard(scope):
+                exe.run(startup)
+                for feed in batches:
+                    (l,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+                    losses.append(float(l[0]))
+                table = np.asarray(scope.find_var("fm_emb")).copy()
+            results.append((np.array(losses), table))
+        (dense_l, dense_t), (sparse_l, sparse_t) = results
+        np.testing.assert_allclose(sparse_l, dense_l, rtol=0, atol=0)
+        np.testing.assert_array_equal(sparse_t, dense_t)

@@ -82,6 +82,14 @@ WAIVERS = {
     # mesh-collective kernels: need a multi-device mesh, not a single-op run
     "ring_attention": ("test_parallel_pkg.py", "flash/dense ring vs plain attention, forward and grads, on the 8-device mesh"),
     "flash_attention": ("test_pallas_kernels.py", "Pallas kernel vs dense reference, forward and grads"),
+    # SelectedRows tier: these ops consume/produce the typed (values, rows)
+    # gradient pair that the flat single-op feed/fetch harness cannot carry;
+    # each is proven by sparse-vs-dense bit-parity over a training run
+    "lookup_table_grad_sparse": ("test_deepfm.py", "emits the SelectedRows pair; bit-parity vs dense lookup_table_grad (SGD/Adagrad/Momentum runs)"),
+    "selected_rows_to_dense": ("test_deepfm.py", "densify fallback for non-sparse-aware optimizers; Momentum parity run routes through it"),
+    "sgd_sparse": ("test_deepfm.py", "per-row scatter SGD; bit-parity vs dense sgd over a training run"),
+    "adagrad_sparse": ("test_deepfm.py", "per-row scatter Adagrad; bit-parity vs dense adagrad (untouched rows see g=0 either way)"),
+    "adam_sparse": ("test_deepfm.py", "lazy per-row Adam; touched-rows-only moment/param update proven in test_sparse_adam_updates_only_touched_rows"),
 }
 
 

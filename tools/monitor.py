@@ -181,6 +181,26 @@ def _data_summary(metrics):
     return out
 
 
+def _embedding_summary(metrics):
+    """Sparse-embedding-engine stats from a snapshot's metric dump: the
+    embedding/... gauges written at trace time by paddle_tpu.embedding and
+    ops/sparse_ops (per-table rows/bytes and the sparse-vs-dense gradient
+    wire cost), keyed by the table=... label."""
+    fields = {}
+    for name in metrics:
+        parts = name.split("/")
+        if len(parts) == 2 and parts[0] == "embedding":
+            fields[parts[1]] = (metrics[name] or {}).get("values") or {}
+    if not fields:
+        return {}
+    tables = {}
+    for field, vals in fields.items():
+        for label, v in vals.items():
+            table = label.split("=", 1)[1] if "=" in label else label or "?"
+            tables.setdefault(table, {})[field] = v
+    return tables
+
+
 def summarize(records, window=200):
     """Aggregate the record stream into the monitor's display fields.
 
@@ -213,6 +233,7 @@ def summarize(records, window=200):
         "top_ops": [],
         "serving": {},
         "data": {},
+        "embedding": {},
     }
 
     if opprofs:
@@ -277,6 +298,7 @@ def summarize(records, window=200):
             summary["bubble_analytic"] = bub.get("analytic")
         summary["serving"] = _serving_summary(metrics)
         summary["data"] = _data_summary(metrics)
+        summary["embedding"] = _embedding_summary(metrics)
         summary["health"] = dict(last.get("health", {}))
         memrec = last.get("mem", {})
         if memrec.get("mem_peak_bytes"):
@@ -396,6 +418,30 @@ def render(summary):
                 "%s worker restarts, %s dup batches dropped" % (
                     _fmt(data.get("restarts"), "{:.0f}", "0"),
                     _fmt(data.get("dropped_dup"), "{:.0f}", "0"),
+                ),
+            ))
+    for table, e in sorted((summary.get("embedding") or {}).items()):
+        rows.append((
+            "embedding/" + table,
+            "%s rows (%s; %s/shard), %s touched/step" % (
+                _fmt(e.get("table_rows"), "{:.0f}"),
+                _fmt_bytes(e.get("table_bytes")),
+                _fmt_bytes(e.get("table_bytes_per_shard")),
+                _fmt(e.get("rows_touched_per_step"), "{:.0f}"),
+            ),
+        ))
+        if e.get("sparse_grad_bytes") or e.get("dense_grad_bytes"):
+            sparse_b = e.get("sparse_grad_bytes")
+            dense_b = e.get("dense_grad_bytes")
+            ratio = (
+                dense_b / sparse_b if sparse_b and dense_b else None
+            )
+            rows.append((
+                "embedding/%s grad" % table,
+                "%s sparse vs %s dense (%sx saved)" % (
+                    _fmt_bytes(sparse_b),
+                    _fmt_bytes(dense_b),
+                    _fmt(ratio, "{:.0f}"),
                 ),
             ))
     for name in sorted(summary["health"]):

@@ -42,6 +42,7 @@ MODULES = [
     "paddle_tpu.inference",
     "paddle_tpu.serving",
     "paddle_tpu.data",
+    "paddle_tpu.embedding",
     "paddle_tpu.contrib",
     "paddle_tpu.contrib.memory_usage_calc",
 ]
