@@ -1339,7 +1339,149 @@ def run_recsys_bench(smoke=False):
     return record
 
 
+def run_recovery_bench(smoke=False):
+    """Elastic-recovery evidence pass (ISSUE 9 -> RECOVERY.json).
+
+    Three measurements on one machine:
+      1. checkpoint step stall, sync vs async, at EQUAL state size: a
+         synchronous `checkpoint.save_checkpoint` stalls the step for the
+         full serialize+hash+fsync; `AsyncCheckpointer.save` stalls only for
+         the device->host snapshot. Acceptance: async <= 20% of sync.
+      2. time-to-recover: wall time of `Supervisor.resume_or_init` on a cold
+         scope (startup + manifest read + shard reassembly + overlay).
+      3. steps lost to a simulated preemption at `killed_at_step` with
+         `ckpt_every` checkpoint cadence, plus a bit-exactness check that
+         the resumed trajectory equals the uninterrupted one.
+    """
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.resilience import (
+        AsyncCheckpointer, Supervisor, checkpoint as rckpt,
+    )
+
+    # --- 1. stall comparison at equal state size -------------------------
+    state_mb = 8 if smoke else 64
+    n_arrays = 8
+    rows = (state_mb << 20) // n_arrays // (64 * 4)
+    rng = np.random.RandomState(0)
+    # device arrays: the async save's stall IS the device->host copy
+    state = {
+        "p%02d" % i: jnp.asarray(rng.randn(rows, 64).astype(np.float32))
+        for i in range(n_arrays)
+    }
+    repeats = 3 if smoke else 5
+    tmp = tempfile.mkdtemp(prefix="recovery-bench-")
+    sync_ms, async_ms, commit_ms = [], [], []
+    try:
+        cp = AsyncCheckpointer(os.path.join(tmp, "async"), keep_last=2)
+        for r in range(repeats):
+            t0 = time.perf_counter()
+            rckpt.save_checkpoint(os.path.join(tmp, "sync"), state, r,
+                                  keep_last=2)
+            sync_ms.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            stall = cp.save(state, r)
+            async_ms.append(stall * 1e3)
+            cp.wait()  # commit latency is background, measured separately
+            commit_ms.append((time.perf_counter() - t0) * 1e3)
+        cp.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+
+    # --- 2+3. preemption -> resume on a tiny supervised trainer ----------
+    def _mlp():
+        main, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(input=x, size=16, act="relu")
+            p = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    def _feed(step):
+        r = np.random.RandomState(step)
+        x = r.randn(16, 8).astype(np.float32)
+        return {"x": x,
+                "y": np.abs(x).sum(axis=1, keepdims=True).astype(np.float32)}
+
+    ckpt_every, killed_at, total = 5, 17, 20
+    root = tempfile.mkdtemp(prefix="recovery-train-")
+    try:
+        def train(ckpt_root, upto, every):
+            main, startup, loss = _mlp()
+            with scope_guard(Scope(seed=1)):
+                exe = fluid.Executor()
+                sup = Supervisor(exe, ckpt_root, program=main,
+                                 ckpt_every=every)
+                start, _ = sup.resume_or_init(startup)
+                out = {}
+                with sup:
+                    for s in range(start, upto):
+                        (lv,) = sup.run_step(program=main, feed=_feed(s),
+                                             fetch_list=[loss])
+                        out[s] = float(np.asarray(lv).ravel()[0])
+                    sup.checkpointer.wait()
+                return out, start
+
+        golden, _ = train(os.path.join(root, "golden"), total, 0)
+        eroot = os.path.join(root, "elastic")
+        train(eroot, killed_at, ckpt_every)  # "preempted" here: no final save
+
+        main, startup, loss = _mlp()
+        with scope_guard(Scope(seed=2)):
+            exe = fluid.Executor()
+            sup = Supervisor(exe, eroot, program=main, ckpt_every=0)
+            t0 = time.perf_counter()
+            resumed_step, _cursor = sup.resume_or_init(startup)
+            recover_s = time.perf_counter() - t0
+        cont, start = train(eroot, total, 0)
+        bit_exact = all(cont[s] == golden[s] for s in range(start, total))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    return {
+        "metric": "elastic_recovery",
+        "mode": "smoke" if smoke else "full",
+        "state_mb": state_mb,
+        "repeats": repeats,
+        "sync_save_stall_ms": round(med(sync_ms), 2),
+        "async_save_stall_ms": round(med(async_ms), 2),
+        "async_commit_ms": round(med(commit_ms), 2),
+        # the acceptance ratio: step-visible stall, async vs sync
+        "async_stall_frac_of_sync": round(med(async_ms) / med(sync_ms), 4),
+        "ckpt_every": ckpt_every,
+        "killed_at_step": killed_at,
+        "resumed_step": resumed_step,
+        "steps_lost": killed_at - resumed_step,
+        "time_to_recover_s": round(recover_s, 3),
+        "resume_bit_exact": bool(bit_exact),
+    }
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "recovery":
+        # elastic-recovery evidence pass (ISSUE 9): async-checkpoint stall
+        # vs synchronous save at equal state size (target <= 0.20),
+        # time-to-recover, steps lost to a preemption; writes RECOVERY.json
+        # next to this file ("smoke" shrinks sizes, skips the tracked file)
+        smoke = "smoke" in sys.argv[2:]
+        rec = run_recovery_bench(smoke=smoke)
+        if not smoke:
+            out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "RECOVERY.json")
+            with open(out, "w") as f:
+                json.dump(rec, f, indent=1)
+        print(json.dumps(rec, indent=1))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "recsys":
         # sparse-embedding-engine evidence pass (PR 8): writes
         # BENCH_recsys.json next to this file; "smoke" keeps sizes CPU-CI

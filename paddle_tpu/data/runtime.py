@@ -234,6 +234,20 @@ class DataRuntime:
             except _queue.Empty:
                 break
 
+    def drain(self):
+        """Preemption half-close (resilience/elastic.py Supervisor): abort
+        the epoch, drop staged batches, and count the drop — then the
+        caller close()s. Exactly-once across the preemption is carried by
+        the checkpoint manifest's data cursor, not by preserving in-flight
+        batches (a preempted host's ring is gone anyway)."""
+        dropped = self._staged.qsize()
+        self.reset()
+        if dropped:
+            from ..resilience import health as _health
+
+            _health.incr("drain_batches_dropped", dropped)
+        return dropped
+
     def close(self):
         if self._closed:
             return

@@ -137,6 +137,22 @@ def _localize_nan(compiled, scope, feed_arrays, rng_key, reason, step=None,
         return None
 
 
+_ELASTIC_HB = None
+
+
+def _elastic_heartbeat():
+    """Beat the elastic watchdog (resilience/elastic.py). The import is
+    resolved once and cached; afterwards the disabled path is one function
+    call + one empty-list probe per run."""
+    global _ELASTIC_HB
+    hb = _ELASTIC_HB
+    if hb is None:
+        from .resilience.elastic import heartbeat as hb
+
+        _ELASTIC_HB = hb
+    hb()
+
+
 def _telemetry_begin():
     """(collector, t0) when telemetry is active, else (None, None) — the
     disabled path costs one flags lookup per run (observability.stepstats)."""
@@ -1609,6 +1625,10 @@ class Executor:
         the program's started py_readers."""
         if program is None:
             program = framework.default_main_program()
+        # elastic step-deadline watchdog: every run entry is a progress beat
+        # (resilience/elastic.py heartbeat — one list probe when no
+        # Supervisor is active)
+        _elastic_heartbeat()
         # telemetry (observability.stepstats): t0 brackets the WHOLE run —
         # reader pull, dispatch, and the fetch conversion (which is where
         # the device sync lands under return_numpy / FLAGS_benchmark)

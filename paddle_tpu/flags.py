@@ -71,6 +71,18 @@ Honored flags:
   be picklable module-level callables).
 - data_max_worker_restarts: respawn budget per worker slot under the
   resilience retry policy before the runtime surfaces a fatal error.
+- elastic_step_deadline_s: step-deadline for the elastic Supervisor's
+  watchdog (resilience/elastic.py): a supervised step with no heartbeat for
+  this many seconds counts a watchdog stall, takes an emergency checkpoint
+  when the step returns, and raises FatalError; 0.0 (default) disables.
+- elastic_nan_budget: consecutive bad (NaN-skipped / non-finite-loss) steps
+  the Supervisor tolerates before rolling back to the last committed
+  elastic checkpoint.
+- elastic_rollback_budget: NaN-storm rollbacks before the Supervisor gives
+  up with FatalError (progress is impossible from this state).
+- elastic_barrier_timeout_s: how long the elastic checkpoint writers wait
+  on cross-host markers (neighbor shard for the replica copy, rank 0's
+  commit barrier) before DeadlineExceeded.
 - eager_delete_tensor_gb / fraction_of_gpu_memory_to_use /
   paddle_num_threads: accepted for API compatibility; storage lifetime and
   threading are XLA/PJRT-owned here (documented no-ops).
@@ -105,6 +117,10 @@ _DEFAULTS = {
     "data_prefetch": 2,
     "data_start_method": "fork",
     "data_max_worker_restarts": 4,
+    "elastic_step_deadline_s": 0.0,
+    "elastic_nan_budget": 3,
+    "elastic_rollback_budget": 2,
+    "elastic_barrier_timeout_s": 120.0,
 }
 
 _flags = {}

@@ -37,6 +37,25 @@ __all__ = [
 MODEL_FILENAME = "__model__"
 
 
+def fsync_dir(path):
+    """Durably record a directory's entries. os.replace makes a rename
+    atomic, but not DURABLE: until the parent directory's metadata hits
+    disk, a power cut can roll the rename back — leaving a checkpoint whose
+    manifest names files that no longer exist. Checkpoint writers call this
+    after renames and before publishing a manifest. Best-effort on
+    filesystems that refuse O_RDONLY directory opens."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _bf16_safe_save(arr):
     a = np.asarray(arr)
     if a.dtype.name == "bfloat16" or "bfloat16" in str(a.dtype):
@@ -58,6 +77,7 @@ def save_arrays(dirname, arrays):
     # below between the first tmp write and its rename — the torn state
     # load_latest_valid must skip
     crash_now = _faults.fires("ckpt_crash")
+    dirs_touched = set()
     for name, val in arrays.items():
         arr, orig_dtype = _bf16_safe_save(val)
         path = os.path.join(dirname, name + ".npy")
@@ -71,6 +91,12 @@ def save_arrays(dirname, arrays):
         tmp = "%s.tmp.%d" % (path, os.getpid())
         with open(tmp, "wb") as f:
             np.save(f, arr)
+            # data durability BEFORE the rename: a crash after os.replace
+            # but before writeback would otherwise surface a correctly-named
+            # file of garbage — exactly what a manifest checksum can't fix
+            # once the manifest itself committed over it
+            f.flush()
+            os.fsync(f.fileno())
         if crash_now:
             # injected mid-commit death: the tmp exists, the rename never
             # happens — exactly the window a real crash hits
@@ -88,7 +114,15 @@ def save_arrays(dirname, arrays):
             f.write(orig_dtype or "")  # empty = native dtype, and the
             # sidecar's presence shadows any legacy __dtypes__*.json entry
             # a previous run left for this name
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, side)
+        dirs_touched.add(os.path.dirname(path))
+    # one dir fsync per directory, after all renames: the renames become
+    # durable together, and a manifest published after save_arrays returns
+    # can never name a file a power cut un-renames
+    for d in sorted(dirs_touched):
+        fsync_dir(d)
 
 
 def _load_dtype_meta(dirname):

@@ -11,9 +11,35 @@ import os
 
 import jax
 
-__all__ = ["init_distributed"]
+__all__ = ["init_distributed", "host_count", "host_index"]
 
 _initialized = False
+
+
+def host_count():
+    """Processes in the job: jax.process_count() after a rendezvous, else
+    the PADDLE_TRAINER_ENDPOINTS list length (the elastic runtime needs the
+    intended topology BEFORE initialize, e.g. to size checkpoint shards)."""
+    try:
+        n = jax.process_count()
+        if n > 1:
+            return n
+    except RuntimeError:
+        pass
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return len(eps.split(",")) if eps else 1
+
+
+def host_index():
+    """This process's rank: jax.process_index() after a rendezvous, else
+    PADDLE_TRAINER_ID."""
+    try:
+        i = jax.process_index()
+        if i or host_count() == 1:
+            return i
+    except RuntimeError:
+        pass
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
 
 
 def init_distributed(
@@ -46,10 +72,16 @@ def init_distributed(
     from ..resilience.retry import RetryPolicy
 
     attempts = int(_flags.get_flags("dist_init_max_retry")["dist_init_max_retry"]) + 1
+    # decorrelated jitter, seeded per-rank: when a whole pod restarts after
+    # a preemption, every host fails attempt 1 at the same instant — the
+    # lockstep exponential schedule would hammer the coordinator in waves,
+    # decorrelated draws spread the herd (resilience/retry.py docstring)
     policy = RetryPolicy(
         max_attempts=attempts,
         base_delay=0.5,
         max_delay=5.0,
+        jitter="decorrelated",
+        seed=process_id,
         retryable=(RuntimeError, ConnectionError, OSError),
     )
     policy.call(

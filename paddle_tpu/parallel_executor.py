@@ -149,6 +149,21 @@ class ParallelExecutor:
         """Number of ways the batch is split (the 'dp' axis extent)."""
         return self._mesh.shape.get("dp", self._mesh.size)
 
+    @property
+    def topology(self):
+        """Mesh axis extents + host count, the identity an elastic
+        checkpoint manifest records (resilience/async_ckpt.py): a later
+        resume compares its own topology against the saved one only for
+        bookkeeping — restore itself is topology-blind."""
+        import jax
+
+        out = {name: int(ext) for name, ext in self._mesh.shape.items()}
+        try:
+            out["num_hosts"] = int(jax.process_count())
+        except RuntimeError:
+            out["num_hosts"] = 1
+        return out
+
     def _install_reader_sharding(self):
         """Hand this PE's data-parallel placement to the program's readers
         (data-runtime mode stages batches with it, so they arrive on the

@@ -459,6 +459,30 @@ class PyReader:
             self._pushed_back = collections.deque()
         self._pushed_back.appendleft(batch)
 
+    def drain(self):
+        """Preemption half-close (resilience/elastic.py Supervisor): stop
+        producers and discard every staged/in-flight batch, counting what
+        was dropped so the exit is observable. The reader stays decorated —
+        a resumed incarnation re-decorates and starts fresh; exactly-once
+        delivery is the data CURSOR's job (epoch + batch index in the
+        checkpoint manifest), not the queue's."""
+        dropped = 0
+        pushed = getattr(self, "_pushed_back", None)
+        if pushed:
+            dropped += len(pushed)
+        q = self._queue
+        if q is not None:
+            try:
+                dropped += q.qsize()
+            except (NotImplementedError, OSError):
+                pass
+        self.reset()
+        if dropped:
+            from .resilience import health as _health
+
+            _health.incr("drain_batches_dropped", dropped)
+        return dropped
+
     def close(self):
         """Release the worker pool / shared-memory ring of num_workers
         mode (idempotent; the thread path has nothing to release)."""

@@ -17,18 +17,28 @@ save ops. Here it is one subsystem with four pieces:
 - health:     process-wide counters for degraded-but-alive events (skipped
               NaN steps, rpc retries, requeued tasks) so "survived" is
               observable, not silent.
+- async_ckpt: the elastic checkpoint format — per-host row-range shards +
+              neighbor replicas + a rank-0 manifest committed after a
+              cross-host barrier; AsyncCheckpointer stalls the step only
+              for the device→host copy (docs/resilience.md).
+- elastic:    Supervisor (step-deadline watchdog, NaN-storm rollback with a
+              bounded budget, SIGTERM/preempt drain) and the topology-aware
+              resume_or_init: a checkpoint taken at dp=N/ep=K resumes on
+              dp=M/ep=J, with the data cursor re-derived deterministically.
 
 See docs/resilience.md for the fault spec syntax and the recipe for making
 a new subsystem injectable.
 """
 
-from . import checkpoint, faults, health, retry  # noqa: F401
+from . import async_ckpt, checkpoint, elastic, faults, health, retry  # noqa: F401
+from .async_ckpt import AsyncCheckpointer  # noqa: F401
 from .checkpoint import (  # noqa: F401
     load_latest_valid,
     resume_or_init,
     save_checkpoint,
     snapshot_persistables,
 )
+from .elastic import Preempted, Supervisor  # noqa: F401
 from .faults import FaultPlan, InjectedFault  # noqa: F401
 from .retry import DeadlineExceeded, FatalError, RetryPolicy  # noqa: F401
 
@@ -38,6 +48,9 @@ __all__ = [
     "RetryPolicy",
     "DeadlineExceeded",
     "FatalError",
+    "AsyncCheckpointer",
+    "Supervisor",
+    "Preempted",
     "save_checkpoint",
     "load_latest_valid",
     "resume_or_init",
@@ -46,4 +59,6 @@ __all__ = [
     "retry",
     "checkpoint",
     "health",
+    "async_ckpt",
+    "elastic",
 ]

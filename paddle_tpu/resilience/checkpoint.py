@@ -8,11 +8,12 @@ Layout (one directory per checkpoint under a common root):
         MANIFEST.json        {"version": 1, "step": 12,
                               "files": {"<rel>": {"sha256": ..., "size": ...}}}
 
-Commit discipline: tensor files land first (each one atomically via
-write-temp-then-rename, io.save_arrays), the MANIFEST is written atomically
-LAST. A crash at any point leaves either a previous complete checkpoint
-untouched, or a manifest-less / checksum-mismatched directory that
-load_latest_valid skips. This is the same ordering the reference's etcd
+Commit discipline: tensor files land first (each one atomically AND durably
+— write-temp, fsync, rename, then one fsync of the containing directory;
+io.save_arrays), the MANIFEST is written atomically LAST with the same
+file-fsync → rename → dir-fsync ladder. A crash OR power cut at any point
+leaves either a previous complete checkpoint untouched, or a manifest-less /
+checksum-mismatched directory that load_latest_valid skips. This is the same ordering the reference's etcd
 master snapshot relied on (go/master/service.go:166-207: state blob committed
 in one txn), generalized to a directory of tensors.
 
@@ -89,12 +90,30 @@ def save_checkpoint(root, arrays, step, keep_last=3):
             files[rel] = {"sha256": _sha256(path), "size": os.path.getsize(path)}
     faults.crash("manifest_crash", ckpt_dir)
     manifest = {"version": 1, "step": int(step), "files": files}
+    from .. import io as fluid_io
+
+    # durability ordering: every data file AND the directory entries must be
+    # on disk BEFORE the manifest publishes (save_arrays fsyncs both), and
+    # the manifest itself gets file-fsync → rename → dir-fsync — otherwise a
+    # power cut after the rename can surface a manifest whose directory
+    # entry survived but whose payload renames rolled back (a "valid"-
+    # looking, unreadable checkpoint)
     tmp = os.path.join(ckpt_dir, "%s.tmp.%d" % (MANIFEST, os.getpid()))
     with open(tmp, "w") as f:
         json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, os.path.join(ckpt_dir, MANIFEST))
+    fluid_io.fsync_dir(ckpt_dir)
     if keep_last and keep_last > 0:
         for _s, old in _list_checkpoints(root)[keep_last:]:
+            # manifest goes first (atomic unlink): a GC killed mid-rmtree
+            # leaves a manifest-less dir that recovery skips, never a
+            # manifest over half-deleted payload files
+            try:
+                os.unlink(os.path.join(old, MANIFEST))
+            except OSError:
+                pass
             shutil.rmtree(old, ignore_errors=True)
     return ckpt_dir
 

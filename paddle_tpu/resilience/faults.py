@@ -23,6 +23,11 @@ Hook points currently wired (see docs/resilience.md for the full table):
   manifest_crash            resilience/checkpoint.py  before MANIFEST commit
   nan_grad                  executor.py            poisons a training step
   worker_die                trainer loops (tests/dist runners)  hard-exits
+  eckpt_commit_crash        resilience/async_ckpt.py  before the commit marker
+  preempt                   resilience/elastic.py  SIGTERM to self (the cloud
+                            preemption notice, injectable)
+  hang                      resilience/elastic.py  sleeps spec.ms inside the
+                            supervised step window (trips the watchdog)
 
 Every decision is made from per-kind invocation counters plus a per-kind
 seeded RNG, so the same plan + the same call sequence replays the same
@@ -42,7 +47,9 @@ __all__ = [
     "crash",
     "delay",
     "fires",
+    "hang",
     "install",
+    "preempt_self",
     "reset",
 ]
 
@@ -219,3 +226,24 @@ def delay(kind):
         time.sleep((spec.ms if spec else 50.0) / 1000.0)
         return True
     return False
+
+
+def preempt_self(kind="preempt"):
+    """Preemption-style hook: deliver SIGTERM to this process when the plan
+    says so — the injectable stand-in for a cloud preemption notice, so the
+    drain path (elastic.Supervisor) soaks under PADDLE_TPU_FAULTS like every
+    other failure mode. The signal is delivered synchronously: when this
+    returns True the handler has already run."""
+    if fires(kind):
+        import signal as _signal
+
+        os.kill(os.getpid(), _signal.SIGTERM)
+        return True
+    return False
+
+
+def hang(kind="hang"):
+    """Hang-style hook: sleep spec.ms when the plan says so. Placed inside
+    the supervised step window, a `hang:ms=...` spec past the step deadline
+    trips the elastic watchdog exactly like a wedged collective would."""
+    return delay(kind)

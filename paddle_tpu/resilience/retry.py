@@ -40,6 +40,14 @@ class RetryPolicy:
     times, sleeping base_delay * multiplier**i (capped at max_delay, +/- a
     jitter fraction) between attempts, never past `deadline` seconds total.
 
+    jitter="decorrelated" switches to decorrelated jitter: each pause is
+    uniform(base_delay, 3 * previous pause), capped at max_delay. Unlike the
+    +/-fraction form — where N processes sharing attempt counts stay packed
+    in a narrow band around the same exponential schedule — successive draws
+    diverge, so a fleet of hosts that failed together (every worker retrying
+    `jax.distributed.initialize` against a coordinator that isn't up yet)
+    spreads out instead of thundering back in lockstep.
+
     `seed` makes the jitter sequence deterministic (resilience tests);
     `sleep` is injectable for zero-wall-clock unit tests.
     """
@@ -69,9 +77,17 @@ class RetryPolicy:
         self.fatal = tuple(fatal)
         self._rng = Random(seed)
         self._sleep = sleep
+        self._prev = None  # decorrelated mode: last pause issued
 
     def backoff(self, attempt):
         """Delay before retrying after 0-based `attempt` (jittered)."""
+        if self.jitter == "decorrelated":
+            prev = self._prev if self._prev is not None else self.base_delay
+            d = self._rng.uniform(self.base_delay, max(prev * 3.0,
+                                                       self.base_delay))
+            d = min(d, self.max_delay)
+            self._prev = d
+            return max(d, 0.0)
         d = min(self.base_delay * (self.multiplier ** attempt), self.max_delay)
         if self.jitter:
             d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)

@@ -341,6 +341,30 @@ print("recsys smoke ok: %.0f embedding rows/s (ep=%d), "
          rec["parity_max_loss_diff"], rec["parity_steps"]))
 PY
 
+echo "== elastic smoke (docs/resilience.md) =="
+# elastic preemption-tolerant training: the async checkpoint's step-visible
+# stall must stay <= 20% of a synchronous save at equal state size, a
+# preempted trainer must resume bit-exact losing at most ckpt_every steps,
+# and the acceptance scenario — SIGKILL one of two hosts mid-step, delete
+# its shards, resume dp=1 from shard+replica — must hold in subprocesses
+JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+sys.path.insert(0, ".")
+from bench import run_recovery_bench
+rec = run_recovery_bench(smoke=True)
+assert rec["async_stall_frac_of_sync"] <= 0.20, rec
+assert rec["resume_bit_exact"], rec
+assert rec["steps_lost"] <= rec["ckpt_every"], rec
+print("elastic smoke ok: async stall %.2f ms = %.1f%% of sync %.2f ms "
+      "(state %d MB), recover %.3f s, %d step(s) lost"
+      % (rec["async_save_stall_ms"],
+         100 * rec["async_stall_frac_of_sync"], rec["sync_save_stall_ms"],
+         rec["state_mb"], rec["time_to_recover_s"], rec["steps_lost"]))
+PY
+JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_elastic.py::test_sigkill_one_of_two_hosts_resumes_bit_exact \
+    tests/test_elastic.py::test_dp2_to_dp1_resume_parity
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

@@ -1,0 +1,1026 @@
+"""Elastic preemption-tolerant training (ISSUE-9 acceptance matrix).
+
+Covers:
+- plan_host_ranges: deterministic, covering, disjoint ownership plans
+- elastic checkpoint round-trip (1 host and threaded 2-host), replica
+  fallback when a host's primary shard is lost, unrecoverable when both
+  shard AND replica are gone, bf16 widening round-trip
+- durability ordering: data files + directory fsync BEFORE the manifest
+  rename; torn-dir crashes (manifest_crash / eckpt_commit_crash) leave a
+  directory that latest_valid_elastic skips
+- AsyncCheckpointer: deferred background failure re-raised on the next
+  save()/wait(), stall histogram recorded
+- decorrelated-jitter backoff: seeded determinism, bounds, divergence
+- fault kinds `preempt` (synchronous SIGTERM to self) and `hang`
+- drain: PyReader.drain / Supervisor.drain discard staged batches and
+  count them in health
+- Supervisor: bit-exact resume, preemption drain path, watchdog + emergency
+  checkpoint on a hung step, NaN-storm rollback with bounded retry budget,
+  classic-vs-elastic format preference in resume_or_init
+- executor heartbeat wiring, derive_data_shards coverage across resizes
+- tools/monitor.py resilience summary
+- subprocess acceptance: SIGKILL one of 2 hosts mid-step -> delete its
+  host-local shards -> resume at dp=1 from shard+replica, loss continues
+  BIT-EXACT from the last committed step
+- checkpoint-under-SIGKILL soak: every surviving manifest must load with
+  internally consistent state
+- dp=2 -> dp=1 resume parity through ParallelExecutor ZeRO-1 shards
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.observability.registry import default_registry
+from paddle_tpu.resilience import (
+    AsyncCheckpointer,
+    FatalError,
+    Preempted,
+    Supervisor,
+    async_ckpt,
+    checkpoint as ckpt,
+    elastic,
+    faults,
+    health,
+)
+from paddle_tpu.resilience.retry import RetryPolicy
+
+HERE = os.path.dirname(__file__)
+RUNNER = os.path.join(HERE, "elastic_runner.py")
+TOOLS = os.path.join(HERE, "..", "tools")
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    """Fault plans, health counters, and the resilience/ metric namespace are
+    process-wide; isolate each test."""
+    faults.install(None)
+    health.reset()
+    default_registry().reset("resilience/")
+    yield
+    faults.install(None)
+    health.reset()
+    default_registry().reset("resilience/")
+
+
+@pytest.fixture
+def restore_flags():
+    names = [
+        "resilience_nan_guard",
+        "resilience_lr_decay",
+        "elastic_step_deadline_s",
+        "elastic_nan_budget",
+        "elastic_rollback_budget",
+        "elastic_barrier_timeout_s",
+    ]
+    saved = fluid.get_flags(names)
+    yield
+    fluid.set_flags(saved)
+
+
+def _arrays(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": rng.randn(10, 4).astype(np.float32),
+        "b": rng.randn(4).astype(np.float32),
+        "lr": np.float32(0.1),
+    }
+
+
+def _build_mlp(lr=0.1):
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def _mlp_batch(step, bs=16):
+    rng = np.random.RandomState(step)
+    x = rng.randn(bs, 8).astype(np.float32)
+    return {"x": x, "y": np.abs(x).sum(axis=1, keepdims=True).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# partition plan
+# ---------------------------------------------------------------------------
+
+
+def test_plan_host_ranges_covers_disjoint_deterministic():
+    shapes = {"w": (10, 4), "b": (4,), "lr": ()}
+    plans = async_ckpt.plan_host_ranges(shapes, 2)
+    assert len(plans) == 2
+    # splittable arrays: contiguous, disjoint, covering
+    assert plans[0]["w"] == [0, 5] and plans[1]["w"] == [5, 10]
+    assert plans[0]["b"] == [0, 2] and plans[1]["b"] == [2, 4]
+    # scalar: wholly owned by exactly one host
+    owners = [h for h, p in enumerate(plans) if "lr" in p]
+    assert len(owners) == 1 and plans[owners[0]]["lr"] is None
+    # pure function: same inputs -> same plan
+    assert async_ckpt.plan_host_ranges(shapes, 2) == plans
+    # H=1: one host owns everything, whole-array
+    (solo,) = async_ckpt.plan_host_ranges(shapes, 1)
+    assert set(solo) == set(shapes) and all(v is None for v in solo.values())
+
+
+def test_plan_host_ranges_unbalanced_rows():
+    plans = async_ckpt.plan_host_ranges({"t": (7, 2)}, 3)
+    ranges = [p["t"] for p in plans]
+    assert ranges[0][0] == 0 and ranges[-1][1] == 7
+    for a, b in zip(ranges, ranges[1:]):
+        assert a[1] == b[0]  # contiguous, no gap/overlap
+    # rows < hosts: whole-array ownership by one host
+    plans = async_ckpt.plan_host_ranges({"s": (2, 3)}, 4)
+    owners = [h for h, p in enumerate(plans) if "s" in p]
+    assert len(owners) == 1 and plans[owners[0]]["s"] is None
+
+
+# ---------------------------------------------------------------------------
+# round-trip + replica fallback
+# ---------------------------------------------------------------------------
+
+
+def test_single_host_roundtrip(tmp_path):
+    root = str(tmp_path)
+    arrays = _arrays()
+    d = async_ckpt.write_elastic_checkpoint(
+        root, arrays, 7, cursor={"epoch": 1, "batch_index": 9, "seed": 3},
+        topology={"dp": 8, "num_hosts": 1},
+    )
+    assert async_ckpt.verify_elastic_checkpoint(d)
+    assert async_ckpt.latest_valid_elastic(root) == (7, d)
+    step, out, manifest = async_ckpt.load_elastic(d)
+    assert step == 7
+    assert manifest["cursor"] == {"epoch": 1, "batch_index": 9, "seed": 3}
+    assert manifest["topology"]["dp"] == 8
+    for n, a in arrays.items():
+        np.testing.assert_array_equal(np.asarray(out[n]), np.asarray(a))
+        assert out[n].dtype == np.asarray(a).dtype
+
+
+def _write_two_host(root, arrays, step):
+    """Both logical hosts of a 2-host elastic checkpoint, concurrently —
+    the replica step of each host WAITS for its neighbor's shard marker, so
+    sequential in-process writes would deadlock by construction."""
+    errs = []
+
+    def host(h):
+        try:
+            async_ckpt.write_elastic_checkpoint(
+                root, arrays, step, num_hosts=2, host_id=h,
+                barrier_timeout=30.0,
+            )
+        except BaseException as e:  # surfaces in the parent assert
+            errs.append(e)
+
+    ts = [threading.Thread(target=host, args=(h,)) for h in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    return os.path.join(root, "eckpt-%08d" % step)
+
+
+def test_two_host_roundtrip_and_replica_fallback(tmp_path):
+    root = str(tmp_path)
+    arrays = _arrays(1)
+    d = _write_two_host(root, arrays, 12)
+    assert async_ckpt.verify_elastic_checkpoint(d)
+    step, out, _ = async_ckpt.load_elastic(d)
+    assert step == 12
+    for n, a in arrays.items():
+        np.testing.assert_array_equal(np.asarray(out[n]), a)
+
+    # lose host 1's host-local files entirely -> replica keeps it recoverable
+    os.unlink(os.path.join(d, "shard-00001-of-00002.npz"))
+    os.unlink(os.path.join(d, "shard-00001.ok.json"))
+    assert async_ckpt.verify_elastic_checkpoint(d)
+    _, out, _ = async_ckpt.load_elastic(d)
+    for n, a in arrays.items():
+        np.testing.assert_array_equal(np.asarray(out[n]), a)
+
+    # lose the replica too (a SECOND host) -> unrecoverable, skipped not raised
+    os.unlink(os.path.join(d, "replica-00001-by-00000.npz"))
+    assert not async_ckpt.verify_elastic_checkpoint(d)
+    with pytest.warns(UserWarning, match="unrecoverable"):
+        assert async_ckpt.latest_valid_elastic(root) is None
+    assert health.get("ckpt_skipped_invalid") == 1
+    with pytest.raises(IOError, match="neither an intact shard nor a replica"):
+        async_ckpt.load_elastic(d)
+
+
+def test_bf16_widening_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    arrays = {"p": jnp.asarray(np.arange(8, dtype=np.float32), jnp.bfloat16)}
+    d = async_ckpt.write_elastic_checkpoint(str(tmp_path), arrays, 1)
+    _, out, manifest = async_ckpt.load_elastic(d)
+    assert "bfloat16" in manifest["arrays"]["p"]["dtype"]
+    assert manifest["arrays"]["p"]["stored_dtype"] == "float32"
+    assert str(out["p"].dtype) == "bfloat16"
+    np.testing.assert_array_equal(
+        np.asarray(out["p"], dtype=np.float32),
+        np.asarray(arrays["p"], dtype=np.float32),
+    )
+
+
+def test_keep_last_gc_never_collects_newest(tmp_path):
+    root = str(tmp_path)
+    for s in range(1, 6):
+        async_ckpt.write_elastic_checkpoint(root, _arrays(s), s, keep_last=2)
+    steps = [s for s, _ in async_ckpt.list_elastic_checkpoints(root)]
+    assert steps == [5, 4]
+    assert async_ckpt.latest_valid_elastic(root)[0] == 5
+
+
+# ---------------------------------------------------------------------------
+# durability ordering + torn dirs
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_fsync_ordering(tmp_path, monkeypatch):
+    """Satellite (a): every data file rename AND a directory fsync must land
+    BEFORE the MANIFEST rename, and the manifest's own rename is followed by
+    a directory fsync — the ordering that makes `manifest exists => data
+    durable` true across a power cut."""
+    events = []
+    real_replace, real_fsync = os.replace, os.fsync
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: (events.append(("replace", os.path.basename(b))),
+                      real_replace(a, b))[1],
+    )
+    monkeypatch.setattr(
+        os, "fsync",
+        lambda fd: (events.append(("fsync", fd)), real_fsync(fd))[1],
+    )
+    import paddle_tpu.io as fluid_io
+
+    real_fsync_dir = fluid_io.fsync_dir
+    monkeypatch.setattr(
+        fluid_io, "fsync_dir",
+        lambda p: (events.append(("fsync_dir", os.path.abspath(p))),
+                   real_fsync_dir(p))[1],
+    )
+
+    # classic format
+    d = ckpt.save_checkpoint(str(tmp_path / "classic"), _arrays(), 1)
+    idx = [i for i, e in enumerate(events)
+           if e[0] == "replace" and e[1] == "MANIFEST.json"]
+    assert len(idx) == 1
+    mi = idx[0]
+    replaces = [i for i, e in enumerate(events) if e[0] == "replace"]
+    assert all(i < mi for i in replaces if i != mi), events
+    assert any(e[0] == "fsync_dir" and e[1] == os.path.abspath(d)
+               for e in events[:mi]), "data dir not fsynced before manifest"
+    assert any(e[0] == "fsync_dir" and e[1] == os.path.abspath(d)
+               for e in events[mi:]), "manifest rename itself not made durable"
+    assert any(e[0] == "fsync" for e in events[:mi])
+
+    # elastic format: same discipline
+    events.clear()
+    d = async_ckpt.write_elastic_checkpoint(
+        str(tmp_path / "elastic"), _arrays(), 1
+    )
+    idx = [i for i, e in enumerate(events)
+           if e[0] == "replace" and e[1] == "MANIFEST.json"]
+    assert len(idx) == 1
+    mi = idx[0]
+    assert all(i < mi for i in
+               (i for i, e in enumerate(events) if e[0] == "replace")
+               if i != mi), events
+    assert any(e[0] == "fsync_dir" and e[1] == os.path.abspath(d)
+               for e in events[mi:]), events
+
+
+def test_torn_dir_crashes_are_skipped(tmp_path):
+    root = str(tmp_path)
+    async_ckpt.write_elastic_checkpoint(root, _arrays(0), 1)
+
+    # crash before the manifest: shards + commits exist, no MANIFEST
+    faults.install("manifest_crash")
+    with pytest.raises(faults.InjectedFault):
+        async_ckpt.write_elastic_checkpoint(root, _arrays(2), 2)
+    faults.install(None)
+    torn = os.path.join(root, "eckpt-00000002")
+    assert os.path.isdir(torn)
+    assert not os.path.exists(os.path.join(torn, "MANIFEST.json"))
+    assert not async_ckpt.verify_elastic_checkpoint(torn)
+
+    # crash before the commit marker
+    faults.install("eckpt_commit_crash")
+    with pytest.raises(faults.InjectedFault):
+        async_ckpt.write_elastic_checkpoint(root, _arrays(3), 3)
+    faults.install(None)
+
+    # crash between shard tmp write and rename (io.py's existing hook)
+    faults.install("ckpt_crash")
+    with pytest.raises(faults.InjectedFault):
+        async_ckpt.write_elastic_checkpoint(root, _arrays(4), 4)
+    faults.install(None)
+
+    with pytest.warns(UserWarning):
+        found = async_ckpt.latest_valid_elastic(root)
+    assert found is not None and found[0] == 1
+    step, out, _ = async_ckpt.load_elastic(found[1])
+    np.testing.assert_array_equal(out["w"], _arrays(0)["w"])
+    assert health.get("ckpt_skipped_invalid") >= 2
+
+
+# ---------------------------------------------------------------------------
+# AsyncCheckpointer
+# ---------------------------------------------------------------------------
+
+
+def test_async_checkpointer_defers_background_failure(tmp_path):
+    cp = AsyncCheckpointer(str(tmp_path))
+    faults.install("eckpt_commit_crash")
+    cp.save(_arrays(), 1)  # background write fails; save itself returns
+    with pytest.raises(faults.InjectedFault):
+        cp.wait()
+    assert health.get("ckpt_async_failed") == 1
+    faults.install(None)
+    # a later save works and wait() does not re-raise the consumed error
+    cp.save(_arrays(), 2)
+    cp.wait()
+    assert cp.last_commit_dir is not None
+    assert async_ckpt.latest_valid_elastic(str(tmp_path))[0] == 2
+    cp.close()
+
+
+def test_async_checkpointer_records_stall_and_freshness(tmp_path):
+    cp = AsyncCheckpointer(str(tmp_path))
+    stall = cp.save(_arrays(), 3, block=True)
+    assert stall >= 0.0
+    snap = default_registry().snapshot()
+    hist = snap.get("resilience/ckpt_stall_ms")
+    assert hist and hist["count"] >= 1
+    assert snap["resilience/ckpt_commits"]["values"][""] == 1
+    assert snap["resilience/last_ckpt_step"]["values"][""] == 3.0
+    cp.close()
+
+
+# ---------------------------------------------------------------------------
+# decorrelated jitter / fault kinds / drains
+# ---------------------------------------------------------------------------
+
+
+def test_decorrelated_jitter_deterministic_and_bounded():
+    def mk(seed):
+        return RetryPolicy(
+            base_delay=0.1, max_delay=5.0, jitter="decorrelated", seed=seed
+        )
+
+    p, q, r = mk(3), mk(3), mk(4)
+    s1 = [p.backoff(i) for i in range(6)]
+    s2 = [q.backoff(i) for i in range(6)]
+    s3 = [r.backoff(i) for i in range(6)]
+    assert s1 == s2  # seeded determinism (one policy per host, seeded by rank)
+    assert s1 != s3  # different hosts spread out
+    assert all(0.1 <= d <= 5.0 for d in s1)
+    # the signature property: each delay drawn from [base, 3*prev]
+    prev = 0.1
+    for d in s1:
+        assert d <= max(prev * 3.0, 0.1) + 1e-12
+        prev = d
+
+
+def test_preempt_fault_delivers_sigterm_synchronously():
+    hits = []
+    old = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        faults.install("preempt:step=2")
+        assert faults.preempt_self() is False and not hits
+        assert faults.preempt_self() is True
+        assert hits == [signal.SIGTERM]  # handler already ran on return
+        assert faults.preempt_self() is False  # step= fires exactly once
+    finally:
+        signal.signal(signal.SIGTERM, old)
+
+
+def test_hang_fault_sleeps_configured_ms():
+    faults.install("hang:ms=80")
+    t0 = time.perf_counter()
+    assert faults.hang() is True
+    assert time.perf_counter() - t0 >= 0.06
+    faults.install(None)
+    t0 = time.perf_counter()
+    assert faults.hang() is False
+    assert time.perf_counter() - t0 < 0.05
+
+
+def test_pyreader_drain_counts_dropped_batches():
+    from paddle_tpu.py_reader import PyReader
+
+    r = PyReader(["x"], return_device_arrays=False)
+    data = [[(np.full(4, i, "float32"),) for i in range(2)]
+            for _ in range(3)]
+    r.decorate_paddle_reader(lambda: iter(data))
+    r.start()
+    b = r.next_batch()
+    r.push_back(b)  # an in-flight batch the preemption must not lose silently
+    time.sleep(0.05)  # let the feeder stage something
+    dropped = r.drain()
+    assert dropped >= 1
+    assert health.get("drain_batches_dropped") == dropped
+    r.close()
+
+
+def test_supervisor_drain_prefers_drain_then_closes():
+    calls = []
+
+    class FakeReader:
+        def drain(self):
+            calls.append("drain")
+
+        def reset(self):
+            calls.append("reset")
+
+        def close(self):
+            calls.append("close")
+
+    exe = fluid.Executor()
+    sup = Supervisor(exe, "/nonexistent", reader=FakeReader())
+    sup.drain()
+    assert calls == ["drain", "close"]  # drain wins over reset; close follows
+
+    class WedgedReader:
+        def drain(self):
+            raise RuntimeError("wedged")
+
+        def reset(self):
+            calls.append("reset")
+
+        def close(self):
+            calls.append("close")
+
+    calls.clear()
+    Supervisor(exe, "/nonexistent", reader=WedgedReader()).drain()
+    assert calls == ["reset", "close"]  # fallback, never raises
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: resume / preempt / watchdog / NaN escalation
+# ---------------------------------------------------------------------------
+
+
+def _train_supervised(root, steps, ckpt_every, seed=1, resume=False):
+    """One in-process supervised run; returns (losses, resumed_step)."""
+    main, startup, loss = _build_mlp()
+    with scope_guard(Scope(seed=seed)):
+        exe = fluid.Executor()
+        sup = Supervisor(exe, root, program=main, ckpt_every=ckpt_every)
+        start, _cursor = sup.resume_or_init(startup)
+        if not resume:
+            assert start == 0
+        losses = {}
+        with sup:
+            for s in range(start, steps):
+                (lv,) = sup.run_step(
+                    program=main, feed=_mlp_batch(s), fetch_list=[loss]
+                )
+                losses[s] = float(np.asarray(lv).ravel()[0])
+            sup.checkpointer.wait()
+    return losses, start
+
+
+def test_supervisor_resume_is_bit_exact(tmp_path):
+    root = str(tmp_path / "ck")
+    golden, _ = _train_supervised(str(tmp_path / "golden"), 10, ckpt_every=0)
+
+    first, _ = _train_supervised(root, 6, ckpt_every=2)
+    assert async_ckpt.latest_valid_elastic(root)[0] == 6
+    for s in range(6):
+        assert first[s] == golden[s]
+
+    cont, start = _train_supervised(root, 10, ckpt_every=2, resume=True)
+    assert start == 6
+    assert health.get("resumed_from_checkpoint") == 1
+    for s in range(6, 10):
+        assert cont[s] == golden[s], (s, cont[s], golden[s])
+
+
+def test_supervisor_resume_restores_data_cursor(tmp_path):
+    root = str(tmp_path)
+    main, startup, loss = _build_mlp()
+    with scope_guard(Scope(seed=1)):
+        exe = fluid.Executor()
+        sup = Supervisor(exe, root, program=main, ckpt_every=0)
+        sup.resume_or_init(startup)
+        with sup:
+            for s in range(3):
+                sup.run_step(program=main, feed=_mlp_batch(s),
+                             fetch_list=[loss])
+            sup.next_epoch()
+            sup.cursor["seed"] = 7
+            sup.save(block=True)
+    with scope_guard(Scope(seed=2)):
+        exe = fluid.Executor()
+        sup = Supervisor(exe, root, program=main, ckpt_every=0)
+        step, cursor = sup.resume_or_init(startup)
+    assert step == 3
+    assert cursor == {"epoch": 1, "batch_index": 0, "seed": 7}
+
+
+def test_supervisor_preemption_drains_and_commits(tmp_path):
+    root = str(tmp_path)
+    faults.install("preempt:step=3")
+    drained = []
+
+    class R:
+        def drain(self):
+            drained.append(1)
+
+    main, startup, loss = _build_mlp()
+    with scope_guard(Scope(seed=1)):
+        exe = fluid.Executor()
+        sup = Supervisor(exe, root, program=main, ckpt_every=0, reader=R())
+        sup.resume_or_init(startup)
+        with sup:
+            with pytest.raises(Preempted, match="checkpoint committed"):
+                for s in range(10):
+                    sup.run_step(program=main, feed=_mlp_batch(s),
+                                 fetch_list=[loss])
+    assert health.get("preemptions") == 1
+    assert health.get("preempt_signals") == 1
+    assert drained == [1]
+    # the emergency commit is the resumable state at the preempted step
+    found = async_ckpt.latest_valid_elastic(root)
+    assert found is not None and found[0] == 2
+    snap = default_registry().snapshot()
+    assert snap["resilience/preemptions"]["values"][""] == 1
+
+
+def test_supervisor_watchdog_emergency_checkpoint(tmp_path, restore_flags):
+    root = str(tmp_path)
+    faults.install("hang:step=2@ms=700")
+    main, startup, loss = _build_mlp()
+    with scope_guard(Scope(seed=1)):
+        exe = fluid.Executor()
+        sup = Supervisor(exe, root, program=main, ckpt_every=0,
+                         step_deadline_s=0.3)
+        sup.resume_or_init(startup)
+        with sup:
+            with pytest.raises(FatalError, match="exceeded deadline 0.300s"):
+                for s in range(5):
+                    sup.run_step(program=main, feed=_mlp_batch(s),
+                                 fetch_list=[loss])
+    assert health.get("watchdog_stalls") >= 1
+    assert health.get("emergency_checkpoints") == 1
+    # the emergency checkpoint is recoverable
+    assert async_ckpt.latest_valid_elastic(root) is not None
+
+
+def test_supervisor_nan_storm_rollback_then_fatal(tmp_path, restore_flags):
+    fluid.set_flags({"resilience_nan_guard": True})
+    root = str(tmp_path)
+    main, startup, loss = _build_mlp()
+    with scope_guard(Scope(seed=1)):
+        exe = fluid.Executor()
+        sup = Supervisor(exe, root, program=main, ckpt_every=2,
+                         nan_budget=2, rollback_budget=1)
+        sup.resume_or_init(startup)
+        faults.install("nan_grad:every=1@after=3")
+        with sup:
+            with pytest.raises(FatalError, match="NaN storm persisted"):
+                for s in range(50):
+                    sup.run_step(program=main, feed=_mlp_batch(s),
+                                 fetch_list=[loss])
+    # budget=1 -> one real rollback, the second escalation is fatal
+    assert health.get("elastic_rollbacks") == 2
+    snap = default_registry().snapshot()
+    assert snap["resilience/rollbacks"]["values"][""] == 2
+
+
+def test_rollback_restores_state_and_cursor(tmp_path):
+    root = str(tmp_path)
+    main, startup, loss = _build_mlp()
+    with scope_guard(Scope(seed=1)):
+        exe = fluid.Executor()
+        sup = Supervisor(exe, root, program=main, ckpt_every=0)
+        sup.resume_or_init(startup)
+        with sup:
+            for s in range(4):
+                sup.run_step(program=main, feed=_mlp_batch(s),
+                             fetch_list=[loss])
+            sup.save(block=True)
+            saved = {n: np.asarray(a).copy()
+                     for n, a in sup._state().items()}
+            # keep training past the checkpoint, then roll back
+            for s in range(4, 7):
+                sup.run_step(program=main, feed=_mlp_batch(s),
+                             fetch_list=[loss])
+            sup.rollback()
+            assert sup.step == 4
+            assert sup.cursor["batch_index"] == 4
+            for n, a in sup._state().items():
+                np.testing.assert_array_equal(np.asarray(a), saved[n])
+
+
+def test_resume_prefers_newer_format_either_way(tmp_path):
+    main, startup, _loss = _build_mlp()
+
+    # classic newer than elastic -> classic wins
+    root = str(tmp_path / "a")
+    async_ckpt.write_elastic_checkpoint(root, {"m": np.float32(1.0)}, 3)
+    ckpt.save_checkpoint(root, {"m": np.float32(2.0)}, 5)
+    sc = Scope(seed=0)
+    with scope_guard(sc):
+        exe = fluid.Executor()
+        step, cursor = elastic.resume_or_init(exe, startup, root)
+        assert (step, cursor) == (5, {})
+        assert float(np.asarray(sc.find_var("m"))) == 2.0
+
+    # elastic newer than classic -> elastic wins, cursor comes back
+    root = str(tmp_path / "b")
+    ckpt.save_checkpoint(root, {"m": np.float32(2.0)}, 5)
+    async_ckpt.write_elastic_checkpoint(
+        root, {"m": np.float32(9.0)}, 8, cursor={"epoch": 2,
+                                                 "batch_index": 1, "seed": 0},
+    )
+    sc = Scope(seed=0)
+    with scope_guard(sc):
+        exe = fluid.Executor()
+        step, cursor = elastic.resume_or_init(exe, startup, root)
+        assert step == 8 and cursor["epoch"] == 2
+        assert float(np.asarray(sc.find_var("m"))) == 9.0
+    assert health.get("resumed_from_checkpoint") == 2
+
+
+def test_executor_run_beats_the_watchdog_bus():
+    beats = []
+
+    class W:
+        def beat(self, now=None):
+            beats.append(now)
+
+    main, startup, loss = _build_mlp()
+    w = W()
+    with elastic._watchers_lock:
+        elastic._watchers.append(w)
+    try:
+        with scope_guard(Scope(seed=0)):
+            exe = fluid.Executor()
+            exe.run(startup)
+            exe.run(main, feed=_mlp_batch(0), fetch_list=[loss])
+    finally:
+        with elastic._watchers_lock:
+            elastic._watchers.remove(w)
+    assert len(beats) >= 2  # startup + train entry both beat
+
+
+def test_derive_data_shards_covers_after_resize():
+    cursor = {"epoch": 3, "batch_index": 17, "seed": 5}
+    for num_hosts in (1, 2, 3, 4):
+        union = []
+        for h in range(num_hosts):
+            union.extend(elastic.derive_data_shards(cursor, num_hosts, h, 16))
+        assert sorted(union) == list(range(16)), (num_hosts, union)
+    # pure function: the dp=2 assignment recomputes identically
+    a = elastic.derive_data_shards(cursor, 2, 0, 16)
+    assert a == elastic.derive_data_shards(cursor, 2, 0, 16)
+    # a different epoch reshuffles
+    assert a != elastic.derive_data_shards(
+        {"epoch": 4, "seed": 5}, 2, 0, 16
+    ) or True  # permutation MAY coincide; the invariant is coverage above
+
+
+def test_monitor_resilience_summary():
+    sys.path.insert(0, TOOLS)
+    try:
+        import monitor
+
+        metrics = {
+            "resilience/ckpt_commits": {"kind": "counter", "values": {"": 4}},
+            "resilience/last_ckpt_step": {"kind": "gauge", "values": {"": 12.0}},
+            "resilience/last_ckpt_age_s": {"kind": "gauge", "values": {"": 2.5}},
+            "resilience/recoveries": {"kind": "counter", "values": {"": 1}},
+            "resilience/rollbacks": {"kind": "counter", "values": {"": 2}},
+            "resilience/preemptions": {"kind": "counter", "values": {"": 1}},
+            "resilience/watchdog_stalls": {"kind": "counter", "values": {"": 0}},
+            "resilience/ckpt_stall_ms": {
+                "kind": "histogram", "buckets": [1, 5, 25, 100],
+                "counts": [2, 1, 1, 0], "sum": 18.0, "count": 4,
+                "min": 0.5, "max": 9.0,
+            },
+        }
+        s = monitor._resilience_summary(metrics)
+        assert s["ckpt_commits"] == 4 and s["last_ckpt_step"] == 12.0
+        assert s["rollbacks"] == 2 and s["preemptions"] == 1
+        assert s["stall_count"] == 4
+        assert s["stall_mean_ms"] == pytest.approx(4.5)
+        assert s["stall_max_ms"] == 9.0
+        assert 0 < s["stall_p95_ms"] <= 9.0
+
+        records = [
+            {"kind": "step", "step": 1, "ts": 0.0, "host": 0,
+             "wall_ms": 10.0, "n_steps": 1, "loss": 0.5},
+            {"kind": "snapshot", "step": 1, "ts": 1.0, "host": 0,
+             "metrics": metrics, "health": {}},
+        ]
+        summ = monitor.summarize(records)
+        assert summ["resilience"]["ckpt_commits"] == 4
+        text = monitor.render(summ)
+        assert "resilience/ckpt" in text
+        assert "resilience/events" in text
+    finally:
+        sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# subprocess acceptance: SIGKILL + topology-changing resume
+# ---------------------------------------------------------------------------
+
+
+def _child_env(devices=1, **extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=%d" % devices
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.join(HERE, ".."), env.get("PYTHONPATH", "")]
+    )
+    # children must not inherit the parent suite's fault plans / cluster env
+    for k in ("PADDLE_TPU_FAULTS", "PADDLE_TRAINER_ENDPOINTS",
+              "PADDLE_TRAINER_ID"):
+        env.pop(k, None)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _spawn(mode, env, tag):
+    """Start a runner child with stdout AND stderr to files (an undrained
+    PIPE deadlocks a chatty child; files also survive a SIGKILL)."""
+    out = tempfile.NamedTemporaryFile(
+        mode="w+", prefix="el_%s_" % tag, suffix=".out", delete=False)
+    err = tempfile.NamedTemporaryFile(
+        mode="w+", prefix="el_%s_" % tag, suffix=".err", delete=False)
+    p = subprocess.Popen(
+        [sys.executable, RUNNER, mode], stdout=out, stderr=err,
+        text=True, env=env,
+    )
+    return p, out, err
+
+
+def _slurp(f):
+    f.flush()
+    f.seek(0)
+    data = f.read()
+    name = f.name
+    f.close()
+    if os.path.exists(name):
+        os.unlink(name)
+    return data
+
+
+def _run_to_completion(mode, env, tag, timeout=300):
+    p, out, err = _spawn(mode, env, tag)
+    try:
+        p.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        p.wait()
+    o, e = _slurp(out), _slurp(err)
+    assert p.returncode == 0, "%s failed (rc=%s):\n%s\n%s" % (
+        tag, p.returncode, o[-2000:], e[-4000:])
+    return o
+
+
+def _step_hexes(out):
+    """step -> loss-hex from the runner's STEP lines."""
+    got = {}
+    for line in out.splitlines():
+        if line.startswith("STEP "):
+            _, s, hx = line.split()
+            got[int(s)] = hx
+    return got
+
+
+def _resumed_step(out):
+    for line in out.splitlines():
+        if line.startswith("RESUMED "):
+            return int(line.split()[1])
+    raise AssertionError("no RESUMED line in:\n%s" % out[-2000:])
+
+
+def test_sigkill_one_of_two_hosts_resumes_bit_exact(tmp_path):
+    """THE acceptance scenario: a 2-host elastic group is SIGKILLed mid-step
+    (the preempted host first, mid-training), the dead host's host-local
+    shard files are deleted, and a single surviving host resumes dp=1 from
+    shard + neighbor replica with the loss sequence continuing BIT-EXACT
+    (hex-compared) from the last committed step."""
+    root = str(tmp_path / "eck")
+    total = 60
+
+    # golden: uninterrupted single-host run, no checkpoints
+    golden = _step_hexes(_run_to_completion(
+        "train",
+        _child_env(CKPT_ROOT=str(tmp_path / "golden"), ELASTIC_NUM_HOSTS=1,
+                   ELASTIC_HOST_ID=0, TRAIN_STEPS=total, CKPT_EVERY=0),
+        "golden",
+    ))
+    assert sorted(golden) == list(range(total))
+
+    # the 2-host group: throttled so the SIGKILL lands at a bounded step
+    procs = []
+    try:
+        for h in range(2):
+            procs.append(_spawn(
+                "train",
+                _child_env(CKPT_ROOT=root, ELASTIC_NUM_HOSTS=2,
+                           ELASTIC_HOST_ID=h, TRAIN_STEPS=100000,
+                           CKPT_EVERY=3, BARRIER_TIMEOUT=30,
+                           STEP_SLEEP_MS=40),
+                "host%d" % h,
+            ))
+        deadline = time.monotonic() + 240
+        committed = None
+        while time.monotonic() < deadline:
+            found = _quiet_latest(root)
+            if found is not None and found[0] >= 6:
+                committed = found
+                break
+            for p, _o, _e in procs:
+                assert p.poll() is None, "a host exited before the kill"
+            time.sleep(0.05)
+        assert committed is not None, "no committed elastic ckpt within 240s"
+
+        # SIGKILL host 1 mid-step, then the rest of the job
+        procs[1][0].send_signal(signal.SIGKILL)
+        procs[0][0].send_signal(signal.SIGKILL)
+        for p, _o, _e in procs:
+            p.wait(timeout=30)
+    finally:
+        outs = []
+        for p, o, e in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+            outs.append((_slurp(o), _slurp(e)))
+
+    # host 1 is gone AND its host-local storage with it
+    for _s, d in async_ckpt.list_elastic_checkpoints(root):
+        for fname in ("shard-00001-of-00002.npz", "shard-00001.ok.json"):
+            path = os.path.join(d, fname)
+            if os.path.exists(path):
+                os.unlink(path)
+
+    # newest COMMITTED state is still recoverable purely from host 0's
+    # files (own shard + replica of host 1's shard)
+    found = _quiet_latest(root)
+    assert found is not None, "shard loss killed the committed checkpoint"
+    last_step = found[0]
+    assert last_step >= committed[0]
+    assert last_step < total, (
+        "group ran past the golden horizon before the kill: %d" % last_step)
+
+    # resume as ONE host on the SAME root
+    out = _run_to_completion(
+        "train",
+        _child_env(CKPT_ROOT=root, ELASTIC_NUM_HOSTS=1, ELASTIC_HOST_ID=0,
+                   TRAIN_STEPS=total, CKPT_EVERY=0),
+        "resume",
+    )
+    assert _resumed_step(out) == last_step
+    resumed = _step_hexes(out)
+    assert sorted(resumed) == list(range(last_step, total))
+    for s in range(last_step, total):
+        assert resumed[s] == golden[s], (
+            "loss diverged at step %d after elastic resume: %s != %s"
+            % (s, resumed[s], golden[s]))
+
+    # pre-kill steps of the group also matched golden (same SPMD program)
+    host0_steps = _step_hexes(outs[0][0])
+    for s, hx in host0_steps.items():
+        if s in golden:
+            assert hx == golden[s]
+
+
+def _quiet_latest(root):
+    """latest_valid_elastic without the torn-dir warnings a live/killed
+    writer legitimately produces while we poll."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return async_ckpt.latest_valid_elastic(root)
+
+
+def _soak_round(tmp_path, i, delay):
+    root = str(tmp_path / ("soak%d" % i))
+    env = _child_env(CKPT_ROOT=root)
+    p, out, err = _spawn("ckpt_loop", env, "soak%d" % i)
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if async_ckpt.list_elastic_checkpoints(root):
+                break
+            assert p.poll() is None, _slurp(err)[-2000:]
+            time.sleep(0.02)
+        else:
+            raise AssertionError("no checkpoint appeared in 120s")
+        time.sleep(delay)  # land the SIGKILL at a varied protocol point
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=30)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+        _slurp(out), _slurp(err)
+
+    # EVERY dir that has a manifest must verify, load, and be internally
+    # consistent (w0 == base + step: a torn mix of two steps' shards would
+    # break this even though each file checksums)
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(64, 32).astype(np.float32)
+    expected = {}  # replay the writer's ITERATIVE f32 adds bit-for-bit
+    for step in range(1, 1 + max(
+            (s for s, _ in async_ckpt.list_elastic_checkpoints(root)),
+            default=0)):
+        w0 = w0 + np.float32(1.0)
+        expected[step] = w0.copy()
+    checked = 0
+    for step, d in async_ckpt.list_elastic_checkpoints(root):
+        if not os.path.exists(os.path.join(d, "MANIFEST.json")):
+            continue
+        assert async_ckpt.verify_elastic_checkpoint(d), d
+        got_step, arrays, _ = async_ckpt.load_elastic(d)
+        assert got_step == step
+        np.testing.assert_array_equal(arrays["w0"], expected[step])
+        checked += 1
+    return checked
+
+
+def test_checkpoint_sigkill_soak(tmp_path):
+    """Satellite (d): SIGKILL the writer at varied points across
+    snapshot/write/commit; every surviving manifest must load consistently."""
+    checked = 0
+    for i, delay in enumerate([0.0, 0.07, 0.15]):
+        checked += _soak_round(tmp_path, i, delay)
+    assert checked >= 1  # at least one committed checkpoint was validated
+
+
+@pytest.mark.slow
+def test_checkpoint_sigkill_soak_long(tmp_path):
+    checked = 0
+    for i, delay in enumerate([0.0, 0.02, 0.05, 0.09, 0.13, 0.21,
+                               0.34, 0.55, 0.89, 1.44]):
+        checked += _soak_round(tmp_path, 100 + i, delay)
+    assert checked >= 5
+
+
+def test_dp2_to_dp1_resume_parity(tmp_path):
+    """Satellite (d): train under ParallelExecutor ZeRO-1 at dp=2 with
+    elastic checkpoints, resume the SAME root at dp=1, and the continued
+    losses must match a golden dp=1 run (reduction-order tolerance only)."""
+    root = str(tmp_path / "pe")
+    total, cut = 12, 8
+
+    golden = _step_hexes(_run_to_completion(
+        "pe_train",
+        _child_env(devices=1, CKPT_ROOT=str(tmp_path / "pe_golden"),
+                   TRAIN_STEPS=total, CKPT_EVERY=0),
+        "pe_golden", timeout=420,
+    ))
+
+    out2 = _run_to_completion(
+        "pe_train",
+        _child_env(devices=2, CKPT_ROOT=root, TRAIN_STEPS=cut, CKPT_EVERY=4),
+        "pe_dp2", timeout=420,
+    )
+    assert "DP 2" in out2
+    assert async_ckpt.latest_valid_elastic(root)[0] == cut
+
+    out1 = _run_to_completion(
+        "pe_train",
+        _child_env(devices=1, CKPT_ROOT=root, TRAIN_STEPS=total,
+                   CKPT_EVERY=0),
+        "pe_dp1", timeout=420,
+    )
+    assert "DP 1" in out1
+    assert _resumed_step(out1) == cut
+    resumed = _step_hexes(out1)
+    assert sorted(resumed) == list(range(cut, total))
+    for s in range(cut, total):
+        a = float.fromhex(resumed[s])
+        b = float.fromhex(golden[s])
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-7, err_msg=str(s))
+
+    # the dp=2 manifest recorded the topology it was saved under
+    manifest = json.load(open(os.path.join(
+        async_ckpt.latest_valid_elastic(root)[1], "MANIFEST.json")))
+    assert manifest["topology"].get("dp") == 2

@@ -201,6 +201,58 @@ def _embedding_summary(metrics):
     return tables
 
 
+def _resilience_summary(metrics):
+    """Elastic-runtime stats from a snapshot's metric dump: the
+    resilience/... namespace written by paddle_tpu.resilience.async_ckpt
+    and .elastic (checkpoint freshness + stall distribution, and the
+    survived-event counters: recoveries, rollbacks, preemptions, watchdog
+    stalls)."""
+    res = {}
+    for name in metrics:
+        parts = name.split("/")
+        if len(parts) == 2 and parts[0] == "resilience":
+            res[parts[1]] = metrics[name]
+    if not res:
+        return {}
+
+    def scalar(rec):
+        if not rec or not rec.get("values"):
+            return None
+        vals = rec["values"]
+        return vals.get("", sum(vals.values()))
+
+    out = {
+        "last_ckpt_age_s": scalar(res.get("last_ckpt_age_s")),
+        "last_ckpt_step": scalar(res.get("last_ckpt_step")),
+        "ckpt_commits": scalar(res.get("ckpt_commits")),
+        "recoveries": scalar(res.get("recoveries")),
+        "rollbacks": scalar(res.get("rollbacks")),
+        "preemptions": scalar(res.get("preemptions")),
+        "watchdog_stalls": scalar(res.get("watchdog_stalls")),
+    }
+    hist = res.get("ckpt_stall_ms")
+    if hist and hist.get("count"):
+        out["stall_count"] = hist["count"]
+        out["stall_mean_ms"] = hist["sum"] / max(hist["count"], 1)
+        out["stall_max_ms"] = hist.get("max")
+        # p95 by linear interpolation inside the containing bucket — the
+        # same estimate registry.Histogram.percentile makes live
+        target = hist["count"] * 0.95
+        cum, lo = 0, 0.0
+        buckets, counts = hist.get("buckets", []), hist.get("counts", [])
+        p95 = hist.get("max")
+        for i, ub in enumerate(buckets):
+            prev = cum
+            cum += counts[i]
+            if cum >= target:
+                frac = (target - prev) / max(counts[i], 1)
+                p95 = min(lo + frac * (ub - lo), hist.get("max") or ub)
+                break
+            lo = ub
+        out["stall_p95_ms"] = p95
+    return out
+
+
 def summarize(records, window=200):
     """Aggregate the record stream into the monitor's display fields.
 
@@ -234,6 +286,7 @@ def summarize(records, window=200):
         "serving": {},
         "data": {},
         "embedding": {},
+        "resilience": {},
     }
 
     if opprofs:
@@ -299,6 +352,7 @@ def summarize(records, window=200):
         summary["serving"] = _serving_summary(metrics)
         summary["data"] = _data_summary(metrics)
         summary["embedding"] = _embedding_summary(metrics)
+        summary["resilience"] = _resilience_summary(metrics)
         summary["health"] = dict(last.get("health", {}))
         memrec = last.get("mem", {})
         if memrec.get("mem_peak_bytes"):
@@ -444,6 +498,33 @@ def render(summary):
                     _fmt(ratio, "{:.0f}"),
                 ),
             ))
+    res = summary.get("resilience") or {}
+    if res:
+        rows.append((
+            "resilience/ckpt",
+            "last @step %s, age %s s (%s committed)" % (
+                _fmt(res.get("last_ckpt_step"), "{:.0f}"),
+                _fmt(res.get("last_ckpt_age_s"), "{:.1f}"),
+                _fmt(res.get("ckpt_commits"), "{:.0f}", "0"),
+            ),
+        ))
+        if res.get("stall_count"):
+            rows.append((
+                "resilience/ckpt stall",
+                "mean %s ms, p95 %s ms, max %s ms over %d saves" % (
+                    _fmt(res.get("stall_mean_ms")),
+                    _fmt(res.get("stall_p95_ms")),
+                    _fmt(res.get("stall_max_ms")),
+                    res["stall_count"],
+                ),
+            ))
+        events = "%s recoveries, %s rollbacks, %s preemptions, %s stalls" % (
+            _fmt(res.get("recoveries"), "{:.0f}", "0"),
+            _fmt(res.get("rollbacks"), "{:.0f}", "0"),
+            _fmt(res.get("preemptions"), "{:.0f}", "0"),
+            _fmt(res.get("watchdog_stalls"), "{:.0f}", "0"),
+        )
+        rows.append(("resilience/events", events))
     for name in sorted(summary["health"]):
         rows.append(("health/" + name, str(summary["health"][name])))
     for op, total_ms, pct in summary.get("top_ops", []):
