@@ -1339,6 +1339,140 @@ def run_recsys_bench(smoke=False):
     return record
 
 
+def _passes_build_lenet():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.models import lenet5
+
+    main = framework.Program()
+    startup = framework.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                    dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+            out = lenet5(img, label)
+            loss = out[0] if isinstance(out, tuple) else out
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return main, startup, loss
+
+
+def _passes_build_transformer():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.models.transformer import build_tiny_flash_transformer
+
+    main = framework.Program()
+    startup = framework.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            _feeds, loss = build_tiny_flash_transformer()
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return main, startup, loss
+
+
+def _passes_feed(model, rng, batch):
+    if model == "lenet":
+        return {
+            "img": rng.randn(batch, 1, 28, 28).astype("float32"),
+            "label": rng.randint(0, 10, (batch, 1)).astype("int64"),
+        }
+    from paddle_tpu.models.transformer import tiny_flash_transformer_feed
+
+    return tiny_flash_transformer_feed(batch, seed=int(rng.randint(1 << 30)))
+
+
+def run_passes_bench(smoke=False):
+    """Pass-framework evidence (ISSUE 10 -> PASSES.json): for LeNet and the
+    tiny flash transformer, pipeline off vs the training_default preset —
+    steady-state step time, program op count before/after, compiled HLO
+    instruction count, per-pass payloads (folded/removed/fusion groups), and
+    the max loss delta over lockstep training (must be < 1e-6: the pipeline
+    preserves the RNG stream, so training is bit-identical)."""
+    from paddle_tpu import flags, passes
+    from paddle_tpu.executor import Executor, Scope, scope_guard
+
+    steps = 4 if smoke else 10
+    warmup = 2
+    record = {"metric": "graph_passes", "mode": "smoke" if smoke else "full",
+              "preset": "training_default",
+              "pipeline": list(passes.PRESETS["training_default"]),
+              "models": {}}
+
+    for model, builder, batch in (
+        ("lenet", _passes_build_lenet, 32),
+        ("transformer", _passes_build_transformer, 8),
+    ):
+        entry = {}
+        losses = {}
+        for pipeline in ("off", "training_default"):
+            flags.set_flags({"pass_pipeline":
+                             "" if pipeline == "off" else pipeline})
+            try:
+                main_p, startup, loss = builder()
+                exe = Executor()
+                rng = np.random.RandomState(0)
+                with scope_guard(Scope(seed=7)):
+                    from paddle_tpu.executor import global_scope
+
+                    exe.run(startup)
+                    ls = []
+                    feed_names = None
+                    for _ in range(warmup):
+                        feed = _passes_feed(model, rng, batch)
+                        feed_names = sorted(feed)
+                        ls.append(float(np.asarray(exe.run(
+                            main_p, feed=feed, fetch_list=[loss.name],
+                        )[0]).reshape(-1)[0]))
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        ls.append(float(np.asarray(exe.run(
+                            main_p, feed=_passes_feed(model, rng, batch),
+                            fetch_list=[loss.name],
+                        )[0]).reshape(-1)[0]))
+                    step_ms = (time.perf_counter() - t0) / steps * 1e3
+                    hlo = exe.compiled_hlo()
+                    if pipeline != "off":
+                        # the memoized transformed program the executor just
+                        # ran (same key: program, pipeline, scope, feed/fetch
+                        # -> cache hit, not a re-application)
+                        transformed = passes.apply_cached(
+                            main_p, pipeline, scope=global_scope(),
+                            feed_names=feed_names,
+                            fetch_names=[loss.name],
+                        )
+                        entry["ops_after"] = sum(
+                            len(b.ops) for b in transformed.blocks
+                        )
+                        results = transformed._pass_results
+                        entry["folded"] = results.get(
+                            "constant_fold", {}).get("folded", 0)
+                        entry["dce_removed"] = results.get(
+                            "dead_op_eliminate", {}).get("removed", 0)
+                        entry["fusion_groups"] = results.get(
+                            "fuse_elemwise_act", {}).get("groups", 0)
+                losses[pipeline] = ls
+                key = "off" if pipeline == "off" else "on"
+                entry["step_ms_%s" % key] = round(step_ms, 3)
+                entry["hlo_instructions_%s" % key] = hlo.count(" = ")
+                if pipeline == "off":
+                    entry["ops_before"] = sum(
+                        len(b.ops) for b in main_p.blocks
+                    )
+            finally:
+                flags.set_flags({"pass_pipeline": ""})
+        entry["op_reduction"] = entry["ops_before"] - entry["ops_after"]
+        entry["max_loss_delta"] = max(
+            abs(a - b)
+            for a, b in zip(losses["off"], losses["training_default"])
+        )
+        record["models"][model] = entry
+    record["parity_ok"] = all(
+        m["max_loss_delta"] < 1e-6 for m in record["models"].values()
+    )
+    return record
+
+
 def run_recovery_bench(smoke=False):
     """Elastic-recovery evidence pass (ISSUE 9 -> RECOVERY.json).
 
@@ -1507,6 +1641,21 @@ def main():
             with open(out, "w") as f:
                 json.dump(rec, f, indent=1)
         print(json.dumps(rec))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "passes":
+        # pass-framework evidence (ISSUE 10): pipeline off vs the
+        # training_default preset on LeNet + tiny transformer — step time,
+        # op/HLO counts, fold/DCE/fusion payloads, loss-parity delta; writes
+        # PASSES.json next to this file ("smoke" shrinks steps, skips the
+        # tracked file)
+        smoke = "smoke" in sys.argv[2:]
+        rec = run_passes_bench(smoke=smoke)
+        if not smoke:
+            out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "PASSES.json")
+            with open(out, "w") as f:
+                json.dump(rec, f, indent=1)
+        print(json.dumps(rec, indent=1))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         # serving-runtime evidence pass (scripts/build_and_test.sh): writes

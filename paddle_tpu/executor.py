@@ -58,6 +58,28 @@ def _flags_opprof():
     return _flags.get_flags(("tensor_stats", "nan_provenance"))
 
 
+def _apply_pass_pipeline(program, scope, feed_names, fetch_names, pipeline=None):
+    """The single choke point where graph passes rewrite a program before
+    lowering (paddle_tpu/passes, docs/passes.md). Both executors and
+    aot_serve_lowering route through here. `pipeline` None defers to
+    FLAGS_pass_pipeline; ""/"off"/() disables and returns the program
+    untouched. The transformed program is memoized per (program version,
+    pipeline, scope, feed/fetch), so repeated runs hand the executor the
+    SAME object and its compile cache stays hot."""
+    if pipeline is None:
+        from . import flags as _flags
+
+        pipeline = _flags.get_flags("pass_pipeline")["pass_pipeline"]
+    from .passes import manager as _pm
+
+    if not _pm.resolve_pipeline(pipeline):
+        return program
+    return _pm.apply_cached(
+        program, pipeline, scope=scope,
+        feed_names=feed_names, fetch_names=fetch_names,
+    )
+
+
 def _compiled_ops(compiled):
     """The fluid op list behind any compiled-block flavor (for NaN
     provenance and the check_nan_inf last-writer report)."""
@@ -384,6 +406,29 @@ class _CompiledBlock:
         # persistables created inside the block (e.g. startup initializers)
         self.created_persistables = sorted((persistable & produced) - set(state_names) - fed)
 
+        # cross-check against the inplace_donation_plan pass when one rode in
+        # on this program AND it analyzed this exact lowering (same scope,
+        # feed, fetch, nothing unanalyzable). The plan is the verified source
+        # of truth at this seam: divergence means a pass corrupted def-use or
+        # the classifications drifted — fail loudly, not with silent
+        # mis-donation (docs/passes.md).
+        plan = getattr(program, "_donation_plan", None)
+        if (
+            plan
+            and ops_override is None  # segments lower op SUBSETS the plan never saw
+            and not plan.get("unknown")
+            and plan.get("scope_uid") == scope._uid
+            and plan.get("feed") == sorted(self.feed_names)
+            and list(plan.get("fetch", ())) == list(self.fetch_names)
+        ):
+            if plan["mut"] != self.mut_names or plan["ro"] != self.ro_names:
+                raise RuntimeError(
+                    "inplace_donation_plan disagrees with the lowering's "
+                    "state classification: plan mut=%s ro=%s vs lowered "
+                    "mut=%s ro=%s — a pass likely corrupted def-use edges"
+                    % (plan["mut"], plan["ro"], self.mut_names, self.ro_names)
+                )
+
         ops_ = self.ops
 
         # declared feed-var dtypes: device-resident feeds arrive uncast (see
@@ -611,7 +656,8 @@ class _CompiledBlock:
         return fetches
 
 
-def aot_serve_lowering(program, feed_names, fetch_names, scope):
+def aot_serve_lowering(program, feed_names, fetch_names, scope,
+                       pass_pipeline="inference"):
     """Donation-free forward lowering for ahead-of-time serving.
 
     The serving side (inference.export_compiled, serving.engine) needs the
@@ -625,7 +671,15 @@ def aot_serve_lowering(program, feed_names, fetch_names, scope):
     same shapes. The scope's rng key is captured at trace time — inference
     programs are pruned of training-only stochastic ops by clone(for_test),
     so the key never advances.
+
+    `pass_pipeline` (default: the "inference" preset, docs/passes.md) runs
+    fold/DCE/fusion-tagging over the program before lowering; pass "" / None
+    to lower the program verbatim.
     """
+    program = _apply_pass_pipeline(
+        program, scope, list(feed_names), list(fetch_names),
+        pipeline=pass_pipeline if pass_pipeline else "off",
+    )
     block = program.global_block()
     compiled = _CompiledBlock(
         program, block, list(feed_names), list(fetch_names), scope,
@@ -1664,10 +1718,17 @@ class Executor:
             scope.rng_key = jax.random.key(program.random_seed)
             scope._seeded = True
 
-        block = program.global_block()
         fetch_names = [
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
+        # graph-pass choke point (docs/passes.md): FLAGS_pass_pipeline rewrites
+        # the program here, before any lowering below sees it. Reader/feed
+        # resolution above ran on the ORIGINAL program (its _py_readers);
+        # everything from here down uses the (memoized) transformed one.
+        program = _apply_pass_pipeline(
+            program, scope, list(feed.keys()), fetch_names
+        )
+        block = program.global_block()
 
         feed_arrays = {}
         for name, value in feed.items():

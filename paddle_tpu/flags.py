@@ -83,6 +83,16 @@ Honored flags:
 - elastic_barrier_timeout_s: how long the elastic checkpoint writers wait
   on cross-host markers (neighbor shard for the replica copy, rank 0's
   commit barrier) before DeadlineExceeded.
+- pass_pipeline: graph-pass pipeline both executors apply at the lowering
+  choke point (paddle_tpu/passes, docs/passes.md): a preset name
+  ("training_default", "inference") or a comma-separated pass list; ""
+  (default) disables. ParallelExecutor's BuildStrategy.pass_pipeline
+  overrides this per executor when set.
+- pass_debug_dir: when set, the PassManager writes per-pass debug dumps
+  into this directory — before/after graphviz of block 0 (via
+  debugger.draw_block_graphviz) and a textual op diff, named
+  <NN>_<pass>_{before,after}.dot / <NN>_<pass>_ops.diff; "" (default)
+  disables.
 - eager_delete_tensor_gb / fraction_of_gpu_memory_to_use /
   paddle_num_threads: accepted for API compatibility; storage lifetime and
   threading are XLA/PJRT-owned here (documented no-ops).
@@ -121,6 +131,8 @@ _DEFAULTS = {
     "elastic_nan_budget": 3,
     "elastic_rollback_budget": 2,
     "elastic_barrier_timeout_s": 120.0,
+    "pass_pipeline": "",
+    "pass_debug_dir": "",
 }
 
 _flags = {}

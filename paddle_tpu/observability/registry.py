@@ -285,11 +285,19 @@ class MetricRegistry:
             if rec["kind"] in ("counter", "gauge"):
                 for labels, v in sorted(rec["values"].items()):
                     if labels:
-                        pairs = ",".join(
-                            '%s="%s"' % tuple(p.split("=", 1))
-                            for p in labels.split(",")
+                        # a piece without "=" is the tail of a comma-holding
+                        # label VALUE split apart above — rejoin it instead
+                        # of 500ing every /metrics scrape
+                        pairs = []
+                        for p in labels.split(","):
+                            if "=" in p:
+                                pairs.append(p.split("=", 1))
+                            elif pairs:
+                                pairs[-1][1] += "," + p
+                        rendered = ",".join(
+                            '%s="%s"' % (k, val) for k, val in pairs
                         )
-                        lines.append("%s{%s} %g" % (pname, pairs, v))
+                        lines.append("%s{%s} %g" % (pname, rendered, v))
                     else:
                         lines.append("%s %g" % (pname, v))
             else:  # histogram

@@ -221,6 +221,14 @@ EMPTY_VAR_NAME = "@EMPTY@"  # reference core.kEmptyVarName
 _SCOPE_UNSAFE = _re.compile(r"[^A-Za-z0-9_.=\-]")
 OUT_SCOPE_PREFIX = "out="
 
+# passes.builtin.FuseElemwiseActPass tags matmul/conv+add[+act] chains with
+# this attr; lower_ops lowers a contiguous run sharing one tag inside a
+# single enclosing named_scope ("fusion_group=<id>") so XLA's fusion
+# heuristics see the chain as a unit and the profiler can attribute its
+# HLO to the group (profiler._hlo_op_attribution skips the wrapper segment)
+FUSION_GROUP_ATTR = "__fusion_group__"
+FUSION_SCOPE_PREFIX = "fusion_group="
+
 
 def op_output_scope(op):
     """Scope name carrying the op's identity (its first real output var) into
@@ -232,44 +240,69 @@ def op_output_scope(op):
     return None
 
 
+def _lower_one(ctx, op, env):
+    """Lower a single op into env (see lower_ops)."""
+    opdef = get(op.type)
+    if opdef.skip_exec:
+        return
+    ins = {}
+    for slot, names in op.inputs.items():
+        if names:
+            ins[slot] = [
+                env[n] if n != EMPTY_VAR_NAME else None for n in names
+            ]
+    # named_scope tags every HLO this op emits with op_name="…/<type>/…"
+    # metadata — the correlation key profiler.device_op_profile uses to
+    # fold XLA's per-HLO device timings back onto framework op types
+    # (the reference correlates CUPTI kernels to ops the same way,
+    # platform/device_tracer.cc). A nested "out=<first output>" scope
+    # distinguishes op INSTANCES (profiler._hlo_op_attribution); the
+    # type-level parse skips it, so device_op_profile is unchanged.
+    out_scope = op_output_scope(op)
+    with jax.named_scope(op.type):
+        if out_scope is None:
+            outs = opdef.lower(ctx, ins, op.attrs)
+        else:
+            with jax.named_scope(out_scope):
+                outs = opdef.lower(ctx, ins, op.attrs)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for name, val in zip(names, vals):
+            if val is not None and name != EMPTY_VAR_NAME:
+                env[name] = val
+
+
 def lower_ops(ctx, ops, env):
     """Lower a list of ops into an env (name -> traced value), rebinding
     outputs. The single shared interpreter loop for the whole-block executor
     (executor.py) and for sub-block control-flow ops (while/cond/recurrent in
     control_flow_ops.py) — the reference's Executor::RunPreparedContext loop
     (executor.cc:389-396) respectively its nested-Executor reuse inside
-    while_op.cc:36."""
-    for op in ops:
-        opdef = get(op.type)
-        if opdef.skip_exec:
+    while_op.cc:36.
+
+    Contiguous runs of ops sharing a FUSION_GROUP_ATTR value (tagged by the
+    fuse_elemwise_act pass) lower inside ONE enclosing named_scope: the
+    group's HLO shares an op_name prefix, so XLA's fusion heuristics and the
+    profiler's attribution both see the chain as a unit."""
+    i, n = 0, len(ops)
+    while i < n:
+        op = ops[i]
+        fg = op.attrs.get(FUSION_GROUP_ATTR)
+        if fg is None:
+            _lower_one(ctx, op, env)
+            i += 1
             continue
-        ins = {}
-        for slot, names in op.inputs.items():
-            if names:
-                ins[slot] = [
-                    env[n] if n != EMPTY_VAR_NAME else None for n in names
-                ]
-        # named_scope tags every HLO this op emits with op_name="…/<type>/…"
-        # metadata — the correlation key profiler.device_op_profile uses to
-        # fold XLA's per-HLO device timings back onto framework op types
-        # (the reference correlates CUPTI kernels to ops the same way,
-        # platform/device_tracer.cc). A nested "out=<first output>" scope
-        # distinguishes op INSTANCES (profiler._hlo_op_attribution); the
-        # type-level parse skips it, so device_op_profile is unchanged.
-        out_scope = op_output_scope(op)
-        with jax.named_scope(op.type):
-            if out_scope is None:
-                outs = opdef.lower(ctx, ins, op.attrs)
-            else:
-                with jax.named_scope(out_scope):
-                    outs = opdef.lower(ctx, ins, op.attrs)
-        for slot, names in op.outputs.items():
-            vals = outs.get(slot)
-            if vals is None:
-                continue
-            for name, val in zip(names, vals):
-                if val is not None and name != EMPTY_VAR_NAME:
-                    env[name] = val
+        j = i
+        while j < n and ops[j].attrs.get(FUSION_GROUP_ATTR) == fg:
+            j += 1
+        with jax.named_scope(
+            FUSION_SCOPE_PREFIX + _SCOPE_UNSAFE.sub("_", str(fg))
+        ):
+            for member in ops[i:j]:
+                _lower_one(ctx, member, env)
+        i = j
     return env
 
 

@@ -26,6 +26,7 @@ from .executor import (
     _CompiledBlock,
     _MultiStepBlock,
     _PipelinedBlock,
+    _apply_pass_pipeline,
     _as_feed_array,
     _flags_opprof,
     _telemetry_begin,
@@ -75,6 +76,10 @@ class BuildStrategy:
         # Ignored when an explicit mesh_config is passed — set MeshConfig(pp=)
         # there instead.
         self.pipeline_stages = 1
+        # graph-pass pipeline applied before lowering (paddle_tpu/passes,
+        # docs/passes.md): a manager.PRESETS name or comma-separated pass
+        # list; "" disables. None (default) defers to FLAGS_pass_pipeline.
+        self.pass_pipeline = None
 
 
 class ExecutionStrategy:
@@ -226,10 +231,16 @@ class ParallelExecutor:
                     merged.setdefault(k, []).append(np.asarray(v))
             feed = {k: np.concatenate(vs, axis=0) for k, vs in merged.items()}
         program = self._program
-        block = program.global_block()
         fetch_names = [
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
+        # graph-pass choke point, mirroring Executor.run (docs/passes.md);
+        # BuildStrategy.pass_pipeline overrides FLAGS_pass_pipeline when set
+        program = _apply_pass_pipeline(
+            program, self._scope, list(feed.keys()), fetch_names,
+            pipeline=self._build_strategy.pass_pipeline,
+        )
+        block = program.global_block()
         feed_arrays = {}
         batch_dim = 1 if is_multi else 0  # stacked feeds: [k, N, ...]
         for name, value in feed.items():

@@ -1,0 +1,48 @@
+"""Graph-level optimization pass framework (reference framework/ir/:
+ir::Graph + Pass + PassRegistry + BuildStrategy::Apply — PAPER.md rows
+L2/L3; docs/passes.md).
+
+Program → Graph(program) → [Pass, Pass, ...] → Program, with a lossless
+round-trip, per-pass invariant verification, telemetry, and flag-gated
+debug dumps. Both executors and the serving engine apply pipelines at one
+choke point before lowering (executor._apply_pass_pipeline); presets live
+in manager.PRESETS and are selected via BuildStrategy.pass_pipeline,
+FLAGS_pass_pipeline, or aot_serve_lowering's default "inference".
+"""
+
+from .graph import Graph, GraphVerifyError, OpNode, VarNode, clone_program
+from .manager import (
+    PRESETS,
+    PassManager,
+    apply_cached,
+    apply_inplace,
+    resolve_pipeline,
+)
+from .pass_base import (
+    PASSES,
+    Pass,
+    PassContext,
+    get_pass,
+    register_pass,
+    registered_passes,
+)
+from . import builtin, ports  # noqa: F401  (self-registering pass battery)
+
+__all__ = [
+    "Graph",
+    "GraphVerifyError",
+    "OpNode",
+    "VarNode",
+    "clone_program",
+    "Pass",
+    "PassContext",
+    "PassManager",
+    "PASSES",
+    "PRESETS",
+    "apply_cached",
+    "apply_inplace",
+    "get_pass",
+    "register_pass",
+    "registered_passes",
+    "resolve_pipeline",
+]

@@ -189,10 +189,17 @@ def _hlo_op_attribution(hlo_text):
         key = None
         out = None
         for i, seg in enumerate(path):
-            # skip jit/transform wrappers and arg-pytree paths like
+            # skip jit/transform wrappers, arg-pytree paths like
             # "feeds['img']" / "mut_state['w_0']" (donation copies — those
-            # group under their HLO opcode instead)
-            if seg.startswith("jit(") or seg.startswith("transpose(") or "[" in seg:
+            # group under their HLO opcode instead), and the
+            # fusion-group wrapper the fuse_elemwise_act pass adds (its
+            # member ops carry their own type segments one level deeper)
+            if (
+                seg.startswith("jit(")
+                or seg.startswith("transpose(")
+                or seg.startswith("fusion_group=")
+                or "[" in seg
+            ):
                 continue
             key = seg
             if i + 1 < len(path) and path[i + 1].startswith("out="):

@@ -1,22 +1,18 @@
 """InferenceTranspiler: fold batch-norm into conv weights for serving.
 
-Reference analog: python/paddle/fluid/transpiler/inference_transpiler.py —
-its two rewrites are conv+bn fusion (fuse_batch_norm) and conv+relu/
-conv+elementwise_add fusion (MKLDNN-only). On TPU, elementwise fusion is XLA's
-job (those passes are documented no-ops), but conv+bn folding is still a real
-win for inference: it removes the bn op and its four state tensors entirely by
-rewriting the conv weights in the scope —
+DEPRECATED SHIM — the rewrite now lives in the pass framework as
+passes/ports.py `fold_batch_norm` (run it via
+`passes.apply_inplace(program, ["fold_batch_norm"], scope=scope)` or any
+pipeline spec); this class is kept as the reference-compatible entry point
+(python/paddle/fluid/transpiler/inference_transpiler.py) and delegates.
+
+Reference analog + arithmetic (now in FoldBatchNormPass): conv+bn fusion
     W' = W * gamma / sqrt(var + eps)        (per output channel)
     b' = (b - mean) * gamma / sqrt(var + eps) + beta
-exactly the reference's _fuse_param arithmetic (inference_transpiler.py).
-Patterns handled: conv2d → batch_norm and conv2d → elementwise_add →
-batch_norm (bias as a separate add, which is how layers.conv2d builds it).
-The bn op is replaced by / merged into an elementwise_add carrying b'.
+for conv2d → batch_norm and conv2d → elementwise_add → batch_norm patterns;
+the conv+relu/conv+elementwise_add MKLDNN fusions remain XLA's job
+(documented no-ops).
 """
-
-import numpy as np
-
-from ..framework import Operator, OpRole
 
 __all__ = ["InferenceTranspiler"]
 
@@ -24,90 +20,10 @@ __all__ = ["InferenceTranspiler"]
 class InferenceTranspiler:
     def transpile(self, program, place=None, scope=None):
         """Rewrite `program` in place; `scope` must hold the trained params
-        (reference signature transpile(program, place, scope))."""
+        (reference signature transpile(program, place, scope)). Deprecated:
+        delegates to the `fold_batch_norm` pass."""
         from ..executor import global_scope
+        from ..passes import apply_inplace
 
         scope = scope or global_scope()
-        self._fuse_batch_norm(program, scope)
-
-    # ------------------------------------------------------------------ #
-    def _fuse_batch_norm(self, program, scope):
-        block = program.global_block()
-        i = 0
-        while i < len(block.ops):
-            trio = self._match(block, i)
-            if trio is None:
-                i += 1
-                continue
-            conv_op, add_op, bn_op = trio
-            self._fold(block, scope, conv_op, add_op, bn_op)
-            program._bump_version()
-            # re-scan from the conv (list indices shifted)
-            i = block.ops.index(conv_op)
-            i += 1
-
-    def _match(self, block, i):
-        """Return (conv, add_or_None, bn) rooted at op i, else None."""
-        ops = block.ops
-        op = ops[i]
-        if op.type not in ("conv2d", "depthwise_conv2d") or not op.output("Output"):
-            return None
-        out = op.output("Output")[0]
-        users = [o for o in ops if out in o.input_arg_names]
-        if len(users) != 1:
-            return None
-        nxt = users[0]
-        add_op = None
-        if nxt.type == "elementwise_add" and nxt.input("X") == [out]:
-            add_out = nxt.output("Out")[0]
-            users2 = [o for o in ops if add_out in o.input_arg_names]
-            if len(users2) != 1:
-                return None
-            add_op, nxt = nxt, users2[0]
-        if nxt.type == "batch_norm" and nxt.attrs.get("is_test", False):
-            return (op, add_op, nxt)
-        return None
-
-    @staticmethod
-    def _fold(block, scope, conv_op, add_op, bn_op):
-        import jax.numpy as jnp
-
-        w_name = conv_op.input("Filter")[0]
-        gamma = np.asarray(scope.find_var(bn_op.input("Scale")[0]))
-        beta = np.asarray(scope.find_var(bn_op.input("Bias")[0]))
-        mean = np.asarray(scope.find_var(bn_op.input("Mean")[0]))
-        var = np.asarray(scope.find_var(bn_op.input("Variance")[0]))
-        eps = float(bn_op.attrs.get("epsilon", 1e-5))
-        std_inv = gamma / np.sqrt(var + eps)
-
-        w = np.asarray(scope.find_var(w_name), dtype=np.float32)
-        # conv filter layout (out_c, in_c, kh, kw): scale per out channel
-        w = w * std_inv.reshape((-1,) + (1,) * (w.ndim - 1))
-        scope.set_var(w_name, jnp.asarray(w))
-
-        bn_out = bn_op.output("Y")[0]
-        if add_op is not None:
-            # existing bias: b' = (b - mean) * std_inv + beta
-            b_name = add_op.input("Y")[0]
-            b = np.asarray(scope.find_var(b_name), dtype=np.float32)
-            scope.set_var(b_name, jnp.asarray((b - mean) * std_inv + beta))
-            add_op.outputs["Out"] = [bn_out]
-        else:
-            # no bias add: introduce one carrying the folded shift
-            b_name = w_name + ".bn_bias"
-            block.create_var(
-                name=b_name, shape=(len(beta),), dtype="float32", persistable=True
-            )
-            scope.set_var(b_name, jnp.asarray(beta - mean * std_inv))
-            conv_out = conv_op.output("Output")[0]
-            idx = block.ops.index(bn_op)
-            block.ops[idx] = Operator(
-                block,
-                "elementwise_add",
-                inputs={"X": [conv_out], "Y": [b_name]},
-                outputs={"Out": [bn_out]},
-                attrs={"axis": 1, OpRole.OP_ROLE_KEY: OpRole.Forward},
-            )
-            return
-        # drop the bn op (its output now produced by the add)
-        block.ops.remove(bn_op)
+        apply_inplace(program, ["fold_batch_norm"], scope=scope)

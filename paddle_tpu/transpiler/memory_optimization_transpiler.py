@@ -1,148 +1,37 @@
 """memory_optimize: liveness-based in-place variable reuse on a Program.
 
-Reference analog: python/paddle/fluid/transpiler/memory_optimization_transpiler.py
-(ControlFlowGraph liveness at :113, memory_optimize entry at :457): dataflow
-liveness analysis over the op list, then renaming later vars onto dead earlier
-vars of matching dtype/size so the C++ executor reuses their buffers.
+DEPRECATED SHIM — the transform now lives in the pass framework as
+passes/ports.py `memory_optimize` (run it via
+`passes.apply_inplace(program, ["memory_optimize"], ...)` or any pipeline
+spec); these functions are kept as the reference-compatible entry points
+(python/paddle/fluid/transpiler/memory_optimization_transpiler.py:457) and
+delegate.
 
-TPU-native status: inside one jitted block XLA's buffer assignment already
-performs this optimally, so renaming cannot shrink device memory further —
-the transform is kept because (a) it is part of the public transpiler API,
-(b) it reduces the number of distinct names the executor tracks across
-feed/fetch and host-op segment boundaries, where values DO materialize, and
-(c) its statistics (`memory_optimize(..., print_log=True)`) report the same
-reuse plan the reference printed. Semantics are preserved: only
+TPU-native status (unchanged): inside one jitted block XLA's buffer
+assignment already performs this reuse optimally, so renaming cannot shrink
+device memory further — the transform is kept because (a) it is part of the
+public transpiler API, (b) it reduces the number of distinct names the
+executor tracks across feed/fetch and host-op segment boundaries, where
+values DO materialize, and (c) its statistics (print_log=True) report the
+same reuse plan the reference printed. Semantics are preserved: only
 non-persistable, non-fetched, same-dtype same-size vars are merged.
 """
 
-import numpy as np
-
-from .. import framework
-
 __all__ = ["memory_optimize", "release_memory"]
-
-# ops whose outputs alias inputs or that the renamer must not touch
-# (reference SUB_BLOCK_OPS + skip list)
-_SKIP_OP_TYPES = frozenset(
-    ["while", "conditional_block", "recurrent", "listen_and_serv"]
-)
-
-
-class _Liveness:
-    """Backward liveness over the straight-line op list (the reference's
-    ControlFlowGraph restricted to block 0, which is where it applies it)."""
-
-    def __init__(self, block, protected):
-        self.block = block
-        self.protected = protected
-        n = len(block.ops)
-        self.live_after = [set() for _ in range(n)]
-        live = set(protected)
-        for i in range(n - 1, -1, -1):
-            op = block.ops[i]
-            self.live_after[i] = set(live)
-            live -= set(op.output_arg_names)
-            live |= set(op.input_arg_names)
 
 
 def memory_optimize(input_program, skip_opt_set=None, print_log=False, level=0):
     """Rewrite `input_program` in place, renaming dead intermediate vars onto
-    compatible earlier ones. Returns the reuse mapping {new_name: old_name}."""
-    block = input_program.global_block()
-    skip = set(skip_opt_set or ())
-    protected = set(skip)
-    for name, v in block.vars.items():
-        if v.persistable or v.is_data or getattr(v, "stop_gradient", False):
-            protected.add(name)
-    # vars referenced by sub-block ops stay untouched (reference SUB_BLOCK_PAIR
-    # handling): renaming across block boundaries is not worth the risk
-    for blk in input_program.blocks[1:]:
-        for op in blk.ops:
-            protected.update(op.input_arg_names)
-            protected.update(op.output_arg_names)
-    for op in block.ops:
-        if op.type in _SKIP_OP_TYPES:
-            protected.update(op.input_arg_names)
-            protected.update(op.output_arg_names)
+    compatible earlier ones. Returns the reuse mapping {new_name: old_name}.
+    Deprecated: delegates to the `memory_optimize` pass."""
+    from ..passes import apply_inplace
 
-    liveness = _Liveness(block, protected)
-    free_pool = {}  # (dtype, shape) -> [buffer names free for reuse]
-    mapping = {}  # original var name -> buffer name it now occupies
-    occupants = {}  # buffer name -> set of original names mapped onto it
-
-    def pool_key(v):
-        # Exact dtype+shape match, with a dynamic (-1) dim allowed: two vars
-        # whose static shapes are identical occupy equal-size buffers at
-        # runtime even when the batch dim is symbolic (the reference compares
-        # shapes the same way, memory_optimization_transpiler.py:150-163).
-        if v.shape is None:
-            return None
-        return (v.dtype, tuple(v.shape))
-
-    for i, op in enumerate(block.ops):
-        # inputs were defined earlier — apply their renames
-        for slot, names in op.inputs.items():
-            op.inputs[slot] = [mapping.get(n, n) for n in names]
-        # outputs defined here: try to place each onto a free dead buffer
-        for out in op.output_arg_names:
-            if out in protected or out in mapping or not block.has_var(out):
-                continue
-            key = pool_key(block.var(out))
-            if key is None:
-                continue
-            candidates = free_pool.get(key)
-            if candidates:
-                buf = candidates.pop()
-                mapping[out] = buf
-                occupants.setdefault(buf, set()).add(out)
-        for slot, names in op.outputs.items():
-            op.outputs[slot] = [mapping.get(n, n) for n in names]
-        # original vars whose live range ends here free their buffer
-        live = liveness.live_after[i]
-        for name in set(op.input_arg_names) | set(op.output_arg_names):
-            # `name` is a buffer name; free only once every original mapped
-            # onto it (and itself) is dead
-            originals = occupants.get(name) or (name,)
-            if name in live or any(o in live for o in originals):
-                continue
-            if name in protected or not block.has_var(name):
-                continue
-            key = pool_key(block.var(name))
-            if key is None:
-                continue
-            lst = free_pool.setdefault(key, [])
-            if name not in lst:
-                lst.append(name)
-
-    # drop now-unreferenced vars
-    if mapping:
-        used = set()
-        for op in block.ops:
-            used.update(op.input_arg_names)
-            used.update(op.output_arg_names)
-        for old in list(block.vars):
-            if old in mapping and old not in used:
-                del block.vars[old]
-        input_program._bump_version()
-
-    if print_log:
-        saved = 0
-        for new, old in mapping.items():
-            v = block.vars.get(old) or block.vars.get(new)
-            if v is None or v.shape is None:
-                continue
-            # product of known dims: per-sample bytes when batch dim is -1
-            n = 1
-            for d in v.shape:
-                n *= d if d and d > 0 else 1
-            saved += n * np.dtype(
-                "float32" if v.dtype == "bfloat16" else v.dtype
-            ).itemsize
-        print(
-            "memory_optimize: reused %d buffers (~%.1f KB/sample host-visible)"
-            % (len(mapping), saved / 1024.0)
-        )
-    return mapping
+    results = apply_inplace(
+        input_program,
+        ["memory_optimize"],
+        attrs={"skip_opt_set": skip_opt_set, "print_log": print_log},
+    )
+    return results["memory_optimize"]["mapping"]
 
 
 def release_memory(input_program, skip_opt_set=None):

@@ -365,6 +365,69 @@ JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_elastic.py::test_sigkill_one_of_two_hosts_resumes_bit_exact \
     tests/test_elastic.py::test_dp2_to_dp1_resume_parity
 
+echo "== pass-framework smoke (docs/passes.md) =="
+# graph pass pipeline: LeNet trains with FLAGS_pass_pipeline=training_default
+# and FLAGS_pass_debug_dir set; asserts the round-trip is bit-lossless, the
+# per-pass debug dumps exist, and pipeline-on losses match pipeline-off
+# within 1e-6 (they are in fact bit-identical; tests/test_passes.py holds
+# the strict form)
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'PY'
+import json, os, sys, tempfile
+import numpy as np
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu import passes
+from paddle_tpu.executor import Scope, scope_guard
+
+sys.path.insert(0, "tests")
+from test_mnist import lenet, make_batch
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        avg_loss, _ = lenet(img, label)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_loss)
+    return main, startup, avg_loss.name
+
+main, _, _ = build()
+fp = lambda p: json.dumps(p.to_dict(), sort_keys=True)
+assert fp(passes.Graph(main).to_program()) == fp(main), "round-trip not lossless"
+
+d = tempfile.mkdtemp(prefix="pass-dumps-")
+def losses(pipeline, debug_dir=""):
+    pt.set_flags({"pass_pipeline": pipeline, "pass_debug_dir": debug_dir})
+    try:
+        main, startup, loss_name = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(3)
+        out = []
+        with scope_guard(Scope(seed=11)):
+            exe.run(startup)
+            for _ in range(4):
+                imgs, labels = make_batch(rng, 32)
+                (lv,) = exe.run(main, feed={"img": imgs, "label": labels},
+                                fetch_list=[loss_name])
+                out.append(float(np.asarray(lv).ravel()[0]))
+        return np.asarray(out)
+    finally:
+        pt.set_flags({"pass_pipeline": "", "pass_debug_dir": ""})
+
+off = losses("")
+on = losses("training_default", debug_dir=d)
+delta = float(np.abs(off - on).max())
+assert delta < 1e-6, "pipeline on/off loss diverged: %r vs %r" % (off, on)
+dumps = sorted(os.listdir(d))
+for i, name in enumerate(passes.PRESETS["training_default"]):
+    for suffix in ("before.dot", "after.dot", "ops.diff"):
+        want = "%02d_%s_%s" % (i, name, suffix)
+        assert want in dumps, "missing debug dump %s (have %s)" % (want, dumps)
+print("pass smoke ok: lossless round-trip, %d debug dumps, "
+      "on/off max loss delta %.2g over 4 steps" % (len(dumps), delta))
+PY
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

@@ -201,6 +201,38 @@ def _embedding_summary(metrics):
     return tables
 
 
+def _passes_summary(metrics):
+    """Graph-pass pipeline stats from a snapshot's metric dump: the
+    passes/... namespace written by paddle_tpu.passes.manager — per-pass
+    wall ms and op counts (labeled pass=<name>), fusion groups formed, and
+    pipeline application counts (labeled pipeline=<spec>)."""
+    fields = {}
+    for name in metrics:
+        parts = name.split("/")
+        if len(parts) == 2 and parts[0] == "passes":
+            fields[parts[1]] = (metrics[name] or {}).get("values") or {}
+    if not fields:
+        return {}
+
+    per_pass = {}
+    for field in ("applied", "wall_ms", "ops_before", "ops_after",
+                  "ops_removed"):
+        for label, v in fields.get(field, {}).items():
+            pname = label.split("=", 1)[1] if "=" in label else label or "?"
+            per_pass.setdefault(pname, {})[field] = v
+    out = {"passes": per_pass}
+    fg = fields.get("fusion_groups", {})
+    if fg:
+        out["fusion_groups"] = sum(fg.values())
+    pipelines = fields.get("pipelines", {})
+    if pipelines:
+        out["pipelines"] = {
+            (label.split("=", 1)[1] if "=" in label else label): v
+            for label, v in pipelines.items()
+        }
+    return out
+
+
 def _resilience_summary(metrics):
     """Elastic-runtime stats from a snapshot's metric dump: the
     resilience/... namespace written by paddle_tpu.resilience.async_ckpt
@@ -287,6 +319,7 @@ def summarize(records, window=200):
         "data": {},
         "embedding": {},
         "resilience": {},
+        "passes": {},
     }
 
     if opprofs:
@@ -353,6 +386,7 @@ def summarize(records, window=200):
         summary["data"] = _data_summary(metrics)
         summary["embedding"] = _embedding_summary(metrics)
         summary["resilience"] = _resilience_summary(metrics)
+        summary["passes"] = _passes_summary(metrics)
         summary["health"] = dict(last.get("health", {}))
         memrec = last.get("mem", {})
         if memrec.get("mem_peak_bytes"):
@@ -525,6 +559,25 @@ def render(summary):
             _fmt(res.get("watchdog_stalls"), "{:.0f}", "0"),
         )
         rows.append(("resilience/events", events))
+    passes = summary.get("passes") or {}
+    for pname, p in sorted((passes.get("passes") or {}).items()):
+        before = p.get("ops_before")
+        after = p.get("ops_after")
+        rows.append((
+            "pass/" + pname,
+            "%s ms, ops %s -> %s (%s applications, %s removed)" % (
+                _fmt(p.get("wall_ms")),
+                _fmt(before, "{:.0f}"),
+                _fmt(after, "{:.0f}"),
+                _fmt(p.get("applied"), "{:.0f}", "0"),
+                _fmt(p.get("ops_removed"), "{:.0f}", "0"),
+            ),
+        ))
+    if passes.get("fusion_groups"):
+        rows.append((
+            "pass/fusion groups",
+            _fmt(passes["fusion_groups"], "{:.0f}"),
+        ))
     for name in sorted(summary["health"]):
         rows.append(("health/" + name, str(summary["health"][name])))
     for op, total_ms, pct in summary.get("top_ops", []):

@@ -31,6 +31,7 @@ MODULES = [
     "paddle_tpu.regularizer",
     "paddle_tpu.profiler",
     "paddle_tpu.transpiler",
+    "paddle_tpu.passes",
     "paddle_tpu.reader",
     "paddle_tpu.reader.creator",
     "paddle_tpu.imperative",
