@@ -453,7 +453,8 @@ def build_transformer(b=8, t=1024, d=2048, n_layer=4, vocab=32000,
 
 
 def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=30,
-                        warmup=3, moment_dtype="bfloat16"):
+                        warmup=3, moment_dtype="bfloat16",
+                        pass_pipeline=None):
     """Secondary metric: MFU on a compute-dense Transformer train step (the
     north-star metric is MFU, BASELINE.md — ResNet-50 on one v5e chip is
     HBM-bound by its BN/elementwise tier (PROFILE.md), so a matmul-dominated
@@ -469,8 +470,20 @@ def run_transformer_mfu(b=8, t=1024, d=2048, n_layer=4, vocab=32000, steps=30,
     )
     import jax.numpy as jnp
 
+    # pass_pipeline (e.g. "training_fused" for the Pallas kernel-substitution
+    # tier) applies only to this bench step and is restored on exit
+    from contextlib import ExitStack
+
+    from paddle_tpu import flags as _flags
+
+    stack = ExitStack()
+    if pass_pipeline is not None:
+        prev = _flags.get_flags("pass_pipeline")["pass_pipeline"]
+        _flags.set_flags({"pass_pipeline": pass_pipeline})
+        stack.callback(lambda: _flags.set_flags({"pass_pipeline": prev}))
+
     exe = fluid.Executor(fluid.TPUPlace())
-    with scope_guard(Scope(seed=0)):
+    with stack, scope_guard(Scope(seed=0)):
         exe.run(startup)
         from paddle_tpu.transpiler.bf16_transpiler import Bf16Transpiler
 
@@ -1657,6 +1670,23 @@ def main():
                 json.dump(rec, f, indent=1)
         print(json.dumps(rec, indent=1))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "mfu_audit":
+        # per-HLO MFU gap audit with the HBM memcpy microbench grounding the
+        # memory roofline in measured bandwidth (tools/mfu_audit.py; ISSUE
+        # 11 satellite). All trailing args pass through, e.g.:
+        #   python bench.py mfu_audit transformer --pass-pipeline
+        #   training_fused --probe
+        import importlib.util as _ilu
+
+        spec = _ilu.spec_from_file_location(
+            "mfu_audit",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "mfu_audit.py"),
+        )
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.main(sys.argv[2:])
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         # serving-runtime evidence pass (scripts/build_and_test.sh): writes
         # SERVING.json next to this file
@@ -1728,8 +1758,10 @@ def main():
         # headline MFU config: bf16-stored Adam moments (f32 compute) — the
         # TPU-native training configuration (convergence-tested,
         # tests/test_ops_optimizers.py) which halves optimizer-state memory
-        # and its share of the dW-fusion HBM traffic (PROFILE.md audit)
-        mfu = run_transformer_mfu()
+        # and its share of the dW-fusion HBM traffic (PROFILE.md audit) —
+        # under the training_fused preset (Pallas GEMM-epilogue /
+        # layer_norm / multi-tensor-Adam substitution, docs/passes.md)
+        mfu = run_transformer_mfu(pass_pipeline="training_fused")
         tfs = mfu["tflops_min_window"]
         record["transformer_tflops_per_sec"] = round(tfs, 1)
         record["transformer_mfu_vs_nominal_peak"] = round(tfs / NOMINAL_BF16_TFLOPS, 3)
@@ -1741,6 +1773,20 @@ def main():
         record["transformer_window_ms_per_step"] = mfu["window_ms_per_step"]
     except Exception as e:
         print("transformer MFU pass failed: %r" % e, file=sys.stderr)
+    try:
+        # kernel-substitution ablation: the SAME step with the fuse_* passes
+        # off — the delta against the headline is the Pallas tier's win
+        mfu_unfused = run_transformer_mfu(pass_pipeline="")
+        tfs_u = mfu_unfused["tflops_min_window"]
+        record["transformer_tflops_unfused"] = round(tfs_u, 1)
+        record["transformer_mfu_unfused"] = round(
+            tfs_u / NOMINAL_BF16_TFLOPS, 3
+        )
+        record["transformer_unfused_window_ms_per_step"] = mfu_unfused[
+            "window_ms_per_step"
+        ]
+    except Exception as e:
+        print("unfused-ablation MFU pass failed: %r" % e, file=sys.stderr)
     try:
         # reference-comparable variant: full-f32 Adam state
         mfu_f32 = run_transformer_mfu(moment_dtype=None)

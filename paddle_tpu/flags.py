@@ -85,9 +85,11 @@ Honored flags:
   commit barrier) before DeadlineExceeded.
 - pass_pipeline: graph-pass pipeline both executors apply at the lowering
   choke point (paddle_tpu/passes, docs/passes.md): a preset name
-  ("training_default", "inference") or a comma-separated pass list; ""
-  (default) disables. ParallelExecutor's BuildStrategy.pass_pipeline
-  overrides this per executor when set.
+  ("training_default", "inference", or "training_fused" — the latter adds
+  the Pallas kernel-substitution taggers) or a comma-separated pass list;
+  "" (default) disables. ParallelExecutor's BuildStrategy.pass_pipeline
+  (or BuildStrategy.fuse_kernels=True) overrides this per executor when
+  set.
 - pass_debug_dir: when set, the PassManager writes per-pass debug dumps
   into this directory — before/after graphviz of block 0 (via
   debugger.draw_block_graphviz) and a textual op diff, named

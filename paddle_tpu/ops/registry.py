@@ -229,6 +229,58 @@ OUT_SCOPE_PREFIX = "out="
 FUSION_GROUP_ATTR = "__fusion_group__"
 FUSION_SCOPE_PREFIX = "fusion_group="
 
+# Kernel-substitution tier (docs/passes.md "Kernel substitution"): the
+# fuse_gemm_epilogue / fuse_layer_norm / fuse_optimizer passes tag op runs
+# with a group id + a kernel family name; lower_ops hands a contiguous
+# same-group run to the family's registered FUSED lowering (a Pallas kernel,
+# ops/pallas_kernels.py) instead of lowering op by op. A fused lowering may
+# DECLINE at trace time (ragged shapes, unsupported attrs, ZeRO-1 sharding)
+# by returning False — the run then falls back to per-op lowering with
+# identical semantics, so tagging is always safe. Like FUSION_GROUP_ATTR
+# the tags are attr-only: def-use, op order, and count are untouched.
+PALLAS_GROUP_ATTR = "__pallas_group__"
+PALLAS_KERNEL_ATTR = "__pallas_kernel__"
+PALLAS_SCOPE_PREFIX = "pallas_kernel="
+
+# kernel family name -> fused lowering fn(ctx, ops, env) -> bool (True when
+# the run was handled and its outputs written into env)
+FUSED_LOWERINGS = {}
+
+
+def register_fused(family):
+    """Decorator: @register_fused("gemm_epilogue")
+    def lower_run(ctx, ops, env) -> bool: ..."""
+
+    def deco(fn):
+        FUSED_LOWERINGS[family] = fn
+        return fn
+
+    return deco
+
+
+def gather_op_inputs(op, env):
+    """Resolve an op's input slots from the lowering env (shared by
+    _lower_one and the fused lowerings)."""
+    ins = {}
+    for slot, names in op.inputs.items():
+        if names:
+            ins[slot] = [
+                env[n] if n != EMPTY_VAR_NAME else None for n in names
+            ]
+    return ins
+
+
+def scatter_op_outputs(op, outs, env):
+    """Bind an op's output slots back into the lowering env (shared by
+    _lower_one and the fused lowerings)."""
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for name, val in zip(names, vals):
+            if val is not None and name != EMPTY_VAR_NAME:
+                env[name] = val
+
 
 def op_output_scope(op):
     """Scope name carrying the op's identity (its first real output var) into
@@ -245,12 +297,7 @@ def _lower_one(ctx, op, env):
     opdef = get(op.type)
     if opdef.skip_exec:
         return
-    ins = {}
-    for slot, names in op.inputs.items():
-        if names:
-            ins[slot] = [
-                env[n] if n != EMPTY_VAR_NAME else None for n in names
-            ]
+    ins = gather_op_inputs(op, env)
     # named_scope tags every HLO this op emits with op_name="…/<type>/…"
     # metadata — the correlation key profiler.device_op_profile uses to
     # fold XLA's per-HLO device timings back onto framework op types
@@ -265,13 +312,26 @@ def _lower_one(ctx, op, env):
         else:
             with jax.named_scope(out_scope):
                 outs = opdef.lower(ctx, ins, op.attrs)
-    for slot, names in op.outputs.items():
-        vals = outs.get(slot)
-        if vals is None:
-            continue
-        for name, val in zip(names, vals):
-            if val is not None and name != EMPTY_VAR_NAME:
-                env[name] = val
+    scatter_op_outputs(op, outs, env)
+
+
+def _lower_pallas_run(ctx, run, env):
+    """Try the registered fused Pallas lowering for a tagged run; fall back to
+    per-op lowering when the family is unknown or the lowering declines."""
+    family = run[0].attrs.get(PALLAS_KERNEL_ATTR)
+    fused = FUSED_LOWERINGS.get(family)
+    gid = run[0].attrs.get(PALLAS_GROUP_ATTR)
+    # "<family>.<gid>" so the profiler can attribute the kernel's HLO to a
+    # "pallas:<family>" row with per-group instances (profiler.py)
+    scope = PALLAS_SCOPE_PREFIX + _SCOPE_UNSAFE.sub(
+        "_", "%s.%s" % (family, gid)
+    )
+    if fused is not None:
+        with jax.named_scope(scope):
+            if fused(ctx, run, env):
+                return
+    for member in run:
+        _lower_one(ctx, member, env)
 
 
 def lower_ops(ctx, ops, env):
@@ -285,17 +345,34 @@ def lower_ops(ctx, ops, env):
     Contiguous runs of ops sharing a FUSION_GROUP_ATTR value (tagged by the
     fuse_elemwise_act pass) lower inside ONE enclosing named_scope: the
     group's HLO shares an op_name prefix, so XLA's fusion heuristics and the
-    profiler's attribution both see the chain as a unit."""
+    profiler's attribution both see the chain as a unit.
+
+    Contiguous runs sharing a PALLAS_GROUP_ATTR value (tagged by the
+    fuse_gemm_epilogue / fuse_layer_norm / fuse_optimizer passes) are handed
+    to the family's fused Pallas lowering (FUSED_LOWERINGS); a decline falls
+    back to per-op lowering. Pallas tags take precedence over fusion-group
+    tags when an op carries both (the kernel subsumes the XLA fusion hint)."""
     i, n = 0, len(ops)
     while i < n:
         op = ops[i]
+        pg = op.attrs.get(PALLAS_GROUP_ATTR)
+        if pg is not None:
+            j = i
+            while j < n and ops[j].attrs.get(PALLAS_GROUP_ATTR) == pg:
+                j += 1
+            _lower_pallas_run(ctx, ops[i:j], env)
+            i = j
+            continue
         fg = op.attrs.get(FUSION_GROUP_ATTR)
         if fg is None:
             _lower_one(ctx, op, env)
             i += 1
             continue
         j = i
-        while j < n and ops[j].attrs.get(FUSION_GROUP_ATTR) == fg:
+        while j < n and (
+            ops[j].attrs.get(FUSION_GROUP_ATTR) == fg
+            and ops[j].attrs.get(PALLAS_GROUP_ATTR) is None
+        ):
             j += 1
         with jax.named_scope(
             FUSION_SCOPE_PREFIX + _SCOPE_UNSAFE.sub("_", str(fg))

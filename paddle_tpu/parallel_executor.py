@@ -80,6 +80,22 @@ class BuildStrategy:
         # docs/passes.md): a manager.PRESETS name or comma-separated pass
         # list; "" disables. None (default) defers to FLAGS_pass_pipeline.
         self.pass_pipeline = None
+        # True -> lower tagged matmul+bias[+act] / layer_norm(+residual) /
+        # adam-run chains through the hand-tuned Pallas kernels (the
+        # "training_fused" preset; docs/passes.md kernel substitution).
+        # Only consulted when pass_pipeline is None — an explicit pipeline
+        # always wins.
+        self.fuse_kernels = False
+
+    def resolved_pass_pipeline(self):
+        """The pipeline the executor should apply: pass_pipeline verbatim
+        when set (even ""), else "training_fused" when fuse_kernels, else
+        None (defer to FLAGS_pass_pipeline)."""
+        if self.pass_pipeline is not None:
+            return self.pass_pipeline
+        if self.fuse_kernels:
+            return "training_fused"
+        return None
 
 
 class ExecutionStrategy:
@@ -238,7 +254,7 @@ class ParallelExecutor:
         # BuildStrategy.pass_pipeline overrides FLAGS_pass_pipeline when set
         program = _apply_pass_pipeline(
             program, self._scope, list(feed.keys()), fetch_names,
-            pipeline=self._build_strategy.pass_pipeline,
+            pipeline=self._build_strategy.resolved_pass_pipeline(),
         )
         block = program.global_block()
         feed_arrays = {}

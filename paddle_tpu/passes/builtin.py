@@ -14,6 +14,9 @@ __all__ = [
     "ConstantFoldPass",
     "DeadOpEliminatePass",
     "FuseElemwiseActPass",
+    "FuseGemmEpiloguePass",
+    "FuseLayerNormPass",
+    "FuseOptimizerPass",
     "InplaceDonationPlanPass",
 ]
 
@@ -296,6 +299,180 @@ class FuseElemwiseActPass(Pass):
         if act is not None and act.type in _FUSE_ACTS:
             chain.append(act)
         return chain
+
+
+# chains the kernel-substitution taggers hand to Pallas. These passes only
+# TAG: every shape/dtype/attr decision is re-validated at trace time by the
+# @register_fused lowering (ops/pallas_kernels.py), which declines back to
+# the per-op path — so tagging can be optimistic without risking semantics.
+_PALLAS_GEMM_PRODUCERS = ("mul", "matmul")
+_PALLAS_GEMM_ACTS = ("relu", "gelu", "tanh", "sigmoid")
+
+
+def _pallas_free(op):
+    from ..ops.registry import PALLAS_GROUP_ATTR
+
+    return PALLAS_GROUP_ATTR not in op.attrs
+
+
+def _tag_run(run, gid, family):
+    from ..ops.registry import PALLAS_GROUP_ATTR, PALLAS_KERNEL_ATTR
+
+    for member in run:
+        member.attrs[PALLAS_GROUP_ATTR] = gid
+        member.attrs[PALLAS_KERNEL_ATTR] = family
+
+
+@register_pass("fuse_gemm_epilogue")
+class FuseGemmEpiloguePass(Pass):
+    """Tag mul|matmul → elementwise_add [→ act] chains for the fused Pallas
+    GEMM epilogue (ops/pallas_kernels.py `gemm_epilogue`): bias add and
+    activation computed on the f32 MXU accumulator with ONE rounding to the
+    output dtype. Unlike fuse_elemwise_act (a named-scope hint this pass
+    happily coexists with — Pallas tags take precedence in lower_ops), the
+    wiring check here is strict slot equality, because the fused lowering
+    replaces the ops' math rather than just scoping it."""
+
+    def apply(self, graph, ctx):
+        ops = graph.program.global_block().ops
+        groups = 0
+        tagged = 0
+        i = 0
+        while i < len(ops):
+            op = ops[i]
+            if op.type not in _PALLAS_GEMM_PRODUCERS or not _pallas_free(op):
+                i += 1
+                continue
+            chain = self._chain_at(ops, i)
+            if chain is None:
+                i += 1
+                continue
+            _tag_run(chain, "gemm%d" % groups, "gemm_epilogue")
+            tagged += len(chain)
+            groups += 1
+            i += len(chain)
+        ctx.results[self.name] = {"groups": groups, "ops_tagged": tagged}
+        if groups:
+            graph.program._bump_version()
+
+    @staticmethod
+    def _chain_at(ops, i):
+        prod = ops[i]
+        if i + 1 >= len(ops) or not prod.output_arg_names:
+            return None
+        add = ops[i + 1]
+        if (
+            add.type != "elementwise_add"
+            or not _pallas_free(add)
+            or add.input("X") != [prod.output("Out")[0]]
+        ):
+            return None
+        chain = [prod, add]
+        if i + 2 < len(ops):
+            act = ops[i + 2]
+            if (
+                act.type in _PALLAS_GEMM_ACTS
+                and _pallas_free(act)
+                and act.input("X") == [add.output("Out")[0]]
+            ):
+                chain.append(act)
+        return chain
+
+
+@register_pass("fuse_layer_norm")
+class FuseLayerNormPass(Pass):
+    """Tag [elementwise_add →] layer_norm chains for the fused Pallas
+    layer_norm(+residual) forward (`layer_norm` family: residual add in the
+    input dtype, one-pass Welford stats and normalization in f32), and every
+    layer_norm_grad as a singleton for the explicit backward kernel
+    (`layer_norm_grad` family). Grad ops never inherit forward tags —
+    backward.py copies attrs at build time, before any pass runs — so the
+    backward must be tagged here explicitly."""
+
+    def apply(self, graph, ctx):
+        ops = graph.program.global_block().ops
+        groups = 0
+        tagged = 0
+        i = 0
+        while i < len(ops):
+            op = ops[i]
+            if not _pallas_free(op):
+                i += 1
+                continue
+            if op.type == "layer_norm_grad":
+                _tag_run([op], "lng%d" % groups, "layer_norm_grad")
+                groups += 1
+                tagged += 1
+                i += 1
+                continue
+            if (
+                op.type == "elementwise_add"
+                and i + 1 < len(ops)
+                and ops[i + 1].type == "layer_norm"
+                and _pallas_free(ops[i + 1])
+                and ops[i + 1].input("X") == [op.output("Out")[0]]
+            ):
+                _tag_run([op, ops[i + 1]], "ln%d" % groups, "layer_norm")
+                groups += 1
+                tagged += 2
+                i += 2
+                continue
+            if op.type == "layer_norm":
+                _tag_run([op], "ln%d" % groups, "layer_norm")
+                groups += 1
+                tagged += 1
+            i += 1
+        ctx.results[self.name] = {"groups": groups, "ops_tagged": tagged}
+        if groups:
+            graph.program._bump_version()
+
+
+@register_pass("fuse_optimizer")
+class FuseOptimizerPass(Pass):
+    """Tag maximal contiguous runs (≥ 2) of dense adam ops sharing
+    (beta1, beta2, epsilon, LearningRate input) for the fused multi-tensor
+    Adam kernel (`multi_adam` family): every param group flattened into
+    chunk-padded slabs and updated by ONE kernel, f32 master math rounded to
+    the storage dtypes. AdamOptimizer emits exactly this shape — one adam
+    per param back to back, beta-pow scale ops appended after the run."""
+
+    def apply(self, graph, ctx):
+        ops = graph.program.global_block().ops
+        groups = 0
+        tagged = 0
+        i = 0
+        while i < len(ops):
+            op = ops[i]
+            if op.type != "adam" or not _pallas_free(op):
+                i += 1
+                continue
+            key = self._group_key(op)
+            j = i + 1
+            while (
+                j < len(ops)
+                and ops[j].type == "adam"
+                and _pallas_free(ops[j])
+                and self._group_key(ops[j]) == key
+            ):
+                j += 1
+            run = ops[i:j]
+            if len(run) >= 2:
+                _tag_run(run, "madam%d" % groups, "multi_adam")
+                groups += 1
+                tagged += len(run)
+            i = j
+        ctx.results[self.name] = {"groups": groups, "ops_tagged": tagged}
+        if groups:
+            graph.program._bump_version()
+
+    @staticmethod
+    def _group_key(op):
+        return (
+            op.attrs.get("beta1", 0.9),
+            op.attrs.get("beta2", 0.999),
+            op.attrs.get("epsilon", 1e-8),
+            op.input("LearningRate")[0],
+        )
 
 
 @register_pass("inplace_donation_plan")

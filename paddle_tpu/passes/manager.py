@@ -26,6 +26,12 @@ aot_serve_lowering):
   serving path's default (aot_serve_lowering); fold_batch_norm is NOT in
   it because that pass rewrites parameter values in the scope — opt in
   explicitly (or via the InferenceTranspiler shim).
+- training_fused: training_default plus the Pallas kernel-substitution
+  taggers (fuse_gemm_epilogue, fuse_layer_norm, fuse_optimizer) — tagged
+  chains lower to hand-tuned kernels (ops/pallas_kernels.py) instead of
+  per-op XLA; fused-vs-unfused parity is within bf16 rounding (one
+  rounding per fused chain instead of one per op), bit-identical where
+  the chain's math was already f32 (the multi-tensor Adam update).
 """
 
 import difflib
@@ -54,6 +60,15 @@ PRESETS = {
         "constant_fold",
         "dead_op_eliminate",
         "fuse_elemwise_act",
+    ),
+    "training_fused": (
+        "constant_fold",
+        "dead_op_eliminate",
+        "fuse_elemwise_act",
+        "fuse_gemm_epilogue",
+        "fuse_layer_norm",
+        "fuse_optimizer",
+        "inplace_donation_plan",
     ),
 }
 
@@ -103,7 +118,7 @@ def _metrics():
             "passes/ops_removed", "ops eliminated across all applications"
         ),
         "fusion_groups": reg.counter(
-            "passes/fusion_groups", "fusion groups formed by fuse_elemwise_act"
+            "passes/fusion_groups", "groups formed by the fuse_* passes"
         ),
         "pipelines": reg.counter(
             "passes/pipelines", "full pipeline applications, labeled by name"

@@ -201,6 +201,16 @@ def _hlo_op_attribution(hlo_text):
                 or "[" in seg
             ):
                 continue
+            # a Pallas kernel-substitution scope ("pallas_kernel=
+            # <family>.<gid>", registry._lower_pallas_run) replaces its
+            # member ops' HLO wholesale: attribute to a "pallas:<family>"
+            # row with the group id as the instance
+            if seg.startswith("pallas_kernel="):
+                tag = seg[len("pallas_kernel="):]
+                fam, _, gid = tag.partition(".")
+                key = "pallas:" + fam
+                out = gid or None
+                break
             key = seg
             if i + 1 < len(path) and path[i + 1].startswith("out="):
                 out = path[i + 1][len("out="):]

@@ -428,6 +428,66 @@ print("pass smoke ok: lossless round-trip, %d debug dumps, "
       "on/off max loss delta %.2g over 4 steps" % (len(dumps), delta))
 PY
 
+echo "== pallas kernel-substitution smoke (docs/passes.md) =="
+# training_fused preset: a residual+layer_norm MLP whose shapes satisfy every
+# path predicate must dispatch all four kernel families (GEMM epilogue,
+# layer_norm fwd/bwd, multi-tensor Adam) and hold trajectory parity with the
+# unfused run; tests/test_fused_kernels.py holds the full contract incl. the
+# ZeRO-1 decline rule
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'PY'
+import numpy as np
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.ops import pallas_kernels as pk
+
+def build():
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[256], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=256, act="gelu")
+        h2 = fluid.layers.fc(h, size=256)
+        ln = fluid.layers.layer_norm(
+            fluid.layers.elementwise_add(h2, h), begin_norm_axis=1)
+        pred = fluid.layers.fc(ln, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return main, startup, loss.name
+
+def losses(pipeline):
+    pt.set_flags({"pass_pipeline": pipeline})
+    try:
+        main, startup, loss_name = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(3)
+        W = rng.randn(256, 1).astype("float32")
+        out = []
+        with scope_guard(Scope(seed=11)):
+            exe.run(startup)
+            for _ in range(4):
+                xs = rng.randn(128, 256).astype("float32")
+                (lv,) = exe.run(main, feed={"x": xs, "y": xs @ W},
+                                fetch_list=[loss_name])
+                out.append(float(np.asarray(lv).ravel()[0]))
+        return np.asarray(out)
+    finally:
+        pt.set_flags({"pass_pipeline": ""})
+
+pk.KERNEL_DISPATCHES.clear()
+off = losses("")
+assert not pk.KERNEL_DISPATCHES, pk.KERNEL_DISPATCHES
+on = losses("training_fused")
+for fam in ("gemm_epilogue", "layer_norm", "layer_norm_grad", "multi_adam"):
+    assert pk.KERNEL_DISPATCHES.get(fam, 0) > 0, (fam, pk.KERNEL_DISPATCHES)
+delta = float(np.abs(off - on).max() / np.abs(off).max())
+assert delta < 1e-4, "fused/unfused diverged: %r vs %r" % (off, on)
+print("pallas smoke ok: dispatched %s, fused/unfused rel loss delta %.2g"
+      % (dict(pk.KERNEL_DISPATCHES), delta))
+PY
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

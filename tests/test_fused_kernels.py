@@ -1,0 +1,335 @@
+"""Kernel-substitution tier (docs/passes.md "Pallas kernel substitution"):
+unit numerics for the fused GEMM-epilogue / layer_norm(+residual) /
+multi-tensor Adam kernels against dense references, the path predicates
+that gate them, and fused-vs-unfused pipeline parity through BOTH
+executors — including the ZeRO-1 composition rule (fused Adam must decline
+so the sharded per-param update keeps its GSPMD placement)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import flags, framework
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.ops import pallas_kernels as pk
+from paddle_tpu.parallel_executor import BuildStrategy, ReduceStrategy
+
+# fused chains round ONCE (f32 accumulate, single cast) where the unfused
+# op sequence rounds at every op boundary — trajectories agree to fp noise,
+# not bit-for-bit (the Adam state update alone is bit-identical; see
+# test_multi_tensor_adam_bit_identical). Same bar as the PE convergence
+# contract in test_parallel_executor.py.
+_RTOL = 2e-3
+_ATOL = 2e-4
+
+
+# --------------------------------------------------------------------------
+# path predicates — the same checks the lowerings consult before
+# substituting, asserted directly so a silent fallback can't masquerade
+# as coverage
+# --------------------------------------------------------------------------
+
+
+def test_gemm_path_predicate():
+    assert pk.gemm_path_taken(128, 256, 256)
+    assert pk.gemm_path_taken(512, 2048, 2048)
+    assert pk.gemm_path_taken(100, 256, 256)  # one whole ragged tile is fine
+    assert not pk.gemm_path_taken(1000, 256, 256)  # ragged m, multi-tile
+    assert not pk.gemm_path_taken(128, 1030, 256)  # ragged n, multi-tile
+
+
+def test_ln_path_predicate():
+    assert pk.ln_path_taken(128, 256)
+    assert pk.ln_path_taken(8192, 2048)
+    assert not pk.ln_path_taken(100, 256)  # rows % 128
+    assert not pk.ln_path_taken(128, 100)  # cols % 128
+
+
+def test_adam_path_predicate():
+    assert pk.adam_path_taken(2)
+    assert pk.adam_path_taken(8)
+    assert not pk.adam_path_taken(1)  # nothing to batch
+    assert not pk.adam_path_taken(8, zero1=True)  # sharded state stays per-op
+
+
+# --------------------------------------------------------------------------
+# kernel unit numerics vs dense references
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("act", [None, "relu", "gelu"])
+def test_gemm_bias_act_matches_dense(act):
+    rng = np.random.RandomState(0)
+    m, k, n = 128, 256, 384
+    x = jnp.asarray(rng.randn(m, k).astype("float32"))
+    w = jnp.asarray(rng.randn(k, n).astype("float32"))
+    b = jnp.asarray(rng.randn(n).astype("float32"))
+    assert pk.gemm_path_taken(m, n, k)
+    z, y = pk.gemm_bias_act(x, w, b, act)
+    z_ref = jnp.dot(x, w, preferred_element_type=jnp.float32) + b
+    np.testing.assert_allclose(np.asarray(z), np.asarray(z_ref),
+                               rtol=2e-5, atol=2e-5)
+    if act is None:
+        assert y is None  # callers reuse z; no second output to transfer
+    else:
+        y_ref = pk._GEMM_ACT_F32[act](z_ref)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_gemm_ragged_falls_back_dense():
+    rng = np.random.RandomState(1)
+    # 1000 rows: > one tile and no 128-multiple divisor -> dense fallback
+    x = jnp.asarray(rng.randn(1000, 256).astype("float32"))
+    w = jnp.asarray(rng.randn(256, 256).astype("float32"))
+    b = jnp.asarray(rng.randn(256).astype("float32"))
+    assert not pk.gemm_path_taken(1000, 256, 256)
+    z, y = pk.gemm_bias_act(x, w, b, "relu")
+    z_ref = jnp.dot(x, w, preferred_element_type=jnp.float32) + b
+    np.testing.assert_allclose(np.asarray(z), np.asarray(z_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.maximum(np.asarray(z_ref), 0.0),
+                               rtol=2e-5, atol=2e-5)
+
+
+def _ln_reference(x, r, scale, bias, eps):
+    s = x if r is None else x + r
+    s32 = s.astype(jnp.float32)
+    mean = s32.mean(axis=1, keepdims=True)
+    var = s32.var(axis=1, keepdims=True)
+    xhat = (s32 - mean) * jax.lax.rsqrt(var + eps)
+    y = xhat * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return s, y.astype(x.dtype), mean[:, 0], var[:, 0]
+
+
+@pytest.mark.parametrize("residual", [False, True])
+def test_fused_layer_norm_matches_reference(residual):
+    rng = np.random.RandomState(2)
+    rows, cols = 128, 256
+    x = jnp.asarray(rng.randn(rows, cols).astype("float32"))
+    r = jnp.asarray(rng.randn(rows, cols).astype("float32")) if residual else None
+    scale = jnp.asarray(rng.rand(cols).astype("float32") + 0.5)
+    bias = jnp.asarray(rng.randn(cols).astype("float32"))
+    assert pk.ln_path_taken(rows, cols)
+    s, y, mean, var = pk.fused_layer_norm(x, r, scale, bias, 1e-5)
+    s_ref, y_ref, mean_ref, var_ref = _ln_reference(x, r, scale, bias, 1e-5)
+    if residual:
+        # the residual sum is the graph value grads replay from: bit-exact
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
+    else:
+        assert s is None
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mean_ref),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(var_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_layer_norm_grad_matches_vjp():
+    rng = np.random.RandomState(3)
+    rows, cols = 128, 256
+    x = jnp.asarray(rng.randn(rows, cols).astype("float32"))
+    scale = jnp.asarray(rng.rand(cols).astype("float32") + 0.5)
+    bias = jnp.asarray(rng.randn(cols).astype("float32"))
+    dy = jnp.asarray(rng.randn(rows, cols).astype("float32"))
+
+    def f(x, scale, bias):
+        return _ln_reference(x, None, scale, bias, 1e-5)[1]
+
+    _, vjp = jax.vjp(f, x, scale, bias)
+    dx_ref, ds_ref, db_ref = vjp(dy)
+    _, _, mean, var = pk.fused_layer_norm(x, None, scale, bias, 1e-5)
+    dx, ds, db = pk.fused_layer_norm_grad(x, scale, mean, var, dy, 1e-5)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ds), np.asarray(ds_ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(db), np.asarray(db_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("moment_dtype", ["float32", "bfloat16"])
+def test_multi_tensor_adam_bit_identical(moment_dtype):
+    """The fused update is the EXACT _adam f32 math rounded to the storage
+    dtypes — bit-identical to a jitted reference of the same expressions
+    (both must be jitted: XLA's FMA contraction differs from eager)."""
+    rng = np.random.RandomState(4)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    shapes = [(256, 384), (384,), (128, 128), (7, 13)]  # incl. ragged tail
+    params = [jnp.asarray(rng.randn(*s).astype("float32")) for s in shapes]
+    grads = [jnp.asarray(rng.randn(*s).astype("float32")) for s in shapes]
+    m1s = [jnp.asarray(rng.randn(*s).astype(moment_dtype)) for s in shapes]
+    m2s = [jnp.asarray(np.abs(rng.randn(*s)).astype(moment_dtype))
+           for s in shapes]
+    lr_ts = [np.float32(1e-3 * (i + 1)) for i in range(len(shapes))]
+
+    @jax.jit
+    def ref(p, g, m1, m2, lr_t):
+        gf = g.astype(jnp.float32)
+        m1o = b1 * m1.astype(jnp.float32) + (1 - b1) * gf
+        m2o = b2 * m2.astype(jnp.float32) + (1 - b2) * jnp.square(gf)
+        po = p.astype(jnp.float32) - lr_t * m1o / (jnp.sqrt(m2o) + eps)
+        return po.astype(p.dtype), m1o.astype(m1.dtype), m2o.astype(m2.dtype)
+
+    assert pk.adam_path_taken(len(params))
+    pos, m1os, m2os = pk.multi_tensor_adam(
+        params, grads, m1s, m2s, lr_ts, b1, b2, eps
+    )
+    for i in range(len(shapes)):
+        po_r, m1o_r, m2o_r = ref(params[i], grads[i], m1s[i], m2s[i],
+                                 jnp.float32(lr_ts[i]))
+        np.testing.assert_array_equal(np.asarray(pos[i]), np.asarray(po_r))
+        np.testing.assert_array_equal(np.asarray(m1os[i]), np.asarray(m1o_r))
+        np.testing.assert_array_equal(np.asarray(m2os[i]), np.asarray(m2o_r))
+        assert str(m1os[i].dtype) == moment_dtype
+
+
+# --------------------------------------------------------------------------
+# pipeline parity through the executors — shapes chosen so every path
+# predicate holds (batch 128, width 256): mul+add+gelu and mul+add hit the
+# GEMM epilogue, the residual add + layer_norm pair hits the LN kernel,
+# and Adam's 8 params batch into one multi-tensor group
+# --------------------------------------------------------------------------
+
+
+def _build_residual_ln_model(moment_dtype=None):
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[256], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=256, act="gelu")
+        h2 = fluid.layers.fc(h, size=256)
+        r = fluid.layers.elementwise_add(h2, h)
+        ln = fluid.layers.layer_norm(r, begin_norm_axis=1)
+        pred = fluid.layers.fc(ln, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(
+            learning_rate=1e-3, moment_dtype=moment_dtype
+        ).minimize(loss)
+    return main, startup, loss
+
+
+def _executor_run(pipeline, moment_dtype=None, steps=4):
+    """(losses, first-fc weight grads, final param values) under the given
+    FLAGS_pass_pipeline through the plain Executor."""
+    flags.set_flags({"pass_pipeline": pipeline})
+    try:
+        main, startup, loss = _build_residual_ln_model(moment_dtype)
+        pnames = [v.name for v in main.global_block().all_parameters()]
+        exe = fluid.Executor()
+        rng = np.random.RandomState(3)
+        W = rng.randn(256, 1).astype("float32")
+        losses, grads = [], []
+        scope = Scope(seed=11)
+        with scope_guard(scope):
+            exe.run(startup)
+            for _ in range(steps):
+                xs = rng.randn(128, 256).astype("float32")
+                lv, gv = exe.run(
+                    main, feed={"x": xs, "y": xs @ W},
+                    fetch_list=[loss.name, pnames[0] + "@GRAD"],
+                )
+                losses.append(np.asarray(lv).copy())
+                grads.append(np.asarray(gv).copy())
+            finals = {n: np.asarray(scope.vars[n]).copy() for n in pnames}
+        return np.stack(losses), np.stack(grads), finals
+    finally:
+        flags.set_flags({"pass_pipeline": ""})
+
+
+def test_fused_pipeline_parity_executor():
+    """training_fused on vs off through Executor: losses, fetched grads, and
+    the trained params all agree — and the dispatch counters prove every
+    kernel family actually substituted (no silent per-op fallback)."""
+    pk.KERNEL_DISPATCHES.clear()
+    off_l, off_g, off_p = _executor_run("")
+    assert not pk.KERNEL_DISPATCHES, pk.KERNEL_DISPATCHES
+    on_l, on_g, on_p = _executor_run("training_fused")
+    for family in ("gemm_epilogue", "layer_norm", "layer_norm_grad",
+                   "multi_adam"):
+        assert pk.KERNEL_DISPATCHES.get(family, 0) > 0, (
+            family, pk.KERNEL_DISPATCHES)
+    np.testing.assert_allclose(on_l, off_l, rtol=_RTOL, atol=_ATOL)
+    np.testing.assert_allclose(on_g, off_g, rtol=_RTOL, atol=_ATOL)
+    for n in off_p:
+        np.testing.assert_allclose(on_p[n], off_p[n], rtol=_RTOL, atol=_ATOL,
+                                   err_msg=n)
+
+
+def test_fused_pipeline_parity_executor_bf16_moments():
+    """The bench default (bf16 Adam moments) composes with the fused update:
+    the kernel rounds its f32 math to bf16 storage exactly like the per-op
+    chain, so the trajectory bar is unchanged."""
+    off_l, _, off_p = _executor_run("", moment_dtype="bfloat16")
+    on_l, _, on_p = _executor_run("training_fused", moment_dtype="bfloat16")
+    np.testing.assert_allclose(on_l, off_l, rtol=_RTOL, atol=_ATOL)
+    for n in off_p:
+        np.testing.assert_allclose(on_p[n], off_p[n], rtol=_RTOL, atol=_ATOL,
+                                   err_msg=n)
+
+
+def _pe_run(fuse_kernels, zero1=False, steps=4):
+    bs = BuildStrategy()
+    bs.fuse_kernels = fuse_kernels
+    if zero1:
+        bs.reduce_strategy = ReduceStrategy.Reduce
+    main, startup, loss = _build_residual_ln_model()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(3)
+    W = rng.randn(256, 1).astype("float32")
+    losses = []
+    scope = Scope(seed=2)
+    with scope_guard(scope):
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, build_strategy=bs,
+            scope=scope,
+        )
+        for _ in range(steps):
+            xs = rng.randn(128, 256).astype("float32")
+            (l,) = pe.run([loss.name], feed={"x": xs, "y": xs @ W})
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+    return losses, pe
+
+
+def test_fused_pipeline_parity_parallel_executor():
+    """BuildStrategy.fuse_kernels resolves to the training_fused preset and
+    the SPMD lowering matches the unfused run over the 8-device mesh."""
+    pk.KERNEL_DISPATCHES.clear()
+    off, _ = _pe_run(False)
+    assert not pk.KERNEL_DISPATCHES, pk.KERNEL_DISPATCHES
+    on, _ = _pe_run(True)
+    for family in ("gemm_epilogue", "layer_norm", "layer_norm_grad",
+                   "multi_adam"):
+        assert pk.KERNEL_DISPATCHES.get(family, 0) > 0, (
+            family, pk.KERNEL_DISPATCHES)
+    np.testing.assert_allclose(on, off, rtol=_RTOL, atol=_ATOL)
+
+
+def test_zero1_declines_fused_adam():
+    """Under ReduceStrategy.Reduce the multi-tensor Adam must DECLINE (the
+    flattened group would defeat the per-param moment sharding GSPMD plans
+    around) while the forward/backward kernels still substitute — and the
+    trajectory still matches the unfused ZeRO-1 run with sharded state."""
+    pk.KERNEL_DISPATCHES.clear()
+    z_off, _ = _pe_run(False, zero1=True)
+    z_on, zpe = _pe_run(True, zero1=True)
+    assert pk.KERNEL_DISPATCHES.get("gemm_epilogue", 0) > 0
+    if zpe.device_count > 1:
+        assert "multi_adam" not in pk.KERNEL_DISPATCHES, pk.KERNEL_DISPATCHES
+        assert zpe._last_run[0].zero1_state_names
+    np.testing.assert_allclose(z_on, z_off, rtol=_RTOL, atol=_ATOL)
+
+
+def test_build_strategy_pipeline_resolution():
+    bs = BuildStrategy()
+    assert bs.resolved_pass_pipeline() is None
+    bs.fuse_kernels = True
+    assert bs.resolved_pass_pipeline() == "training_fused"
+    bs.pass_pipeline = "training_default"  # explicit pipeline wins
+    assert bs.resolved_pass_pipeline() == "training_default"

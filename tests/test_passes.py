@@ -196,7 +196,12 @@ def test_registered_pass_battery():
                      "quantize_training"):
         assert required in names
     assert len(names) >= 5
-    assert set(passes.PRESETS) == {"training_default", "inference"}
+    assert set(passes.PRESETS) == {
+        "training_default", "inference", "training_fused",
+    }
+    for pname in ("fuse_gemm_epilogue", "fuse_layer_norm", "fuse_optimizer"):
+        assert pname in names
+        assert pname in passes.PRESETS["training_fused"]
 
 
 # --------------------------------------------------------------------------

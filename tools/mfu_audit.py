@@ -374,7 +374,7 @@ def probe_bandwidth(n_bytes):
     return (3 * n * 2) / (ms / 1e3) / 1e9
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("model", choices=["transformer", "resnet"])
     ap.add_argument("--steps", type=int, default=10)
@@ -385,9 +385,20 @@ def main():
                     help="also write the compiled HLO text here")
     ap.add_argument("--bf16-moments", action="store_true",
                     help="audit the bench-headline Adam(moment_dtype=bf16) step")
-    args = ap.parse_args()
+    ap.add_argument("--analytic-bw", action="store_true",
+                    help="skip the HBM memcpy microbench and use the "
+                         "analytic PEAK_BW_GBS for the memory roofline")
+    ap.add_argument("--pass-pipeline", default=None,
+                    help="graph-pass pipeline for the audited step (e.g. "
+                         "training_fused); default leaves FLAGS_pass_pipeline "
+                         "as-is")
+    args = ap.parse_args(argv)
     if args.bf16_moments and args.model != "transformer":
         ap.error("--bf16-moments only applies to the transformer step")
+    if args.pass_pipeline is not None:
+        from paddle_tpu import flags as _flags
+
+        _flags.set_flags({"pass_pipeline": args.pass_pipeline})
 
     hlo, events, wall_ms, flops = profile_step(
         args.model, args.steps,
@@ -399,6 +410,18 @@ def main():
     idx = HloIndex(hlo)
     busy_ms = sum(events.values()) / args.steps
 
+    # ground the memory roofline in THIS chip's measured HBM bandwidth (the
+    # memcpy microbench) instead of the analytic constant; measured_bw_gbs
+    # had been a null placeholder in r05-era audits
+    measured_bw = None
+    if not args.analytic_bw:
+        try:
+            measured_bw = round(probe_bandwidth(1 << 30), 0)
+        except Exception as e:
+            print("bandwidth probe failed (%r); using analytic PEAK_BW_GBS"
+                  % (e,), file=sys.stderr)
+    bw_gbs = measured_bw or PEAK_BW_GBS
+
     rows = []
     tot_fl = tot_bytes = tot_est = 0.0
     for name, tot in sorted(events.items(), key=lambda kv: -kv[1]):
@@ -408,8 +431,8 @@ def main():
         fl = idx.instr_flops(name)
         nbytes = idx.hbm_bytes(name)
         # roofline: overlapped MXU + HBM model against this chip's measured
-        # ceilings (memory file / PROFILE.md probes)
-        est_ms = max(fl / PEAK_MM_TFLOPS / 1e9, nbytes / PEAK_BW_GBS / 1e6)
+        # ceilings (matmul probe constant + the bandwidth microbench above)
+        est_ms = max(fl / PEAK_MM_TFLOPS / 1e9, nbytes / bw_gbs / 1e6)
         tot_fl += fl
         tot_bytes += nbytes
         tot_est += est_ms
@@ -428,7 +451,12 @@ def main():
     cats = {}
     for r in rows:
         if r["opcode"] == "custom-call":
-            c = "custom-call (pallas flash)"
+            # the kernel-substitution lowerings scope their calls as
+            # "pallas_kernel=<family>.<gid>" (registry._lower_pallas_run);
+            # flash attention predates that tag and keeps its legacy label
+            m = re.search(r"pallas_kernel=([a-z_0-9]+)", idx.line(r["instr"]))
+            c = ("custom-call (pallas %s)" % m.group(1) if m
+                 else "custom-call (pallas flash)")
         elif r["tflops"]:
             c = "matmul-bearing fusions"
         elif r["opcode"] in ("fusion",):
@@ -441,10 +469,7 @@ def main():
         e[2] += (r["gbs"] or 0) * r["ms_per_step"] / 1e3
 
     top = rows[: args.top]
-    measured_bw = None
     if args.probe:
-        # validate the PEAK_BW_GBS constant on this chip while we're here
-        measured_bw = round(probe_bandwidth(1 << 30), 0)
         for r in top:
             fl = idx.instr_flops(r["instr"])
             if not fl:
@@ -465,6 +490,8 @@ def main():
         "roofline_min_busy_ms": round(tot_est, 1),
         "busy_x_roofline": round(busy_ms / tot_est, 2) if tot_est else None,
         "measured_bw_gbs": measured_bw,
+        "roofline_bw_gbs": bw_gbs,  # which bandwidth grounded the roofline
+        "pass_pipeline": args.pass_pipeline,
         "categories": {
             c: {"ms": round(v[0], 1), "tflop": round(v[1], 2),
                 "gb": round(v[2], 1)}
