@@ -657,7 +657,7 @@ class _CompiledBlock:
 
 
 def aot_serve_lowering(program, feed_names, fetch_names, scope,
-                       pass_pipeline="inference"):
+                       pass_pipeline="inference", return_state=False):
     """Donation-free forward lowering for ahead-of-time serving.
 
     The serving side (inference.export_compiled, serving.engine) needs the
@@ -671,6 +671,12 @@ def aot_serve_lowering(program, feed_names, fetch_names, scope,
     same shapes. The scope's rng key is captured at trace time — inference
     programs are pruned of training-only stochastic ops by clone(for_test),
     so the key never advances.
+
+    `return_state=True` is the decode-state mode (serving.generation): the
+    closure becomes `serve(feeds, ro, mut) -> ([fetches], new_mut)` so a
+    stateful caller (KV-cache pools) can thread the rewritten state dict to
+    the next step and jit the wrapper with `donate_argnums=(2,)` — the
+    single-shot default stays donation-free by construction.
 
     `pass_pipeline` (default: the "inference" preset, docs/passes.md) runs
     fold/DCE/fusion-tagging over the program before lowering; pass "" / None
@@ -689,11 +695,19 @@ def aot_serve_lowering(program, feed_names, fetch_names, scope,
     mut = {n: scope.vars[n] for n in compiled.mut_names}
     rng_key = scope.rng_key
 
-    def serve(feeds, ro_, mut_):
-        # compiled.fn is the un-jitted lowering: (feeds, ro, mut, key) ->
-        # (fetches, new_mut, created, key); serving wants fetches only
-        fetches, _, _, _ = compiled.fn(feeds, ro_, mut_, rng_key)
-        return fetches
+    if return_state:
+
+        def serve(feeds, ro_, mut_):
+            fetches, new_mut, _, _ = compiled.fn(feeds, ro_, mut_, rng_key)
+            return fetches, new_mut
+
+    else:
+
+        def serve(feeds, ro_, mut_):
+            # compiled.fn is the un-jitted lowering: (feeds, ro, mut, key) ->
+            # (fetches, new_mut, created, key); serving wants fetches only
+            fetches, _, _, _ = compiled.fn(feeds, ro_, mut_, rng_key)
+            return fetches
 
     return serve, ro, mut
 

@@ -77,6 +77,8 @@ __all__ = [
     "distributed_embedding",
     "beam_search",
     "beam_search_decode",
+    "kv_cache_write",
+    "paged_attention",
 ]
 
 
@@ -1320,6 +1322,57 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, name=None):
         type="flash_attention",
         inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
         outputs=outputs,
+        attrs=attrs,
+    )
+    return out
+
+
+def kv_cache_write(pool, rows, block_table, pos, page_size, name=None):
+    """Scatter per-token K or V rows into a paged cache pool in place.
+
+    ``pool`` is a persistable ``[n_pages * page_size, feat]`` tensor; each
+    row of ``rows`` lands at ``block_table[pos // page_size] * page_size +
+    pos % page_size``. The op's output IS the pool variable (the in-place
+    idiom), so the serving lowering classifies the pool as written state
+    and can donate its buffer across decode steps."""
+    helper = LayerHelper("kv_cache_write", name=name)
+    helper.append_op(
+        type="kv_cache_write",
+        inputs={
+            "Pool": [pool.name],
+            "Rows": [rows.name],
+            "BlockTable": [block_table.name],
+            "Pos": [pos.name],
+        },
+        outputs={"Out": [pool.name]},
+        attrs={"page_size": int(page_size)},
+    )
+    return pool
+
+
+def paged_attention(q, k_pool, v_pool, block_table, pos, n_head, page_size, sm_scale=None, name=None):
+    """One-query-per-slot attention over a paged KV pool.
+
+    ``q`` is ``[slots, n_head * d_head]`` (one decode token per slot),
+    ``block_table`` ``[slots, pages_per_slot]`` int32, ``pos`` the query
+    token's position; each slot attends to context positions 0..pos through
+    its block table. Unused table entries point at the scratch page and are
+    masked by the position bound."""
+    helper = LayerHelper("paged_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {"n_head": int(n_head), "page_size": int(page_size)}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    helper.append_op(
+        type="paged_attention",
+        inputs={
+            "Q": [q.name],
+            "KPool": [k_pool.name],
+            "VPool": [v_pool.name],
+            "BlockTable": [block_table.name],
+            "Pos": [pos.name],
+        },
+        outputs={"Out": [out.name]},
         attrs=attrs,
     )
     return out

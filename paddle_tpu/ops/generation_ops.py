@@ -1,0 +1,91 @@
+"""Generation-serving ops: paged KV-cache attention and cache writes.
+
+The decode hot loop of the generation engine (`serving/generation.py`) runs
+one fixed-shape program per step: every live slot contributes exactly one
+query token, and all past K/V live in a preallocated paged pool indexed
+through per-slot block tables (the vLLM layout). Keeping the gather/scatter
+inside registered ops means the decode program lowers through the same
+`aot_serve_lowering` path as everything else — the pool tensors classify as
+mutable state and can be donated, so pages update in place and the step
+never retraces.
+
+Conventions:
+  * A pool is a persistable ``[n_pages * page_size, n_head * d_head]`` f32
+    array. Row ``page_id * page_size + offset`` holds the K (or V) row for
+    one token. Page 0 is a scratch page the allocator never hands out —
+    writes landing there (padded prefill tail, idle decode slots) are
+    masked out of every attention read.
+  * ``kv_cache_write`` outputs the pool variable itself (the in-place
+    idiom, like ``increment``), so the executor classifies the pool as
+    written state and threads the new buffer to the next step.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+_NEG_INF = -1e9
+
+
+def _flat_rows(block_table, positions, page_size):
+    """Pool row index for each (slot, position): block_table picks the page,
+    position % page_size the offset. block_table may be [S, P] (decode, one
+    row per slot) or [P] (prefill, one slot writing many positions)."""
+    positions = positions.reshape(-1).astype(jnp.int32)
+    page_idx = positions // page_size
+    if block_table.ndim == 1:
+        page_id = block_table.astype(jnp.int32)[page_idx]
+    else:
+        page_id = jnp.take_along_axis(
+            block_table.astype(jnp.int32), page_idx[:, None], axis=1
+        )[:, 0]
+    return page_id * page_size + positions % page_size
+
+
+@register("kv_cache_write", no_grad=True)
+def _kv_cache_write(ctx, ins, attrs):
+    (pool,) = ins["Pool"]
+    (rows,) = ins["Rows"]
+    (bt,) = ins["BlockTable"]
+    (pos,) = ins["Pos"]
+    page_size = int(attrs["page_size"])
+    flat = _flat_rows(bt, pos, page_size)
+    return {"Out": [pool.at[flat].set(rows.astype(pool.dtype))]}
+
+
+@register("paged_attention", no_grad=True)
+def _paged_attention(ctx, ins, attrs):
+    (q,) = ins["Q"]  # [S, H*D] — one query token per slot
+    (kp,) = ins["KPool"]
+    (vp,) = ins["VPool"]
+    (bt,) = ins["BlockTable"]  # [S, P] int32 page ids (0 = scratch/unused)
+    (pos,) = ins["Pos"]  # [S] position of the query token (attends 0..pos)
+    n_head = int(attrs["n_head"])
+    page_size = int(attrs["page_size"])
+    s, p = bt.shape
+    ctx_len = p * page_size
+    d = q.shape[-1] // n_head
+    scale = float(attrs.get("sm_scale") or 0.0) or d**-0.5
+
+    flat = (
+        bt.astype(jnp.int32)[:, :, None] * page_size
+        + jnp.arange(page_size, dtype=jnp.int32)[None, None, :]
+    ).reshape(s, ctx_len)
+    k = jnp.take(kp, flat.reshape(-1), axis=0).reshape(s, ctx_len, n_head, d)
+    v = jnp.take(vp, flat.reshape(-1), axis=0).reshape(s, ctx_len, n_head, d)
+    qh = q.reshape(s, n_head, d).astype(jnp.float32)
+
+    scores = jnp.einsum("shd,schd->shc", qh, k.astype(jnp.float32)) * scale
+    # causal-by-position: the query at position pos sees context rows
+    # 0..pos inclusive (its own K/V row was written earlier this step).
+    live = (
+        jnp.arange(ctx_len, dtype=jnp.int32)[None, :]
+        <= pos.reshape(-1).astype(jnp.int32)[:, None]
+    )
+    scores = jnp.where(live[:, None, :], scores, _NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("shc,schd->shd", weights, v.astype(jnp.float32))
+    return {"Out": [out.reshape(s, n_head * d).astype(q.dtype)]}

@@ -344,6 +344,12 @@ class Graph:
                         continue
                     if vn.persistable or vn.var.is_data:
                         continue
+                    if self._blocks[blk.idx]["vars"].get(name) is None:
+                        # declared in an ancestor block: the value exists at
+                        # block entry (loop-carried state written at the tail
+                        # of a while body reads its previous iteration), so
+                        # intra-block write order proves nothing
+                        continue
                     idxs = writes.get(name)
                     if idxs and min(idxs) > i:
                         raise GraphVerifyError(

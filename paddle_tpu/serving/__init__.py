@@ -11,13 +11,18 @@ of the training executors.
   jax.export artifacts (+ XLA executable cache) so replicas cold-start in
   seconds; also owns the export_compiled artifact format.
 - server.ModelServer — stdlib multi-model HTTP front end
-  (`/v1/models/<name>:predict`, `/healthz`, `/metrics`).
+  (`/v1/models/<name>:predict`, `/v1/models/<name>:generate`, `/healthz`,
+  `/metrics`).
+- generation.GenerationEngine / GenerationScheduler — autoregressive
+  serving (ROADMAP item 3): AOT prefill buckets + one fixed-shape decode
+  step over a paged KV-cache pool (kv_cache.PagedKVPool), token-level
+  continuous batching with mid-batch admission and EOS/max-len retirement.
 
-docs/serving.md covers the architecture, bucketing policy, cache layout and
-flags.
+docs/serving.md covers the architecture, bucketing policy, cache layout,
+generation slot/page lifecycle, and flags.
 """
 
-from . import batcher, compile_cache, engine, server  # noqa: F401
+from . import batcher, compile_cache, engine, generation, kv_cache, server  # noqa: F401
 from .batcher import (  # noqa: F401
     ContinuousBatcher,
     QueueFullError,
@@ -27,6 +32,13 @@ from .batcher import (  # noqa: F401
 )
 from .compile_cache import CompileCache  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
+from .generation import (  # noqa: F401
+    GenerationEngine,
+    GenerationScheduler,
+    GenRequest,
+    GenResult,
+)
+from .kv_cache import PagedKVPool, PoolExhausted  # noqa: F401
 from .server import ModelServer  # noqa: F401
 
 __all__ = [
@@ -38,4 +50,10 @@ __all__ = [
     "QueueFullError",
     "RequestTimeout",
     "ShutdownError",
+    "GenerationEngine",
+    "GenerationScheduler",
+    "GenRequest",
+    "GenResult",
+    "PagedKVPool",
+    "PoolExhausted",
 ]

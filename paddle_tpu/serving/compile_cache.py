@@ -59,13 +59,22 @@ def _versions():
     return jax.__version__, jl, platform
 
 
-def variant_key(fingerprint, feed_avals, fetch_names):
+def variant_key(fingerprint, feed_avals, fetch_names, state_avals=None,
+                geometry=None):
     """Content key for one compiled serving variant.
 
     `feed_avals` is {name: (shape tuple, dtype str)} for the PADDED bucket
     shapes. The jax/jaxlib versions and backend platform are folded in
     because a serialized artifact is only replayable on a compatible stack —
     a version bump misses cleanly instead of deserializing garbage.
+
+    Stateful (generation) variants must also pass `state_avals` — the
+    decode-state dict's {name: (shape, dtype)}, i.e. the KV pool tensors —
+    and `geometry`, the engine's page layout (page_size, pool_pages,
+    max_slots, ...). Both change the compiled gather/scatter indexing
+    without necessarily changing any feed shape, so leaving them out of the
+    key would let a config flip replay a stale executable against a
+    differently-shaped pool.
     """
     jax_v, jaxlib_v, platform = _versions()
     doc = {
@@ -78,6 +87,13 @@ def variant_key(fingerprint, feed_avals, fetch_names):
         "jaxlib": jaxlib_v,
         "platform": platform,
     }
+    if state_avals:
+        doc["state"] = sorted(
+            (n, list(shape), str(dtype))
+            for n, (shape, dtype) in state_avals.items()
+        )
+    if geometry:
+        doc["geometry"] = {k: geometry[k] for k in sorted(geometry)}
     return hashlib.sha256(json.dumps(doc, sort_keys=True).encode()).hexdigest()
 
 

@@ -1,0 +1,771 @@
+"""Autoregressive generation serving: prefill/decode split over a paged
+KV-cache pool, with token-level continuous batching.
+
+The PR 6 ServingEngine serves *single-shot* programs — one compiled call
+per request. A decode loop breaks that model twice: sequence lengths grow
+every step (an unbounded retrace set), and a whole-sequence-per-request
+loop wastes nearly all decode FLOPs on finished or padded positions. This
+module is the Orca/vLLM answer, built from the same parts:
+
+**GenerationEngine** AOT-compiles exactly TWO variant families through
+`executor.aot_serve_lowering(return_state=True)`:
+
+- *prefill* — one program per pow2 prompt-length bucket (batch 1): dense
+  causal attention over the padded prompt, K/V of every position scattered
+  into the paged pool through the slot's page list, last-real-position
+  logits out.
+- *decode* — ONE fixed shape, `[max_slots]`: every live slot advances one
+  token through `paged_attention` gather/scatter. Idle slots ride along
+  pointing at the scratch page.
+
+Every variant builds through the persistent CompileCache with the decode
+state avals and page geometry folded into the key, then AOT-compiles
+(`.lower().compile()`) at warmup — the hot loop calls only precompiled
+executables, so it can never retrace regardless of the prompt/output
+length mix (`stats()["traces"]` is the proof the smoke stage asserts).
+Prefill/decode wrappers are jitted with `donate_argnums=(2,)`: the pool
+buffers update in place, verified by input-output aliasing in
+tests/test_generation.py; single-shot serving stays donation-free.
+
+**GenerationScheduler** extends ContinuousBatcher into a token-level
+scheduler: the worker loop admits queued requests into free decode slots
+*mid-batch* between steps (prefill interleaved with decode under a
+queue-pressure policy — one prefill per step when idle, up to all free
+slots when the queue is deep), runs one decode step for all live slots,
+and retires slots on EOS/max-len, releasing their pages for reuse.
+
+Sampling (greedy / temperature / top-k) happens host-side on the fetched
+logits with a per-request counter-based RNG stream seeded from the scope
+seed — so a request's tokens are a pure function of (params, prompt,
+sampling config, seed), independent of which slot it lands in or who
+shares the batch. That determinism is the parity contract the tests pin.
+"""
+
+import hashlib
+import json
+import threading
+import time
+
+import numpy as np
+
+from ..executor import Scope, aot_serve_lowering, scope_guard
+from .batcher import (
+    ContinuousBatcher,
+    QueueFullError,
+    RequestTimeout,
+    ServingFuture,
+    ShutdownError,
+)
+from .kv_cache import PagedKVPool, PoolExhausted
+from . import compile_cache as _cc
+
+__all__ = [
+    "GenerationEngine",
+    "GenerationScheduler",
+    "GenRequest",
+    "GenResult",
+]
+
+
+def _pow2_buckets(lo, hi):
+    out = []
+    b = max(2, lo)
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(sorted(set(out)))
+
+
+def program_fingerprint(program, scope, extra=None):
+    """Content hash of a program built in memory (no model_dir to
+    fingerprint): op list (type/slots/attrs) + the scope avals of every
+    persistable the ops touch. Mirrors io.inference_model_fingerprint's
+    role for the compile-cache key."""
+
+    def _jsonable(v):
+        if isinstance(v, np.ndarray):
+            return ["ndarray", str(v.dtype), list(v.shape),
+                    hashlib.sha256(np.ascontiguousarray(v).tobytes()).hexdigest()]
+        if isinstance(v, (list, tuple)):
+            return [_jsonable(x) for x in v]
+        if isinstance(v, (bool, int, float, str)) or v is None:
+            return v
+        return repr(v)
+
+    ops = []
+    touched = set()
+    for op in program.global_block().ops:
+        ops.append([
+            op.type,
+            sorted((k, list(v)) for k, v in op.inputs.items()),
+            sorted((k, list(v)) for k, v in op.outputs.items()),
+            sorted((k, _jsonable(v)) for k, v in op.attrs.items()),
+        ])
+        touched.update(op.input_arg_names)
+    avals = sorted(
+        (n, list(np.shape(scope.vars[n])), str(np.asarray(scope.vars[n]).dtype)
+         if not hasattr(scope.vars[n], "dtype") else str(scope.vars[n].dtype))
+        for n in touched
+        if n in scope.vars
+    )
+    doc = {"ops": ops, "avals": avals, "extra": extra}
+    return hashlib.sha256(json.dumps(doc, sort_keys=True).encode()).hexdigest()
+
+
+class GenRequest:
+    """One generation request (validated by scheduler/engine entry points).
+    temperature None/0 means greedy; top_k limits sampling to the k most
+    likely tokens; seed pins the request's sample stream (defaults to a
+    per-engine counter so concurrent requests draw independent streams)."""
+
+    __slots__ = ("prompt", "max_new_tokens", "eos_id", "temperature",
+                 "top_k", "seed")
+
+    def __init__(self, prompt, max_new_tokens=16, eos_id=None,
+                 temperature=None, top_k=None, seed=None):
+        self.prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.temperature = None if not temperature else float(temperature)
+        self.top_k = None if not top_k else int(top_k)
+        self.seed = None if seed is None else int(seed)
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+class GenResult:
+    __slots__ = ("tokens", "finish_reason", "prompt_len")
+
+    def __init__(self, tokens, finish_reason, prompt_len):
+        self.tokens = tokens
+        self.finish_reason = finish_reason
+        self.prompt_len = prompt_len
+
+
+class _SlotRun:
+    """Engine-side state of one admitted request occupying a decode slot."""
+
+    __slots__ = ("req", "slot", "table", "tokens", "next_pos", "rng",
+                 "done", "finish_reason", "future", "t_submit", "t_first")
+
+    def __init__(self, req, slot, table, rng):
+        self.req = req
+        self.slot = slot
+        self.table = table
+        self.tokens = []
+        self.next_pos = len(req.prompt)
+        self.rng = rng
+        self.done = False
+        self.finish_reason = None
+        self.future = None
+        self.t_submit = None
+        self.t_first = None
+
+    def result(self):
+        return GenResult(list(self.tokens), self.finish_reason,
+                         len(self.req.prompt))
+
+
+class _Variant:
+    __slots__ = ("fn", "ro", "mut_names", "feed_names", "avals")
+
+    def __init__(self, fn, ro, mut_names, feed_names, avals):
+        self.fn = fn
+        self.ro = ro
+        self.mut_names = mut_names
+        self.feed_names = feed_names
+        self.avals = avals
+
+
+class GenerationEngine:
+    """AOT prefill/decode engine for one decoder model over one paged pool.
+
+    `model` implements the GPTDecoder protocol: build_prefill / build_decode
+    / kv_pool_names / ensure_params / d_model / max_context / eos_id (see
+    models/gpt_decoder.py — the hook point for other decode-loop models).
+    """
+
+    def __init__(self, model, name="generation", scope=None, place=None,
+                 max_slots=4, page_size=8, pool_pages=None, max_context=None,
+                 prefill_buckets=None, cache_dir=None):
+        import jax.numpy as jnp
+
+        self.model = model
+        self.name = name
+        self.max_context = int(max_context or model.max_context)
+        if self.max_context > model.max_context:
+            raise ValueError(
+                "max_context %d exceeds the model's position table %d"
+                % (self.max_context, model.max_context)
+            )
+        self.page_size = int(page_size)
+        self.max_slots = int(max_slots)
+        if self.max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.max_pages = -(-self.max_context // self.page_size)
+        if pool_pages is None:
+            # full reservation capacity for every slot, plus scratch page 0
+            pool_pages = self.max_slots * self.max_pages + 1
+        self.pool_pages = int(pool_pages)
+        self.pool = PagedKVPool(
+            self.pool_pages, self.page_size, self.max_slots, self.max_pages
+        )
+        self.prefill_buckets = tuple(sorted(set(
+            int(b) for b in (prefill_buckets or _pow2_buckets(2, self.max_context))
+        )))
+        if self.prefill_buckets[-1] > self.max_context:
+            raise ValueError("prefill bucket > max_context")
+        # longest admissible prompt must leave room for >= 1 generated token
+        self.max_prompt_len = min(self.prefill_buckets[-1], self.max_context - 1)
+
+        self.scope = scope or Scope()
+        model.ensure_params(self.scope, place)
+        pool_rows = self.pool_pages * self.page_size
+        self._state = {}
+        for pair in model.kv_pool_names():
+            for n in pair:
+                arr = jnp.zeros((pool_rows, model.d_model), jnp.float32)
+                self.scope.vars[n] = arr
+                self._state[n] = arr
+
+        if cache_dir is None:
+            from .. import flags as _flags
+
+            cache_dir = _flags.get_flags("serving_cache_dir")["serving_cache_dir"]
+        self.cache = _cc.CompileCache(cache_dir) if cache_dir else None
+
+        self._variants = {}
+        self._build_lock = threading.Lock()
+        self._sample_counter = 0
+        self.traces = 0
+        self.cache_hits = 0
+        self.tokens_generated = 0
+
+        from ..observability import registry as _registry
+
+        reg = _registry.default_registry()
+        p = "serving/%s" % self.name
+        self._m_tokens = reg.counter(p + "/gen_tokens", "tokens generated")
+        self._m_prefills = reg.counter(p + "/gen_prefills", "prompts prefilled")
+        self._m_steps = reg.counter(p + "/gen_steps", "decode steps executed")
+        self._m_traces = reg.counter(
+            p + "/traces", "generation variants traced (compile-cache misses)"
+        )
+        self._m_slots = reg.gauge(p + "/gen_slots_live", "live decode slots")
+        self._m_occ = reg.gauge(
+            p + "/gen_slot_occupancy", "live slots / max_slots"
+        )
+        self._m_pages = reg.gauge(
+            p + "/gen_kv_pages_used", "KV pool pages in use"
+        )
+        self._m_step_ms = reg.histogram(
+            p + "/gen_step_ms", "one decode step, wall ms"
+        )
+        self._m_prefill_ms = reg.histogram(
+            p + "/gen_prefill_ms", "one prefill call, wall ms"
+        )
+
+    # ---- geometry / cache keys --------------------------------------------
+    def geometry(self):
+        return {
+            "page_size": self.page_size,
+            "pool_pages": self.pool_pages,
+            "max_slots": self.max_slots,
+            "max_pages": self.max_pages,
+            "max_context": self.max_context,
+        }
+
+    def _canon_dtype(self, dtype):
+        import jax.numpy as jnp
+
+        return jnp.asarray(np.zeros((), np.dtype(dtype))).dtype
+
+    # ---- variants ---------------------------------------------------------
+    def _variant(self, kind):
+        """Compiled stateful callable for 'decode' or 'prefill:<bucket>',
+        building through the persistent cache on first sight."""
+        v = self._variants.get(kind)
+        if v is not None:
+            return v
+        with self._build_lock:
+            v = self._variants.get(kind)
+            if v is not None:
+                return v
+            pool_rows = self.pool_pages * self.page_size
+            if kind == "decode":
+                main, _, feeds, fetches = self.model.build_decode(
+                    self.max_slots, self.page_size, self.max_pages, pool_rows
+                )
+            elif kind.startswith("prefill:"):
+                t = int(kind.split(":", 1)[1])
+                main, _, feeds, fetches = self.model.build_prefill(
+                    t, self.page_size, self.max_pages, pool_rows
+                )
+            else:
+                raise ValueError("unknown variant kind %r" % kind)
+            v = self._build_variant(kind, main, feeds, fetches)
+            self._variants[kind] = v
+            return v
+
+    def _build_variant(self, kind, main, feed_names, fetch_names):
+        import jax
+        from jax import export as jax_export
+
+        with scope_guard(self.scope):
+            serve, ro, mut = aot_serve_lowering(
+                main, feed_names, fetch_names, self.scope, return_state=True
+            )
+        block = main.global_block()
+        avals = {}
+        for n in feed_names:
+            var = block.vars[n]
+            avals[n] = jax.ShapeDtypeStruct(
+                tuple(int(d) for d in var.shape), self._canon_dtype(var.dtype)
+            )
+
+        def build():
+            self.traces += 1
+            self._m_traces.inc()
+            return jax_export.export(jax.jit(serve))(avals, ro, mut)
+
+        if self.cache is not None:
+            fp = program_fingerprint(main, self.scope, extra=kind)
+            ck = _cc.variant_key(
+                fp,
+                {n: (s.shape, s.dtype) for n, s in avals.items()},
+                fetch_names,
+                state_avals={
+                    n: (tuple(a.shape), str(a.dtype)) for n, a in mut.items()
+                },
+                geometry=self.geometry(),
+            )
+            exported, hit = self.cache.get_or_build(
+                ck, build,
+                meta={
+                    "model": self.name,
+                    "variant": kind,
+                    "geometry": self.geometry(),
+                    "feeds": {
+                        n: [list(s.shape), str(s.dtype)]
+                        for n, s in avals.items()
+                    },
+                    "fetches": list(fetch_names),
+                },
+            )
+            if hit:
+                self.cache_hits += 1
+        else:
+            exported = build()
+
+        # decode-state donation: the KV pool buffers (arg 2) are consumed
+        # each call and replaced by the returned new state, so XLA may alias
+        # them in place — the aliasing test asserts this on the executable
+        fn = jax.jit(
+            lambda feeds, ro_, mut_, _call=exported.call: _call(feeds, ro_, mut_),
+            donate_argnums=(2,),
+        ).lower(avals, ro, {n: self._state[n] for n in mut}).compile()
+        return _Variant(fn, ro, sorted(mut), list(feed_names), avals)
+
+    def warmup(self):
+        """Precompile the decode step and every prefill bucket. Returns the
+        variant count; after this the hot loop never traces."""
+        self._variant("decode")
+        for b in self.prefill_buckets:
+            self._variant("prefill:%d" % b)
+        return len(self._variants)
+
+    def _call(self, variant, np_feeds):
+        feeds = {}
+        for n in variant.feed_names:
+            s = variant.avals[n]
+            feeds[n] = np.ascontiguousarray(np_feeds[n], dtype=s.dtype)
+        mut_in = {n: self._state[n] for n in variant.mut_names}
+        fetches, new_mut = variant.fn(feeds, variant.ro, mut_in)
+        self._state.update(new_mut)
+        return fetches
+
+    # ---- admission / decode / retire --------------------------------------
+    def prefill_bucket(self, prompt_len):
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            "prompt of %d tokens exceeds the largest prefill bucket %d"
+            % (prompt_len, self.prefill_buckets[-1])
+        )
+
+    def can_admit(self, req):
+        """Whether a free slot + pages exist for this request right now."""
+        budget = len(req.prompt) + self._max_new(req)
+        return self.pool.can_admit(budget)
+
+    def _max_new(self, req):
+        # a request can never run past the context window
+        return min(req.max_new_tokens, self.max_context - len(req.prompt))
+
+    def free_slots(self):
+        return self.max_slots - self.pool.stats()["slots_in_use"]
+
+    def start(self, req):
+        """Admit one request: acquire slot+pages, run the prompt's prefill
+        bucket, sample the first token. Returns a _SlotRun (possibly already
+        done). Raises PoolExhausted when no capacity, ValueError on an
+        inadmissible request."""
+        L = len(req.prompt)
+        if L > self.max_prompt_len:
+            raise ValueError(
+                "prompt of %d tokens exceeds max_prompt_len %d"
+                % (L, self.max_prompt_len)
+            )
+        bucket = self.prefill_bucket(L)
+        max_new = self._max_new(req)
+        slot, table = self.pool.acquire(L + max_new)
+        try:
+            seed = req.seed
+            if seed is None:
+                seed = (self.scope._seed, self._sample_counter)
+                self._sample_counter += 1
+            rng = np.random.default_rng(seed)
+            run = _SlotRun(req, slot, table, rng)
+
+            tokens = np.zeros((1, bucket, 1), np.int64)
+            tokens[0, :L, 0] = req.prompt
+            t0 = time.perf_counter()
+            (logits,) = self._call(
+                self._variant("prefill:%d" % bucket),
+                {
+                    "gen_tokens": tokens,
+                    "gen_length": np.array([L], np.int64),
+                    "gen_pages": table,
+                },
+            )
+            self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+            self._m_prefills.inc()
+            # parity surface: tests assert these rows bit-stable under
+            # batching/admission changes (docs/serving.md contract)
+            self.last_prefill_logits = np.asarray(logits)[0]
+            self._append_token(run, self.last_prefill_logits, max_new)
+            self._set_pool_gauges()
+            return run
+        except Exception:
+            self.pool.release(slot)
+            raise
+
+    def decode_step(self, runs):
+        """One fixed-shape decode step advancing every run in `runs` by one
+        token (all must be live). Finished runs are NOT auto-released — the
+        caller retires them via finish()."""
+        if not runs:
+            return
+        tokens = np.zeros((self.max_slots, 1), np.int64)
+        positions = np.zeros((self.max_slots, 1), np.int64)
+        table = np.zeros((self.max_slots, self.max_pages), np.int32)
+        for run in runs:
+            if run.done:
+                raise ValueError("decode_step on a finished run")
+            tokens[run.slot, 0] = run.tokens[-1]
+            positions[run.slot, 0] = run.next_pos
+            table[run.slot] = run.table
+        t0 = time.perf_counter()
+        (logits,) = self._call(
+            self._variant("decode"),
+            {
+                "dec_tokens": tokens,
+                "dec_positions": positions,
+                "dec_block_table": table,
+            },
+        )
+        logits = np.asarray(logits)
+        self.last_logits = logits  # parity surface, see start()
+        self._m_step_ms.observe((time.perf_counter() - t0) * 1e3)
+        self._m_steps.inc()
+        for run in runs:
+            run.next_pos += 1
+            self._append_token(run, logits[run.slot], self._max_new(run.req))
+
+    def finish(self, run):
+        """Retire a run's slot: pages return to the pool for reuse."""
+        self.pool.release(run.slot)
+        self._set_pool_gauges()
+
+    def _append_token(self, run, logits_row, max_new):
+        tok = self._sample(logits_row, run.req, run.rng)
+        run.tokens.append(tok)
+        self.tokens_generated += 1
+        self._m_tokens.inc()
+        eos = run.req.eos_id
+        if eos is None:
+            eos = getattr(self.model, "eos_id", None)
+        if eos is not None and tok == eos:
+            run.done, run.finish_reason = True, "eos"
+        elif len(run.tokens) >= max_new:
+            run.done, run.finish_reason = True, "length"
+
+    def _sample(self, logits, req, rng):
+        logits = np.asarray(logits, np.float64)
+        if not req.temperature:
+            return int(logits.argmax())
+        z = logits / req.temperature
+        if req.top_k and req.top_k < z.size:
+            kth = np.partition(z, -req.top_k)[-req.top_k]
+            z = np.where(z < kth, -np.inf, z)
+        z = z - z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(rng.choice(z.size, p=p))
+
+    def _set_pool_gauges(self):
+        st = self.pool.stats()
+        self._m_slots.set(st["slots_in_use"])
+        self._m_occ.set(st["slot_occupancy"])
+        self._m_pages.set(st["pages_in_use"])
+
+    # ---- convenience / stats ----------------------------------------------
+    def generate(self, prompt, max_new_tokens=16, **kw):
+        """Serial one-request decode (no scheduler): admit, step to
+        completion, retire. The whole-sequence tests' reference path."""
+        req = GenRequest(prompt, max_new_tokens=max_new_tokens, **kw)
+        run = self.start(req)
+        try:
+            while not run.done:
+                self.decode_step([run])
+        finally:
+            self.finish(run)
+        return run.result()
+
+    def stats(self):
+        out = {
+            "variants": len(self._variants),
+            "traces": self.traces,
+            "cache_hits": self.cache_hits,
+            "tokens_generated": self.tokens_generated,
+            "prefill_buckets": list(self.prefill_buckets),
+            "geometry": self.geometry(),
+            "pool": self.pool.stats(),
+        }
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        return out
+
+
+class _Pending:
+    __slots__ = ("req", "future", "t_submit")
+
+    def __init__(self, req):
+        self.req = req
+        self.future = ServingFuture()
+        self.t_submit = time.perf_counter()
+
+
+class GenerationScheduler(ContinuousBatcher):
+    """Token-level continuous scheduler over a GenerationEngine.
+
+    Reuses the ContinuousBatcher shell (bounded queue, condition variable,
+    worker thread, outcome metrics, drain/shutdown) but replaces the batch
+    dispatcher with a step loop:
+
+      1. admit queued requests into free slots — normally at most
+         `prefill_per_step` prefills per step (prefill latency rides on top
+         of every live slot's token latency), escalating to ALL free slots
+         when the queue is deeper than `pressure_queue` (throughput beats
+         tail latency once a backlog forms);
+      2. run ONE fixed-shape decode step for every live slot;
+      3. retire finished slots (EOS / max-new / context bound), releasing
+         their pages, and resolve their futures with GenResult.
+
+    The queue is bounded in REQUESTS (one row each — a generation request's
+    device debt is a slot, not its prompt length).
+    """
+
+    def __init__(self, engine, max_queue_requests=64, timeout_ms=30000.0,
+                 prefill_per_step=1, pressure_queue=4):
+        self.prefill_per_step = max(1, int(prefill_per_step))
+        self.pressure_queue = int(pressure_queue)
+        self._runs = {}  # slot -> _SlotRun
+        self._drain_flag = True
+        from ..observability import registry as _registry
+
+        reg = _registry.default_registry()
+        p = "serving/%s" % engine.name
+        self._m_ttft_ms = reg.histogram(
+            p + "/gen_ttft_ms", "submit -> first token, wall ms"
+        )
+        self._m_token_ms = reg.histogram(
+            p + "/gen_token_ms", "per-token latency (decode step wall)"
+        )
+        super().__init__(
+            engine,
+            max_queue_rows=max_queue_requests,
+            max_batch_delay_ms=0.0,
+            timeout_ms=timeout_ms,
+        )
+
+    # ---- client side ------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, eos_id=None, temperature=None,
+               top_k=None, seed=None):
+        """Enqueue one generation request; returns a ServingFuture resolving
+        to a GenResult."""
+        req = GenRequest(
+            prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+            temperature=temperature, top_k=top_k, seed=seed,
+        )
+        if len(req.prompt) > self.engine.max_prompt_len:
+            raise ValueError(
+                "prompt of %d tokens exceeds max_prompt_len %d"
+                % (len(req.prompt), self.engine.max_prompt_len)
+            )
+        pending = _Pending(req)
+        with self._cond:
+            if not self._alive or self._draining:
+                self._m_requests.inc(outcome="shutdown")
+                raise ShutdownError("scheduler is shut down")
+            if self._queued_rows + 1 > self.max_queue_rows:
+                self._m_requests.inc(outcome="rejected")
+                raise QueueFullError(
+                    "queue full (%d requests queued, limit %d)"
+                    % (self._queued_rows, self.max_queue_rows)
+                )
+            self._queue.append(pending)
+            self._queued_rows += 1
+            self._m_depth.set(self._queued_rows)
+            self._cond.notify_all()
+        return pending.future
+
+    def run(self, prompt, timeout=None, **kw):
+        return self.submit(prompt, **kw).result(
+            self.timeout * 2 if timeout is None else timeout
+        )
+
+    # ---- step loop --------------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cond:
+                while self._alive and not self._queue and not self._runs:
+                    self._cond.wait()
+                if not self._alive:
+                    if not self._drain_flag:
+                        self._fail_runs_locked()
+                        return
+                    if not self._queue and not self._runs:
+                        return
+                admits = self._admit_requests_locked()
+            self._step(admits)
+
+    def _admit_requests_locked(self):
+        """Pop queued requests that fit free capacity right now. Queue
+        pressure escalates the per-step prefill budget from
+        `prefill_per_step` to every free slot."""
+        budget = self.prefill_per_step
+        if len(self._queue) >= self.pressure_queue:
+            budget = self.engine.max_slots
+        pool = self.engine.pool
+        st = pool.stats()
+        slots_left = st["slots_total"] - st["slots_in_use"]
+        pages_left = st["pages_total"] - st["pages_in_use"]
+        admits = []
+        while self._queue and len(admits) < min(budget, slots_left):
+            nxt = self._queue[0]
+            if now_expired(nxt, self.timeout):
+                self._queue.pop(0)
+                self._queued_rows -= 1
+                self._m_requests.inc(outcome="timeout")
+                nxt.future._set_error(RequestTimeout(
+                    "queued %.0f ms > timeout %.0f ms"
+                    % ((time.perf_counter() - nxt.t_submit) * 1e3,
+                       self.timeout * 1e3)
+                ))
+                continue
+            # reservation-aware: each admit here WILL acquire pages before
+            # the pool state refreshes, so account for the whole batch
+            need = pool.pages_for(
+                len(nxt.req.prompt) + self.engine._max_new(nxt.req)
+            )
+            if need > pages_left:
+                break
+            pages_left -= need
+            admits.append(self._queue.pop(0))
+            self._queued_rows -= 1
+        self._m_depth.set(self._queued_rows)
+        return admits
+
+    def _step(self, admits):
+        eng = self.engine
+        for pending in admits:
+            self._m_queue_ms.observe(
+                (time.perf_counter() - pending.t_submit) * 1e3
+            )
+            try:
+                run = eng.start(pending.req)
+            except PoolExhausted as e:
+                # capacity raced away (shouldn't happen single-threaded,
+                # but never drop a request on the floor)
+                self._m_requests.inc(outcome="error")
+                pending.future._set_error(e)
+                continue
+            except Exception as e:
+                self._m_requests.inc(outcome="error")
+                err = RuntimeError("prefill failed: %s" % (repr(e),))
+                err.__cause__ = e
+                pending.future._set_error(err)
+                continue
+            run.future = pending.future
+            run.t_submit = pending.t_submit
+            run.t_first = time.perf_counter()
+            self._m_ttft_ms.observe((run.t_first - run.t_submit) * 1e3)
+            if run.done:
+                self._retire(run)
+            else:
+                self._runs[run.slot] = run
+
+        live = list(self._runs.values())
+        if live:
+            t0 = time.perf_counter()
+            try:
+                eng.decode_step(live)
+            except Exception as e:
+                for run in live:
+                    self._m_requests.inc(outcome="error")
+                    err = RuntimeError("decode failed: %s" % (repr(e),))
+                    err.__cause__ = e
+                    run.future._set_error(err)
+                    eng.finish(run)
+                self._runs.clear()
+                return
+            step_ms = (time.perf_counter() - t0) * 1e3
+            for run in live:
+                self._m_token_ms.observe(step_ms)
+                if run.done:
+                    del self._runs[run.slot]
+                    self._retire(run)
+
+    def _retire(self, run):
+        self.engine.finish(run)
+        self._m_requests.inc(outcome="ok")
+        self._m_latency_ms.observe((time.perf_counter() - run.t_submit) * 1e3)
+        run.future._set_result(run.result())
+
+    def _fail_runs_locked(self):
+        for run in self._runs.values():
+            self._m_requests.inc(outcome="shutdown")
+            run.future._set_error(ShutdownError("scheduler closed"))
+            self.engine.finish(run)
+        self._runs.clear()
+
+    def close(self, drain=True, timeout=30.0):
+        self._drain_flag = bool(drain)
+        return super().close(drain=drain, timeout=timeout)
+
+    def stats(self):
+        with self._cond:
+            return {
+                "queued_requests": self._queued_rows,
+                "live_slots": len(self._runs),
+                "alive": self._alive,
+            }
+
+
+def now_expired(pending, timeout):
+    return (time.perf_counter() - pending.t_submit) > timeout

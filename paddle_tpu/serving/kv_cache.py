@@ -1,0 +1,126 @@
+"""Host-side allocator for the paged KV-cache pool (the vLLM block manager
+analog, sized for the GenerationEngine's fixed-shape decode step).
+
+The device side is dumb on purpose: per layer, one persistable
+``[n_pages * page_size, feat]`` pool tensor that the compiled programs
+gather/scatter through block tables (ops/generation_ops.py). All policy
+lives here, on the host, where it costs nothing per token:
+
+- **page free-list** — page 0 is a reserved *scratch* page that is never
+  handed out. Idle decode slots and padded prefill tail positions write
+  there (their block-table entries are 0), so a fixed-shape program can
+  always run all slots without conditionals; scratch contents are garbage
+  by design and masked out of every attention read.
+- **slot free-list** — a slot is one decode lane in the fixed [max_slots]
+  step. Admission takes a slot + enough pages for the request's worst case
+  (prompt + max_new tokens, the reservation-at-admit policy: admission can
+  never deadlock mid-decode needing a page that isn't there).
+- **page reuse on retirement** — release() returns both to their free
+  lists; the next admission reuses the pages without touching the device
+  (stale rows are overwritten by prefill/decode writes before any read, see
+  docs/serving.md lifecycle).
+
+Thread-safety: the GenerationScheduler's worker thread is the only caller;
+a lock still guards acquire/release so `stats()` from other threads is
+consistent.
+"""
+
+import threading
+
+import numpy as np
+
+__all__ = ["PagedKVPool", "PoolExhausted"]
+
+SCRATCH_PAGE = 0
+
+
+class PoolExhausted(RuntimeError):
+    """No free slot or not enough free pages for the reservation."""
+
+
+class PagedKVPool:
+    def __init__(self, n_pages, page_size, max_slots, max_pages_per_slot):
+        if n_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is scratch)")
+        if page_size < 1 or max_slots < 1 or max_pages_per_slot < 1:
+            raise ValueError("page_size/max_slots/max_pages_per_slot must be >= 1")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.max_slots = int(max_slots)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        self._lock = threading.Lock()
+        # LIFO free lists: hottest pages get reused first (best for any
+        # future device-side page cache locality)
+        self._free_pages = list(range(1, self.n_pages))
+        self._free_slots = list(range(self.max_slots - 1, -1, -1))
+        self._tables = {}  # slot -> np.int32 [max_pages_per_slot]
+
+    @property
+    def pool_rows(self):
+        return self.n_pages * self.page_size
+
+    def pages_for(self, n_positions):
+        """Pages needed to hold `n_positions` cached tokens."""
+        return -(-int(n_positions) // self.page_size)
+
+    def can_admit(self, n_positions):
+        need = self.pages_for(n_positions)
+        with self._lock:
+            return (
+                bool(self._free_slots)
+                and need <= len(self._free_pages)
+                and need <= self.max_pages_per_slot
+            )
+
+    def acquire(self, n_positions):
+        """Reserve a slot + pages for a request whose cache will hold at most
+        `n_positions` tokens. Returns (slot, block_table) where block_table
+        is the slot's np.int32 [max_pages_per_slot] page list, scratch-0
+        padded. Raises PoolExhausted when it can't."""
+        need = self.pages_for(n_positions)
+        if need > self.max_pages_per_slot:
+            raise PoolExhausted(
+                "%d positions need %d pages > max_pages_per_slot %d"
+                % (n_positions, need, self.max_pages_per_slot)
+            )
+        with self._lock:
+            if not self._free_slots:
+                raise PoolExhausted("no free decode slot")
+            if need > len(self._free_pages):
+                raise PoolExhausted(
+                    "need %d pages, %d free" % (need, len(self._free_pages))
+                )
+            slot = self._free_slots.pop()
+            table = np.full(self.max_pages_per_slot, SCRATCH_PAGE, np.int32)
+            for i in range(need):
+                table[i] = self._free_pages.pop()
+            self._tables[slot] = table
+            return slot, table
+
+    def release(self, slot):
+        """Retire a slot: its pages return to the free list for reuse."""
+        with self._lock:
+            table = self._tables.pop(slot, None)
+            if table is None:
+                return
+            for p in table:
+                if p != SCRATCH_PAGE:
+                    self._free_pages.append(int(p))
+            self._free_slots.append(slot)
+
+    def block_table(self, slot):
+        with self._lock:
+            t = self._tables.get(slot)
+            return None if t is None else t.copy()
+
+    def stats(self):
+        with self._lock:
+            in_use = (self.n_pages - 1) - len(self._free_pages)
+            slots = self.max_slots - len(self._free_slots)
+            return {
+                "pages_total": self.n_pages - 1,  # scratch excluded
+                "pages_in_use": in_use,
+                "slots_total": self.max_slots,
+                "slots_in_use": slots,
+                "slot_occupancy": slots / float(self.max_slots),
+            }

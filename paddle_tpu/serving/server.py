@@ -12,6 +12,12 @@ Routes:
   (Content-Type ``application/x-npz``; one array per feed name). JSON
   replies as ``{"outputs": {fetch: nested list}, "latency_ms": float}``;
   npz requests reply as npz bytes.
+- ``POST /v1/models/<name>:generate`` — autoregressive models only
+  (add_generation_model). JSON body ``{"prompt": [ids...],
+  "max_new_tokens": n, "temperature": t, "top_k": k, "seed": s,
+  "eos_id": id}`` (prompt required, rest optional; no temperature means
+  greedy). Replies ``{"tokens": [...], "finish_reason": "eos"|"length",
+  "latency_ms": float}``.
 - ``GET /healthz`` — 200 once every model's engine is constructed; body
   lists models and variant counts.
 - ``GET /v1/models`` — model metadata (feeds, fetches, buckets, stats).
@@ -40,11 +46,12 @@ PREDICT_PREFIX = "/v1/models/"
 
 
 class _Hosted:
-    __slots__ = ("engine", "batcher")
+    __slots__ = ("engine", "batcher", "kind")
 
-    def __init__(self, engine, batcher):
+    def __init__(self, engine, batcher, kind="predict"):
         self.engine = engine
         self.batcher = batcher
+        self.kind = kind
 
 
 class ModelServer:
@@ -79,6 +86,24 @@ class ModelServer:
             engine.warmup(example_feed=warmup_feed)
         batcher = ContinuousBatcher(engine, **(batcher_opts or {}))
         self._models[name] = _Hosted(engine, batcher)
+        return engine
+
+    def add_generation_model(self, name, model=None, engine=None, warmup=True,
+                             scheduler_opts=None, **engine_opts):
+        """Register an autoregressive model behind the `:generate` route.
+        Either pass a prebuilt GenerationEngine or a decoder `model`
+        (GPTDecoder protocol, plus GenerationEngine kwargs). Warmup
+        precompiles the decode step and every prefill bucket."""
+        from .generation import GenerationEngine, GenerationScheduler
+
+        if engine is None:
+            if model is None:
+                raise ValueError("add_generation_model needs model or engine")
+            engine = GenerationEngine(model, name=name, **engine_opts)
+        if warmup:
+            engine.warmup()
+        scheduler = GenerationScheduler(engine, **(scheduler_opts or {}))
+        self._models[name] = _Hosted(engine, scheduler, kind="generate")
         return engine
 
     def models(self):
@@ -178,19 +203,31 @@ class ModelServer:
         }
 
     def _describe(self):
-        return {
-            name: {
-                "feeds": h.engine.feed_names,
-                "fetches": h.engine.fetch_names,
-                "batch_buckets": list(h.engine.batch_buckets),
-                "stats": h.engine.stats(),
-                "batcher": h.batcher.stats(),
-            }
-            for name, h in self._models.items()
-        }
+        out = {}
+        for name, h in self._models.items():
+            if h.kind == "generate":
+                out[name] = {
+                    "kind": "generate",
+                    "stats": h.engine.stats(),
+                    "scheduler": h.batcher.stats(),
+                }
+            else:
+                out[name] = {
+                    "feeds": h.engine.feed_names,
+                    "fetches": h.engine.fetch_names,
+                    "batch_buckets": list(h.engine.batch_buckets),
+                    "stats": h.engine.stats(),
+                    "batcher": h.batcher.stats(),
+                }
+        return out
 
     def _predict(self, path, content_type, body):
-        """(status, reply bytes, content type) for one predict POST."""
+        """(status, reply bytes, content type) for one predict/generate
+        POST."""
+        if path.startswith(PREDICT_PREFIX) and path.endswith(":generate"):
+            return self._generate(
+                path[len(PREDICT_PREFIX):-len(":generate")], body
+            )
         if not (path.startswith(PREDICT_PREFIX) and path.endswith(":predict")):
             return 404, json.dumps({"error": "no route %s" % path}).encode(), \
                 "application/json"
@@ -199,6 +236,10 @@ class ModelServer:
         if hosted is None:
             return 404, json.dumps(
                 {"error": "unknown model %r (have %s)" % (name, self.models())}
+            ).encode(), "application/json"
+        if hosted.kind != "predict":
+            return 400, json.dumps(
+                {"error": "model %r serves :generate, not :predict" % name}
             ).encode(), "application/json"
 
         as_npz = "npz" in content_type or content_type == "application/octet-stream"
@@ -261,5 +302,57 @@ class ModelServer:
                     for n, o in zip(hosted.engine.fetch_names, outs)
                 },
                 "latency_ms": latency_ms,
+            }
+        ).encode(), "application/json"
+
+    def _generate(self, name, body):
+        """(status, reply bytes, content type) for one :generate POST."""
+        hosted = self._models.get(name)
+        if hosted is None:
+            return 404, json.dumps(
+                {"error": "unknown model %r (have %s)" % (name, self.models())}
+            ).encode(), "application/json"
+        if hosted.kind != "generate":
+            return 400, json.dumps(
+                {"error": "model %r serves :predict, not :generate" % name}
+            ).encode(), "application/json"
+        try:
+            doc = json.loads(body.decode() or "{}")
+            prompt = doc.get("prompt")
+            if not isinstance(prompt, (list, tuple)) or not prompt:
+                raise ValueError('body needs {"prompt": [token ids...]}')
+            kw = {
+                k: doc[k]
+                for k in ("max_new_tokens", "eos_id", "temperature",
+                          "top_k", "seed")
+                if doc.get(k) is not None
+            }
+        except (ValueError, json.JSONDecodeError) as e:
+            return 400, json.dumps({"error": "bad payload: %r" % e}).encode(), \
+                "application/json"
+
+        t0 = time.perf_counter()
+        try:
+            future = hosted.batcher.submit(prompt, **kw)
+        except QueueFullError as e:
+            return 503, json.dumps({"error": str(e)}).encode(), \
+                "application/json"
+        except ValueError as e:
+            return 400, json.dumps({"error": str(e)}).encode(), \
+                "application/json"
+        try:
+            res = future.result(self.request_timeout)
+        except RequestTimeout as e:
+            return 504, json.dumps({"error": str(e)}).encode(), \
+                "application/json"
+        except Exception as e:
+            return 500, json.dumps({"error": repr(e)}).encode(), \
+                "application/json"
+        return 200, json.dumps(
+            {
+                "tokens": list(res.tokens),
+                "finish_reason": res.finish_reason,
+                "prompt_len": res.prompt_len,
+                "latency_ms": (time.perf_counter() - t0) * 1e3,
             }
         ).encode(), "application/json"

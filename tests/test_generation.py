@@ -1,0 +1,399 @@
+"""Generation serving tier (paddle_tpu/serving/generation.py, kv_cache.py,
+models/gpt_decoder.py): continuous-batch vs serial token parity, mid-stream
+admission bit-parity, NMT beam-search round-trip through aot_serve_lowering,
+decode-state donation aliasing vs single-shot, compile-cache geometry
+keying across fresh processes, scheduler lifecycle, and the HTTP :generate
+route."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, aot_serve_lowering, scope_guard
+from paddle_tpu.models.gpt_decoder import GPTDecoder
+from paddle_tpu.serving import (
+    GenerationEngine,
+    GenerationScheduler,
+    GenRequest,
+    ModelServer,
+    PagedKVPool,
+    PoolExhausted,
+    QueueFullError,
+    ServingEngine,
+    ShutdownError,
+)
+
+MODEL_KW = dict(
+    vocab_size=24, n_layer=2, n_head=2, d_model=16, d_inner=32, max_context=16
+)
+NO_EOS = 999  # never sampled: forces "length" finishes in timing-sensitive tests
+
+
+@pytest.fixture(scope="module")
+def gen_engine():
+    model = GPTDecoder(**MODEL_KW)
+    eng = GenerationEngine(
+        model, name="tgen", max_slots=3, page_size=4, max_context=16,
+        cache_dir=None,
+    )
+    eng.warmup()
+    return eng
+
+
+# ---------------------------------------------------------------- allocator
+
+
+def test_paged_pool_reuse_and_exhaustion():
+    pool = PagedKVPool(n_pages=5, page_size=4, max_slots=2, max_pages_per_slot=2)
+    s0, t0 = pool.acquire(8)   # 2 pages
+    s1, t1 = pool.acquire(5)   # 2 pages
+    assert s0 != s1
+    assert 0 not in set(t0[t0 != 0]) and 0 not in set(t1[t1 != 0])
+    assert pool.stats()["pages_in_use"] == 4
+    with pytest.raises(PoolExhausted):
+        pool.acquire(1)  # no slot left
+    pool.release(s0)
+    used = set(int(p) for p in t0 if p != 0)
+    s2, t2 = pool.acquire(8)  # page reuse on retirement
+    assert set(int(p) for p in t2 if p != 0) == used
+    pool.release(s1)
+    pool.release(s2)
+    assert pool.stats() == {
+        "pages_total": 4, "pages_in_use": 0, "slots_total": 2,
+        "slots_in_use": 0, "slot_occupancy": 0.0,
+    }
+
+
+# ------------------------------------------------------------ token parity
+
+
+def test_continuous_batch_matches_serial_decode(gen_engine):
+    """(a) token-for-token parity: mixed prompt/output lengths through the
+    continuous scheduler == one-request-at-a-time decode."""
+    eng = gen_engine
+    cases = [
+        ([3, 7, 11, 2, 9], 3),
+        ([1, 2], 6),
+        ([5, 6, 7], 5),
+        ([9, 8, 7, 6, 5, 4, 3], 7),
+        ([2, 4], 4),
+        ([13, 12, 11, 10], 5),
+    ]
+    serial = [eng.generate(p, max_new_tokens=m) for p, m in cases]
+    sched = GenerationScheduler(eng, timeout_ms=60000.0)
+    try:
+        futs = [sched.submit(p, max_new_tokens=m) for p, m in cases]
+        results = [f.result(60) for f in futs]
+    finally:
+        assert sched.close(drain=True)
+    for (p, m), want, got in zip(cases, serial, results):
+        assert got.tokens == want.tokens, (p, got.tokens, want.tokens)
+        assert got.finish_reason == want.finish_reason
+    st = eng.pool.stats()
+    assert st["slots_in_use"] == 0 and st["pages_in_use"] == 0
+    assert eng.traces == len(eng._variants), "hot loop retraced"
+
+
+def test_paged_decode_bit_identical_to_dense_forward(gen_engine):
+    """The paged decode path reproduces the whole-sequence dense program's
+    logits bit-for-bit (same params, same math, different cache plumbing)."""
+    eng = gen_engine
+    model, T = eng.model, 16
+    main, _, feeds, fetches = model.build_forward(1, T)
+    with scope_guard(eng.scope):
+        serve, ro, mut = aot_serve_lowering(main, feeds, fetches, eng.scope)
+    assert not mut
+
+    prompt, n_new = [3, 7, 11, 2, 9], 4
+
+    def oracle_row(tokens):
+        buf = np.zeros((1, T, 1), np.int64)
+        buf[0, :len(tokens), 0] = tokens
+        (lg,) = serve({"fwd_tokens": buf}, ro, {})
+        return np.asarray(lg)[0, len(tokens) - 1]
+
+    req = GenRequest(prompt, max_new_tokens=n_new, eos_id=NO_EOS)
+    run = eng.start(req)
+    toks = list(prompt) + [run.tokens[-1]]
+    np.testing.assert_array_equal(eng.last_prefill_logits, oracle_row(prompt))
+    try:
+        while not run.done:
+            eng.decode_step([run])
+            np.testing.assert_array_equal(
+                eng.last_logits[run.slot], oracle_row(toks)
+            )
+            toks.append(run.tokens[-1])
+    finally:
+        eng.finish(run)
+
+
+def test_mid_stream_admit_does_not_perturb_other_slots(gen_engine):
+    """(b) admitting a request mid-batch never changes another live slot's
+    logits — bit-parity against a solo run of the same request."""
+    eng = gen_engine
+    req_a = dict(prompt=[3, 1, 4, 1, 5], max_new_tokens=8, eos_id=NO_EOS)
+
+    def drive(mid_admit):
+        run = eng.start(GenRequest(**req_a))
+        rows = [eng.last_prefill_logits.copy()]
+        other = None
+        try:
+            for step in range(7):
+                live = [run]
+                if mid_admit and step == 3:
+                    other = eng.start(
+                        GenRequest([9, 2, 6], max_new_tokens=12, eos_id=NO_EOS)
+                    )
+                if other is not None and not other.done:
+                    live.append(other)
+                eng.decode_step(live)
+                rows.append(eng.last_logits[run.slot].copy())
+        finally:
+            eng.finish(run)
+            if other is not None:
+                eng.finish(other)
+        return rows
+
+    solo = drive(mid_admit=False)
+    shared = drive(mid_admit=True)
+    assert len(solo) == len(shared)
+    for i, (a, b) in enumerate(zip(solo, shared)):
+        np.testing.assert_array_equal(a, b, err_msg="step %d" % i)
+
+
+def test_sampling_deterministic_per_seed(gen_engine):
+    eng = gen_engine
+    kw = dict(max_new_tokens=6, temperature=0.7, top_k=4, eos_id=NO_EOS)
+    a = eng.generate([2, 3, 5], seed=11, **kw)
+    b = eng.generate([2, 3, 5], seed=11, **kw)
+    c = eng.generate([2, 3, 5], seed=12, **kw)
+    assert a.tokens == b.tokens
+    assert max(a.tokens) < MODEL_KW["vocab_size"]
+    assert a.tokens != c.tokens or True  # different seed may still collide
+
+
+# ------------------------------------------------- NMT infer path round-trip
+
+
+def test_nmt_infer_roundtrips_through_aot_serve_lowering():
+    """(c) the beam-search XLA-While infer model still lowers through
+    aot_serve_lowering and matches the Executor bit-for-bit."""
+    from paddle_tpu.models import machine_translation as mt
+
+    B, T, VOCAB = 2, 4, 10
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        src = fluid.layers.data(
+            name="src", shape=[B, T, 1], dtype="int64", append_batch_size=False
+        )
+        main.global_block().create_var(name="src_len", shape=(B,), dtype="int64")
+        src._len_name = "src_len"
+        ids, scores = mt.infer_model(
+            src, VOCAB, beam_size=2, max_out_len=T + 1, start_id=0, end_id=1
+        )
+    fetch = [ids.name, scores.name, ids._hyp_len.name]
+    rng = np.random.RandomState(5)
+    feed = {
+        "src": rng.randint(2, VOCAB, (B, T, 1)).astype(np.int64),
+        "src_len": np.array([T, T - 1], np.int64),
+    }
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ref = exe.run(main, feed=feed, fetch_list=fetch)
+        serve, ro, mut = aot_serve_lowering(
+            main, ["src", "src_len"], fetch, scope
+        )
+    got = serve(feed, ro, mut)
+    assert len(got) == len(ref) == 3
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+# --------------------------------------------------------- donation aliasing
+
+
+def _save_mlp(tmp_path):
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="alias_x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    model_dir = str(tmp_path / "alias_mlp")
+    with scope_guard(Scope(seed=1)):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["alias_x"], [y], exe,
+                                      main_program=main)
+    return model_dir
+
+
+def test_decode_state_donated_single_shot_not(gen_engine, tmp_path):
+    """Donation is a property of the compiled executable, not convention:
+    the decode/prefill variants alias their KV-pool args in place; the
+    single-shot ServingEngine variants must not alias anything."""
+    dec = gen_engine._variant("decode")
+    assert "input_output_alias" in dec.fn.as_text()
+    pre = gen_engine._variant("prefill:%d" % gen_engine.prefill_buckets[0])
+    assert "input_output_alias" in pre.fn.as_text()
+
+    sse = ServingEngine(
+        _save_mlp(tmp_path), name="alias_mlp", batch_buckets=(1, 2),
+        cache_dir=None,
+    )
+    sse.warmup()
+    assert sse._variants
+    for fn in sse._variants.values():
+        assert "input_output_alias" not in fn.as_text()
+
+
+# ----------------------------------------------- compile-cache geometry keys
+
+_CACHE_BOOT = r"""
+import os, json, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from paddle_tpu.models.gpt_decoder import GPTDecoder
+from paddle_tpu.serving.generation import GenerationEngine
+cache_dir, page_size = sys.argv[1], int(sys.argv[2])
+m = GPTDecoder(vocab_size=16, n_layer=1, n_head=2, d_model=8, d_inner=16,
+               max_context=8)
+e = GenerationEngine(m, name="cgeom", max_slots=2, page_size=page_size,
+                     max_context=8, prefill_buckets=(4,), cache_dir=cache_dir)
+e.warmup()
+print(json.dumps({"traces": e.traces, "cache_hits": e.cache_hits,
+                  "variants": len(e._variants)}))
+"""
+
+
+@pytest.mark.slow
+def test_cache_geometry_misses_in_fresh_process(tmp_path):
+    """Satellite: same geometry second boot = all cache hits, zero traces;
+    flipping page size in a fresh process must MISS (never replay a stale
+    executable against a differently-shaped pool)."""
+    cache = str(tmp_path / "gen_cache")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def boot(page_size):
+        out = subprocess.run(
+            [sys.executable, "-c", _CACHE_BOOT, cache, str(page_size)],
+            capture_output=True, text=True, env=env, timeout=600,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    first = boot(4)
+    assert first["traces"] == first["variants"] == 2
+    warm = boot(4)
+    assert warm["traces"] == 0
+    assert warm["cache_hits"] == warm["variants"] == 2
+    flipped = boot(2)
+    assert flipped["traces"] == flipped["variants"] == 2, flipped
+
+
+# ------------------------------------------------------- scheduler lifecycle
+
+
+def test_scheduler_backpressure_and_shutdown():
+    model = GPTDecoder(**MODEL_KW)
+    eng = GenerationEngine(
+        model, name="tgen_bp", max_slots=1, page_size=4, max_context=16,
+        prefill_buckets=(4,), cache_dir=None,
+    )
+    eng.warmup()
+    sched = GenerationScheduler(eng, max_queue_requests=1, timeout_ms=60000.0)
+    futs = [sched.submit([2, 3], max_new_tokens=14, eos_id=NO_EOS)]
+    with pytest.raises(QueueFullError):
+        # the single slot drains at one request per 14 decode steps; flooding
+        # submits must hit the bounded queue (limit 1) and fast-fail
+        for _ in range(200):
+            futs.append(sched.submit([4, 5], max_new_tokens=14, eos_id=NO_EOS))
+    assert sched.close(drain=False)  # fail-fast close joins the worker
+    for f in futs:
+        try:
+            f.result(5)  # completed before close, or failed at shutdown
+        except (ShutdownError, RuntimeError):
+            pass
+    st = eng.pool.stats()
+    assert st["slots_in_use"] == 0 and st["pages_in_use"] == 0
+
+    with pytest.raises(ShutdownError):
+        sched.submit([1, 2])
+
+    with pytest.raises(ValueError):
+        GenRequest([], max_new_tokens=1)
+    with pytest.raises(ValueError):
+        GenRequest([1], max_new_tokens=0)
+
+
+# ------------------------------------------------------------- HTTP :generate
+
+
+def test_http_generate_route(gen_engine):
+    want = gen_engine.generate([5, 4, 3], max_new_tokens=5)
+    server = ModelServer(request_timeout_ms=60000.0)
+    server.add_generation_model("tgen", engine=gen_engine)
+    port = server.start()
+    try:
+        body = json.dumps(
+            {"prompt": [5, 4, 3], "max_new_tokens": 5}
+        ).encode()
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/v1/models/tgen:generate" % port,
+            data=body, headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            doc = json.loads(resp.read())
+        assert doc["tokens"] == want.tokens
+        assert doc["finish_reason"] == want.finish_reason
+        assert doc["prompt_len"] == 3
+
+        # wrong verb on a generation model -> 400
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/v1/models/tgen:predict" % port,
+            data=b"{}", headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+        # bad payload -> 400; unknown model -> 404
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/v1/models/tgen:generate" % port,
+            data=b'{"prompt": []}',
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/v1/models/nope:generate" % port,
+            data=b'{"prompt": [1]}',
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+        # health/describe include the generation model
+        with urllib.request.urlopen(
+            "http://127.0.0.1:%d/v1/models" % port, timeout=10
+        ) as resp:
+            desc = json.loads(resp.read())
+        assert desc["tgen"]["kind"] == "generate"
+        assert desc["tgen"]["stats"]["traces"] == desc["tgen"]["stats"]["variants"]
+    finally:
+        server.stop(drain=True)

@@ -1,0 +1,109 @@
+"""Direct numeric op tests for the generation-serving ops.
+
+`kv_cache_write` scatters per-token K/V rows into the paged pool through a
+block table; `paged_attention` gathers pages back and runs causal-by-position
+attention over them. Both are pure functions of their inputs, so each gets a
+numpy reference checked through the real Program/Executor path.
+"""
+
+import unittest
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _flat_rows_np(block_table, positions, page_size):
+    positions = positions.reshape(-1).astype(np.int64)
+    page_idx = positions // page_size
+    if block_table.ndim == 1:
+        page_id = block_table.astype(np.int64)[page_idx]
+    else:
+        page_id = np.take_along_axis(
+            block_table.astype(np.int64), page_idx[:, None], axis=1
+        )[:, 0]
+    return page_id * page_size + positions % page_size
+
+
+class TestKVCacheWriteDecode(OpTest):
+    """Decode-shaped write: [S, P] block table, one row per slot."""
+
+    def setUp(self):
+        self.op_type = "kv_cache_write"
+        page_size, n_pages, feat, slots = 4, 6, 8, 3
+        pool = np.random.rand(n_pages * page_size, feat).astype("float32")
+        rows = np.random.rand(slots, feat).astype("float32")
+        bt = np.array([[1, 4], [2, 0], [5, 3]], dtype="int32")
+        pos = np.array([0, 3, 6], dtype="int32")  # slot 2 lands in page 3
+        self.inputs = {"Pool": pool, "Rows": rows, "BlockTable": bt, "Pos": pos}
+        self.attrs = {"page_size": page_size}
+        out = pool.copy()
+        out[_flat_rows_np(bt, pos, page_size)] = rows
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestKVCacheWritePrefill(OpTest):
+    """Prefill-shaped write: [P] block table, one slot writing many rows."""
+
+    def setUp(self):
+        self.op_type = "kv_cache_write"
+        page_size, n_pages, feat, length = 4, 5, 6, 10
+        pool = np.random.rand(n_pages * page_size, feat).astype("float32")
+        rows = np.random.rand(length, feat).astype("float32")
+        bt = np.array([2, 4, 1], dtype="int32")
+        pos = np.arange(length, dtype="int32")
+        self.inputs = {"Pool": pool, "Rows": rows, "BlockTable": bt, "Pos": pos}
+        self.attrs = {"page_size": page_size}
+        out = pool.copy()
+        out[_flat_rows_np(bt, pos, page_size)] = rows
+        self.outputs = {"Out": out}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestPagedAttention(OpTest):
+    def setUp(self):
+        self.op_type = "paged_attention"
+        n_head, d, page_size = 2, 4, 4
+        slots, pages_per_slot, n_pages = 3, 2, 8
+        ctx_len = pages_per_slot * page_size
+        feat = n_head * d
+        q = (np.random.rand(slots, feat).astype("float32") - 0.5)
+        kp = (np.random.rand(n_pages * page_size, feat).astype("float32") - 0.5)
+        vp = (np.random.rand(n_pages * page_size, feat).astype("float32") - 0.5)
+        # slot 1 still inside its first page: second entry is the scratch
+        # page (0) and must be masked out by the position bound below
+        bt = np.array([[1, 3], [2, 0], [6, 5]], dtype="int32")
+        pos = np.array([5, 2, 7], dtype="int32")
+        self.inputs = {
+            "Q": q, "KPool": kp, "VPool": vp, "BlockTable": bt, "Pos": pos,
+        }
+        self.attrs = {"n_head": n_head, "page_size": page_size}
+
+        flat = (
+            bt.astype(np.int64)[:, :, None] * page_size
+            + np.arange(page_size, dtype=np.int64)[None, None, :]
+        ).reshape(slots, ctx_len)
+        k = kp[flat.reshape(-1)].reshape(slots, ctx_len, n_head, d)
+        v = vp[flat.reshape(-1)].reshape(slots, ctx_len, n_head, d)
+        qh = q.reshape(slots, n_head, d).astype(np.float64)
+        scores = np.einsum("shd,schd->shc", qh, k.astype(np.float64))
+        scores *= d ** -0.5
+        live = np.arange(ctx_len)[None, :] <= pos[:, None]
+        scores = np.where(live[:, None, :], scores, -1e9)
+        scores -= scores.max(axis=-1, keepdims=True)
+        weights = np.exp(scores)
+        weights /= weights.sum(axis=-1, keepdims=True)
+        out = np.einsum("shc,schd->shd", weights, v.astype(np.float64))
+        self.outputs = {"Out": out.reshape(slots, feat).astype("float32")}
+
+    def test_check_output(self):
+        self.check_output()
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -130,6 +130,23 @@ def _serving_summary(metrics):
         fill = m.get("batch_fill") or {}
         if fill.get("count"):
             row["fill_mean"] = fill.get("sum", 0.0) / fill["count"]
+        if m.get("gen_tokens"):
+            # generation models (GenerationEngine/Scheduler namespace)
+            row["gen_tokens"] = scalar(m.get("gen_tokens"))
+            row["gen_steps"] = scalar(m.get("gen_steps"))
+            row["gen_slots_live"] = scalar(m.get("gen_slots_live"))
+            row["gen_slot_occupancy"] = scalar(m.get("gen_slot_occupancy"))
+            row["gen_kv_pages"] = scalar(m.get("gen_kv_pages_used"))
+            for key, hist in (
+                ("gen_token", m.get("gen_token_ms")),
+                ("gen_ttft", m.get("gen_ttft_ms")),
+            ):
+                row[key + "_p50_ms"] = (
+                    _hist_percentile(hist, 50) if hist else None
+                )
+                row[key + "_p99_ms"] = (
+                    _hist_percentile(hist, 99) if hist else None
+                )
         out[model] = row
 
     cc_hits = scalar(metrics.get("serving/compile_cache/hits"))
@@ -383,6 +400,21 @@ def summarize(records, window=200):
             summary["bubble"] = bub.get("bubble")
             summary["bubble_analytic"] = bub.get("analytic")
         summary["serving"] = _serving_summary(metrics)
+        if len(snaps) >= 2:
+            # tokens/s for generation models: counter delta over the last
+            # two snapshots (snapshot gauges carry no rate of their own)
+            prev = snaps[-2]
+            dt = (last.get("ts") or 0.0) - (prev.get("ts") or 0.0)
+            pmet = prev.get("metrics", {})
+            for model, row in summary["serving"].items():
+                if not isinstance(row, dict) or row.get("gen_tokens") is None:
+                    continue
+                rec = pmet.get("serving/%s/gen_tokens" % model) or {}
+                before = (rec.get("values") or {}).get("", 0.0)
+                if dt > 0:
+                    row["gen_tokens_per_s"] = max(
+                        0.0, (row["gen_tokens"] - before) / dt
+                    )
         summary["data"] = _data_summary(metrics)
         summary["embedding"] = _embedding_summary(metrics)
         summary["resilience"] = _resilience_summary(metrics)
@@ -465,6 +497,29 @@ def render(summary):
                 _fmt(s.get("traces"), "{:.0f}", "0"),
             ),
         ))
+        if s.get("gen_tokens") is not None:
+            rows.append((
+                "serve/gen %s" % model,
+                "%s tok (%s tok/s), token p50 %s p99 %s ms, "
+                "ttft p50 %s p99 %s ms" % (
+                    _fmt(s.get("gen_tokens"), "{:.0f}"),
+                    _fmt(s.get("gen_tokens_per_s"), "{:.0f}"),
+                    _fmt(s.get("gen_token_p50_ms")),
+                    _fmt(s.get("gen_token_p99_ms")),
+                    _fmt(s.get("gen_ttft_p50_ms")),
+                    _fmt(s.get("gen_ttft_p99_ms")),
+                ),
+            ))
+            rows.append((
+                "serve/gen %s kv" % model,
+                "occupancy %s (%s slots live), %s kv pages in use, "
+                "%s decode steps" % (
+                    _fmt(s.get("gen_slot_occupancy")),
+                    _fmt(s.get("gen_slots_live"), "{:.0f}"),
+                    _fmt(s.get("gen_kv_pages"), "{:.0f}"),
+                    _fmt(s.get("gen_steps"), "{:.0f}"),
+                ),
+            ))
     if cc:
         rows.append((
             "serve/compile cache",
