@@ -6,9 +6,11 @@ sharding the table across pservers, parameter_prefetch.cc fetching rows by
 RPC, and lookup_sparse_table_op growing rows on demand. The TPU redesign
 collapses that machinery into one engine that owns:
 
-- **table creation**: one Parameter annotated `sharding_spec=(axis, None)` so
-  ParallelExecutor stores it row-sharded over the mesh's `ep` axis (GSPMD
-  placement, executor._CompiledBlock.state_sharding) — no pserver processes;
+- **table creation**: one Parameter with a `(axis, None)` sharding RULE
+  registered on the program (parallel.sharding_rules.program_rules) so
+  ParallelExecutor stores it — and its optimizer accumulators — row-sharded
+  over the mesh's `ep` axis (GSPMD placement, the executor's rule Resolver)
+  — no pserver processes;
 - **forward**: the `distributed_lookup_table` op → gather over the local
   shard + one psum (embedding/lookup.py) instead of an RPC prefetch;
 - **sparse backward**: `is_sparse=True` routes lookup_table_grad through the
@@ -65,7 +67,9 @@ class EmbeddingEngine:
         is_sparse=True,
         param_attr=None,
     ):
-        from ..parallel import shard_parameter
+        import re as _re
+
+        from ..parallel import program_rules
 
         self.num_rows = int(num_rows)
         self.dim = int(dim)
@@ -85,7 +89,15 @@ class EmbeddingEngine:
         self.table = helper.create_parameter(
             attr=attr, shape=[self.num_rows, self.dim], dtype=dtype, is_bias=False
         )
-        shard_parameter(self.table, (axis_name, None))
+        # declare the row-sharded layout through the sharding-rule engine
+        # (parallel/sharding_rules) instead of a per-var attr: the anchored
+        # `(_.*)?` suffix covers the table AND its optimizer accumulators
+        # (`<table>_<slot>_acc_<k>`), so moments row-shard alongside the rows
+        # they update — same placement the old shard_parameter path produced
+        # (bit-parity asserted by tests/test_sharding_rules.py)
+        program_rules(self.table.block.program).add(
+            "^%s(_.*)?$" % _re.escape(self.table.name), (axis_name, None)
+        )
         self.name = name if name is not None else self.table.name
         self._emit_static_gauges()
 

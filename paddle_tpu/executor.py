@@ -74,10 +74,18 @@ def _apply_pass_pipeline(program, scope, feed_names, fetch_names, pipeline=None)
 
     if not _pm.resolve_pipeline(pipeline):
         return program
-    return _pm.apply_cached(
+    out = _pm.apply_cached(
         program, pipeline, scope=scope,
         feed_names=feed_names, fetch_names=fetch_names,
     )
+    # sharding rules live on the Program OBJECT (parallel.sharding_rules
+    # .program_rules); the rewritten program shares the source's rule set so
+    # placement survives the pipeline (mutations propagate — the executor
+    # cache key carries the rules' fingerprint)
+    rules = getattr(program, "_sharding_rules", None)
+    if out is not program and rules is not None:
+        out._sharding_rules = rules
+    return out
 
 
 def _compiled_ops(compiled):
@@ -345,7 +353,7 @@ class _CompiledBlock:
 
     def __init__(self, program, block, feed_names, fetch_names, scope, mesh=None,
                  data_axes=("dp",), feed_ranks=None, ops_override=None,
-                 zero1_axis=None, instrument=True):
+                 zero1_axis=None, sharding_rules=None, instrument=True):
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         src_ops = block.ops if ops_override is None else ops_override
@@ -480,6 +488,7 @@ class _CompiledBlock:
             self.jitted = jax.jit(run, donate_argnums=(2,))
             self._feed_sharding = None
             self.zero1_state_names = []
+            self._resolver = None
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -487,10 +496,33 @@ class _CompiledBlock:
             repl = NamedSharding(mesh, P())
             self._feed_sharding = batch
 
+            # the declarative sharding-rule engine (parallel/sharding_rules):
+            # program-attached rules first, then BuildStrategy rules so the
+            # caller wins ties under last-match. The resolver also layers the
+            # legacy per-var sharding_spec attr and (below) the zero1 tier,
+            # making it the block's single placement source of truth.
+            from .parallel.sharding_rules import Resolver, ShardingRules
+
+            combined = ShardingRules()
+            combined.extend(getattr(program, "_sharding_rules", None))
+            combined.extend(sharding_rules)
+
+            def var_lookup(name):
+                try:
+                    return block._var_recursive(name)
+                except KeyError:
+                    return None
+
+            resolver = Resolver(mesh, rules=combined, var_lookup=var_lookup)
+            resolver.add_aliases(self.ops)
+            self._resolver = resolver
+
             # ZeRO-1: optimizer-state tensors live sharded 1/dp per rank —
             # the ÷dp state-memory/HBM win. Names come from the optimizer
             # ops' state input slots; only tensors whose leading dim divides
-            # the axis shard (scalars like Beta*Pow stay replicated).
+            # the axis shard (scalars like Beta*Pow stay replicated). State
+            # whose PARAM has a rule/attr layout is excluded: the rule tier
+            # (FSDP/TP) stores it in the param's spec instead.
             zero1_names = set()
             if z1 is not None:
                 from .ops.core_ops import ZERO1_STATE_SLOTS
@@ -500,36 +532,25 @@ class _CompiledBlock:
                     for slot in ZERO1_STATE_SLOTS.get(op.type, ()):
                         for name in op.inputs.get(slot, ()):
                             val = scope.find_var(name)
-                            if val is not None and zero1_shardable(
-                                np.shape(val), mesh, z1
+                            if (
+                                val is not None
+                                and zero1_shardable(np.shape(val), mesh, z1)
+                                and resolver.rule_spec(name, np.shape(val))
+                                is None
                             ):
                                 zero1_names.add(name)
             self.zero1_state_names = sorted(zero1_names)
-            z1_sh = NamedSharding(mesh, P(z1)) if z1 is not None else None
+            resolver.set_zero1(z1, zero1_names)
 
             def state_sharding(name):
-                """Parameters annotated via parallel.shard_parameter carry a
-                PartitionSpec tuple (tensor parallelism); default replicated.
-                Axes the current mesh doesn't have degrade to replication so
-                the same program runs on any mesh (e.g. distributed_embedding
-                under a dp-only ParallelExecutor). ZeRO-1 optimizer state
-                shards over the zero1 axis."""
-                if name in zero1_names:
-                    return z1_sh
-                try:
-                    v = block._var_recursive(name)
-                except KeyError:
-                    return repl
-                spec = getattr(v, "sharding_spec", None)
-                if spec is None:
-                    return repl
-                def keep(axis):
-                    if axis is None:
-                        return None
-                    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
-                    kept = tuple(a for a in axes if a in mesh.shape)
-                    return kept if kept else None
-                return NamedSharding(mesh, P(*(keep(a) for a in spec)))
+                """Resolver verdict for one state tensor: explicit rules >
+                accumulator alias > legacy shard_parameter attr > ZeRO-1
+                state > replicated, pruned so axes the current mesh doesn't
+                have degrade to replication (the same program runs on any
+                mesh — e.g. distributed_embedding under a dp-only PE)."""
+                val = scope.find_var(name)
+                shape = np.shape(val) if val is not None else None
+                return resolver.named_sharding(name, shape)
 
             # rank-0 feeds (scalars) cannot be batch-sharded — replicate them
             feed_ranks = feed_ranks or {}
@@ -573,7 +594,10 @@ class _CompiledBlock:
             env.update(ro_state)
             env.update(mut_state)
             env.update(feeds)
-            ctx = registry.LowerCtx(rng_key, mesh=mesh, zero1_axis=z1)
+            ctx = registry.LowerCtx(
+                rng_key, mesh=mesh, zero1_axis=z1,
+                sharding=getattr(self, "_resolver", None),
+            )
             registry.lower_ops(ctx, ops_, env)
             fetches = [env[n] for n in self.fetch_names]
             new_mut = {n: env[n] for n in self.mut_names}
@@ -753,8 +777,8 @@ class _PipelinedBlock(_CompiledBlock):
     """
 
     def __init__(self, program, block, feed_names, fetch_names, scope,
-                 mesh, feed_ranks=None, zero1_axis=None, loss_name=None,
-                 n_micro=None, schedule="gpipe"):
+                 mesh, feed_ranks=None, zero1_axis=None, sharding_rules=None,
+                 loss_name=None, n_micro=None, schedule="gpipe"):
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(
                 "pipeline schedule must be 'gpipe' or '1f1b', got %r"
@@ -772,7 +796,7 @@ class _PipelinedBlock(_CompiledBlock):
         super().__init__(
             program, block, feed_names, fetch_names, scope,
             mesh=mesh, feed_ranks=feed_ranks, zero1_axis=zero1_axis,
-            instrument=False,
+            sharding_rules=sharding_rules, instrument=False,
         )
 
     # packable boundary dtypes: everything is carried as f32 in the boundary
@@ -1291,7 +1315,10 @@ class _PipelinedBlock(_CompiledBlock):
             for (n, shp, dt, sz) in scal_entries:
                 env[n] = scal[off:off + sz].reshape(shp).astype(dt)
                 off += sz
-            ctx = registry.LowerCtx(key_opt, mesh=mesh, zero1_axis=z1)
+            ctx = registry.LowerCtx(
+                key_opt, mesh=mesh, zero1_axis=z1,
+                sharding=getattr(self_, "_resolver", None),
+            )
             registry.lower_ops(ctx, opt_ops, env)
             fetches = [env[n] for n in fetch_names]
             new_mut = {n: env[n] for n in self_.mut_names}
@@ -1328,7 +1355,7 @@ class _MultiStepBlock:
 
     def __init__(self, program, block, feed_names, fetch_names, scope,
                  steps_per_run, mesh=None, data_axes=("dp",), feed_ranks=None,
-                 zero1_axis=None):
+                 zero1_axis=None, sharding_rules=None):
         if steps_per_run < 1:
             raise ValueError("steps_per_run must be >= 1")
         self.steps_per_run = steps_per_run
@@ -1340,7 +1367,8 @@ class _MultiStepBlock:
         inner = _CompiledBlock(
             program, block, feed_names, fetch_names, scope,
             mesh=mesh, data_axes=data_axes, feed_ranks=feed_ranks,
-            zero1_axis=zero1_axis, instrument=False,
+            zero1_axis=zero1_axis, sharding_rules=sharding_rules,
+            instrument=False,
         )
         if inner.created_persistables:
             raise RuntimeError(

@@ -1479,73 +1479,6 @@ ZERO1_STATE_SLOTS = {
 }
 
 
-def _zero1_mesh(ctx):
-    """(mesh, axis) when the ZeRO-1 tier is active for this trace, else
-    (None, None)."""
-    axis = getattr(ctx, "zero1_axis", None)
-    mesh = getattr(ctx, "mesh", None)
-    if axis and mesh is not None and mesh.shape.get(axis, 1) > 1:
-        return mesh, axis
-    return None, None
-
-
-def _zero1_constrain_ins(ins, mesh, axis):
-    """ZeRO-1 input constraints: every shardable floating input (Param, Grad,
-    moments) is pinned to a 1/dp shard along dim 0. On the GRADIENT — still an
-    unpositioned cross-replica partial sum at this point of the trace — GSPMD
-    materializes the combine as reduce-scatter ((p-1)/p wire bytes vs the
-    all-reduce's 2(p-1)/p); on replicated params it is a local slice; on the
-    already-sharded moments it is a no-op confirming the stored layout."""
-    from ..parallel import collectives as _coll
-
-    out = {}
-    for slot, vals in ins.items():
-        cons = []
-        for a in vals:
-            if (
-                a is not None
-                and jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
-                and _coll.zero1_shardable(jnp.shape(a), mesh, axis)
-            ):
-                a = _coll.constrain_sharded(a, mesh, axis)
-            cons.append(a)
-        out[slot] = cons
-    return out
-
-
-def _zero1_constrain_outs(res, mesh, axis):
-    """ZeRO-1 output constraints: ParamOut is constrained back to replicated
-    (GSPMD → all-gather, overlappable with the rest of the step), every other
-    shardable state output (moments) STAYS sharded — that is the 1/dp
-    optimizer-state memory and HBM-traffic win."""
-    from ..parallel import collectives as _coll
-
-    out = {}
-    for slot, vals in res.items():
-        cons = []
-        for v in vals:
-            if v is not None and jnp.issubdtype(
-                jnp.asarray(v).dtype, jnp.floating
-            ):
-                if slot == "ParamOut":
-                    if _coll.zero1_shardable(jnp.shape(v), mesh, axis):
-                        # pin the updated param to the sharded layout FIRST:
-                        # without it the partitioner may push the replicated
-                        # constraint through the update arithmetic and gather
-                        # every operand separately (observed on the CPU
-                        # partitioner: p and lr·v each all-gathered, 2x the
-                        # wire bytes); sharded-then-replicated makes the
-                        # update compute on the 1/dp shard and the reshard a
-                        # single all-gather
-                        v = _coll.constrain_sharded(v, mesh, axis)
-                    v = _coll.constrain_replicated(v, mesh)
-                elif _coll.zero1_shardable(jnp.shape(v), mesh, axis):
-                    v = _coll.constrain_sharded(v, mesh, axis)
-            cons.append(v)
-        out[slot] = cons
-    return out
-
-
 def _opt_f32(fn):
     """Optimizer-lowering dtype fidelity: compute the update in f32 (bf16
     grads upcast; master states already f32 under the train-mode
@@ -1558,12 +1491,16 @@ def _opt_f32(fn):
 
     @functools.wraps(fn)
     def wrapped(ctx, ins, attrs):
-        z1_mesh, z1_axis = _zero1_mesh(ctx)
-        if z1_mesh is not None:
-            # ZeRO-1 tier: reduce-scatter the grad, slice param + moments to
-            # this rank's 1/dp shard BEFORE the f32 upcast (the wire carries
-            # the grad's native dtype; the upcast then touches only the shard)
-            ins = _zero1_constrain_ins(ins, z1_mesh, z1_axis)
+        from ..parallel import sharding_rules as _sr
+
+        # storage-layout constraints (parallel/sharding_rules): rule-sharded
+        # params (FSDP/TP) pin param+grad+moments to the declared spec; else
+        # the ZeRO-1 tier reduce-scatters the grad and slices param+moments
+        # to this rank's 1/dp shard. Either way BEFORE the f32 upcast (the
+        # wire carries the grad's native dtype; the upcast then touches only
+        # the local shard).
+        raw_ins = ins
+        ins = _sr.opt_constrain_ins(ctx, ins)
         orig_dt = {}
         ins32 = {}
         for slot, vals in ins.items():
@@ -1594,11 +1531,11 @@ def _opt_f32(fn):
                 else:
                     down.append(v)
             out[slot] = down
-        if z1_mesh is not None:
-            # all-gather the updated param back to every rank; moments stay
-            # sharded (stored 1/dp via the executor's state shardings)
-            out = _zero1_constrain_outs(out, z1_mesh, z1_axis)
-        return out
+        # rule-sharded: outputs stay in the storage spec (params live
+        # sharded, all-gather-on-use). ZeRO-1: ParamOut all-gathers back to
+        # every rank; moments stay sharded (stored 1/dp via the executor's
+        # state shardings).
+        return _sr.opt_constrain_outs(ctx, out, raw_ins)
 
     return wrapped
 

@@ -1325,12 +1325,13 @@ def fused_layer_norm_grad(x2, scale, mean, var, dy2, eps, *, interpret=None):
 _ADAM_CHUNK_ROWS = 256  # 256x128 = 32k elements per grid step
 
 
-def adam_path_taken(n_params, zero1=False):
+def adam_path_taken(n_params, zero1=False, sharded=False):
     """Mirror of the fused multi-tensor-Adam dispatch decision: the kernel is
     total over shapes (params are chunk-padded), so the only declines are a
-    degenerate group and the ZeRO-1 tier, whose per-param GSPMD sharding
-    constraints (core_ops._opt_f32) the flattened kernel cannot express."""
-    return n_params >= 2 and not zero1
+    degenerate group and the sharded tiers — ZeRO-1 and rule-sharded
+    (FSDP/TP) params — whose per-param GSPMD sharding constraints
+    (core_ops._opt_f32) the flattened kernel cannot express."""
+    return n_params >= 2 and not zero1 and not sharded
 
 
 def _multi_adam_kernel(c2p_ref, lrt_ref, p_ref, g_ref, m1_ref, m2_ref,
@@ -1434,6 +1435,23 @@ class _Shape2:
         self.ndim = len(self.shape)
 
 
+def _rules_sharded(ctx, ops):
+    """True when the declarative rule engine (ctx.sharding, a
+    parallel.sharding_rules.Resolver) places any of the run's operands or
+    results on this mesh. The tiled/flattened kernels assume whole local
+    tensors — a tp-sharded weight or fsdp-sharded param would be gathered
+    around an opaque pallas_call, defeating the placement — so tagged runs
+    decline to per-op lowering, where GSPMD partitions op by op."""
+    sharding = getattr(ctx, "sharding", None)
+    if sharding is None:
+        return False
+    for op in ops:
+        for name in list(op.input_arg_names) + list(op.output_arg_names):
+            if name and sharding.rule_spec(name) is not None:
+                return True
+    return False
+
+
 def _gemm_chain_views(prod, x, w):
     """2-D (m,k)/(k,n) views of the producer's operands plus the full output
     shape, or None when the op form is outside the kernel's contract."""
@@ -1473,6 +1491,8 @@ def _fused_gemm_epilogue(ctx, ops, env):
     and the add's Out is the kernel's exact pre-activation z (gelu_grad's
     replay input)."""
     if len(ops) not in (2, 3) or ops[0].type not in ("mul", "matmul"):
+        return False
+    if _rules_sharded(ctx, ops):
         return False
     prod, add = ops[0], ops[1]
     act_op = ops[2] if len(ops) == 3 else None
@@ -1533,6 +1553,8 @@ def _fused_layer_norm(ctx, ops, env):
     ln = ops[-1]
     if ln.type != "layer_norm" or len(ops) > 2:
         return False
+    if _rules_sharded(ctx, ops):
+        return False
     add = ops[0] if len(ops) == 2 else None
     if add is not None:
         if (
@@ -1582,6 +1604,8 @@ def _fused_layer_norm_grad(ctx, ops, env):
     vjp-replay fallback handles that exotic case."""
     if len(ops) != 1 or ops[0].type != "layer_norm_grad":
         return False
+    if _rules_sharded(ctx, ops):
+        return False
     op = ops[0]
     ins = gather_op_inputs(op, env)
     if (
@@ -1622,8 +1646,11 @@ def _fused_multi_adam(ctx, ops, env):
     computed OUTSIDE the kernel with the exact _adam expressions, so the
     fused update is bit-identical to the per-param f32 chain. The ZeRO-1
     tier declines: _opt_f32's per-param GSPMD reduce-scatter/all-gather
-    constraints don't survive flattening."""
+    constraints don't survive flattening. Likewise rule-sharded (FSDP/TP)
+    params — their storage layouts are per-tensor."""
     if ctx.zero1_axis is not None and ctx.mesh is not None:
+        return False
+    if _rules_sharded(ctx, ops):
         return False
     if len(ops) < 2 or any(op.type != "adam" for op in ops):
         return False

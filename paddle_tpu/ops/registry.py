@@ -138,13 +138,25 @@ class LowerCtx:
     gradient to a sharded layout (GSPMD → reduce-scatter), update the 1/dp
     param+moment shard locally, and constrain ParamOut back to replicated
     (→ all-gather). Set by _CompiledBlock when the ParallelExecutor build
-    strategy asks for ReduceStrategy.Reduce."""
+    strategy asks for ReduceStrategy.Reduce.
 
-    def __init__(self, key, is_test=False, mesh=None, zero1_axis=None):
+    sharding (a parallel.sharding_rules.Resolver, or None) is the
+    declarative rule engine bound to this trace's mesh: optimizer lowerings
+    consult it for the parameter's storage layout (FSDP/TP take precedence
+    over the zero1 tier per param), fused Pallas lowerings decline when it
+    shards their tile dims, and _lower_one constrains rule-matched op
+    outputs. `op` is the framework Operator currently being lowered (set by
+    _lower_one; lowerings only see traced values, so the op is the only
+    handle back to variable NAMES)."""
+
+    def __init__(self, key, is_test=False, mesh=None, zero1_axis=None,
+                 sharding=None):
         self.key = key
         self.is_test = is_test
         self.mesh = mesh
         self.zero1_axis = zero1_axis
+        self.sharding = sharding
+        self.op = None
 
     def next_rng(self):
         self.key, sub = jax.random.split(self.key)
@@ -306,13 +318,19 @@ def _lower_one(ctx, op, env):
     # distinguishes op INSTANCES (profiler._hlo_op_attribution); the
     # type-level parse skips it, so device_op_profile is unchanged.
     out_scope = op_output_scope(op)
+    ctx.op = op  # name handle for sharding-aware lowerings (LowerCtx doc)
     with jax.named_scope(op.type):
         if out_scope is None:
             outs = opdef.lower(ctx, ins, op.attrs)
         else:
             with jax.named_scope(out_scope):
                 outs = opdef.lower(ctx, ins, op.attrs)
+    ctx.op = None
     scatter_op_outputs(op, outs, env)
+    if ctx.sharding is not None:
+        # rule-matched outputs (params written back, annotated activations)
+        # get their declared placement pinned right where they materialize
+        ctx.sharding.constrain_outputs(op, env)
 
 
 def _lower_pallas_run(ctx, run, env):

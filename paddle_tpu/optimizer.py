@@ -56,8 +56,15 @@ def _is_selected_rows(grad):
 
 def _param_shard_axis(param):
     """Mesh axis the param is row-sharded over ('' when unsharded) — forwarded
-    to the sparse update op so it shard_maps the scatter per-rank."""
+    to the sparse update op so it shard_maps the scatter per-rank. Reads the
+    legacy per-var attr first, then the program's declarative sharding rules
+    (parallel.sharding_rules — where the embedding engine registers its
+    `ep` layout)."""
     spec = getattr(param, "sharding_spec", None)
+    if not spec:
+        rules = getattr(param.block.program, "_sharding_rules", None)
+        if rules is not None:
+            spec = rules.match(param.name)
     if spec:
         first = spec[0]
         if isinstance(first, (tuple, list)):

@@ -5,7 +5,10 @@ SSA graphs + NCCL, gRPC parameter servers, distributed lookup tables — maps
 here onto jax.sharding over a device Mesh:
 
 - data parallel (dp): batch-sharded feeds, replicated params (parallel_executor.py)
-- tensor parallel (tp): parameter PartitionSpecs via shard_parameter
+- fully-sharded data parallel (fsdp): params+grads+moments sharded over the
+  fsdp axis with all-gather-on-use, declared via sharding_rules
+- tensor parallel (tp): parameter PartitionSpecs via sharding_rules
+  (declarative regex -> spec engine) or shard_parameter (per-var attr)
 - sequence/context parallel (sp): ring attention over ICI (ring_attention.py)
 - embedding parallel (ep): row-sharded tables with psum combine (sharded_embedding)
 - multi-host: jax.distributed over DCN (multihost.py), replacing the
@@ -21,8 +24,10 @@ from .pipeline import (
     pipeline_fwd_spmd,
 )
 from .ring_attention import ring_attention
+from .sharding_rules import ShardingRules, SpecLayout, program_rules
 from . import collectives
 from . import partition
+from . import sharding_rules
 
 __all__ = [
     "gpipe",
@@ -36,6 +41,10 @@ __all__ = [
     "ring_attention",
     "collectives",
     "shard_parameter",
+    "sharding_rules",
+    "ShardingRules",
+    "SpecLayout",
+    "program_rules",
 ]
 
 

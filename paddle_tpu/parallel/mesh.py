@@ -14,8 +14,10 @@ class MeshConfig:
     """Named mesh-axis sizes. size=-1 on one axis means 'all remaining
     devices'."""
 
-    def __init__(self, dp=-1, tp=1, sp=1, ep=1, pp=1):
-        self.axes = {"dp": dp, "tp": tp, "sp": sp, "ep": ep, "pp": pp}
+    def __init__(self, dp=-1, fsdp=1, tp=1, sp=1, ep=1, pp=1):
+        self.axes = {
+            "dp": dp, "fsdp": fsdp, "tp": tp, "sp": sp, "ep": ep, "pp": pp
+        }
 
     def resolve(self, n_devices):
         sizes = dict(self.axes)
@@ -41,7 +43,7 @@ def make_mesh(config=None, devices=None):
     devices = devices if devices is not None else jax.devices()
     config = config or MeshConfig()
     sizes = config.resolve(len(devices))
-    names = [k for k in ("dp", "tp", "sp", "ep", "pp")]
+    names = [k for k in ("dp", "fsdp", "tp", "sp", "ep", "pp")]
     shape = [sizes[k] for k in names]
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, tuple(names))

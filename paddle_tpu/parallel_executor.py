@@ -86,6 +86,16 @@ class BuildStrategy:
         # Only consulted when pass_pipeline is None — an explicit pipeline
         # always wins.
         self.fuse_kernels = False
+        # declarative placement over the dp×fsdp×tp×sp×ep×pp mesh: a
+        # parallel.ShardingRules (or an iterable of (regex, spec) pairs)
+        # mapping param/activation names -> PartitionSpec tuples, LAST match
+        # wins. Merged AFTER any program-attached rules
+        # (parallel.program_rules), so these win ties. This is how tensor
+        # parallelism and FSDP are requested — see docs/parallelism.md
+        # "Sharding rules". Requires a mesh_config naming the axes used
+        # (e.g. MeshConfig(fsdp=4, tp=2)); axes the mesh lacks degrade to
+        # replication.
+        self.sharding_rules = None
 
     def resolved_pass_pipeline(self):
         """The pipeline the executor should apply: pass_pipeline verbatim
@@ -167,8 +177,20 @@ class ParallelExecutor:
 
     @property
     def device_count(self):
-        """Number of ways the batch is split (the 'dp' axis extent)."""
-        return self._mesh.shape.get("dp", self._mesh.size)
+        """Number of ways the batch is split: dp × fsdp (FSDP shards the
+        batch too — it is data parallelism with sharded storage)."""
+        dp = self._mesh.shape.get("dp", self._mesh.size)
+        return dp * self._mesh.shape.get("fsdp", 1)
+
+    @property
+    def _data_axes(self):
+        """Mesh axes the batch dim shards over. Extent-1 axes are dropped so
+        the default Mesh(devices, ('dp',)) and fsdp-less configs keep their
+        exact old specs."""
+        axes = tuple(
+            a for a in ("dp", "fsdp") if self._mesh.shape.get(a, 1) > 1
+        )
+        return axes or ("dp",)
 
     @property
     def topology(self):
@@ -195,13 +217,14 @@ class ParallelExecutor:
         if dp <= 1:
             return
         mesh = self._mesh
+        axes = self._data_axes
         from jax.sharding import NamedSharding, PartitionSpec
 
         def shard_for(arr):
             shape = getattr(arr, "shape", None)
             if not shape or shape[0] % dp != 0:
                 return None
-            spec = PartitionSpec("dp", *([None] * (len(shape) - 1)))
+            spec = PartitionSpec(axes, *([None] * (len(shape) - 1)))
             return NamedSharding(mesh, spec)
 
         for reader in getattr(self._program, "_py_readers", []):
@@ -279,6 +302,16 @@ class ParallelExecutor:
                 "steps_per_run > 1 is not supported with pipeline "
                 "parallelism yet; run one step per call on a pp mesh"
             )
+        # declarative sharding rules: BuildStrategy's own (normalized to a
+        # ShardingRules), merged by the compiled block AFTER any
+        # program-attached rules. Both fingerprints go into the cache key —
+        # rules hang off live objects and may grow between runs.
+        from .parallel.sharding_rules import ShardingRules
+
+        bs_rules = self._build_strategy.sharding_rules
+        if bs_rules is not None and not isinstance(bs_rules, ShardingRules):
+            bs_rules = ShardingRules(bs_rules)
+        prog_rules = getattr(program, "_sharding_rules", None)
         key = (
             program._uid,
             program._version,
@@ -296,6 +329,8 @@ class ParallelExecutor:
             # toggling FLAGS_tensor_stats must recompile (executor.py key
             # carries the same term)
             _flags_opprof()["tensor_stats"],
+            bs_rules.fingerprint() if bs_rules is not None else None,
+            prog_rules.fingerprint() if prog_rules is not None else None,
         )
         compiled = self._cache.get(key)
         _obs_cache_hit = compiled is not None
@@ -316,7 +351,8 @@ class ParallelExecutor:
                 compiled = _PipelinedBlock(
                     program, block, list(feed_arrays.keys()), fetch_names,
                     self._scope, mesh=self._mesh, feed_ranks=feed_ranks,
-                    zero1_axis=zero1_axis, loss_name=self._loss_name,
+                    zero1_axis=zero1_axis, sharding_rules=bs_rules,
+                    loss_name=self._loss_name,
                     n_micro=self._exec_strategy.num_microbatches,
                     schedule=self._exec_strategy.pipeline_schedule,
                 )
@@ -324,7 +360,8 @@ class ParallelExecutor:
                 compiled = _MultiStepBlock(
                     program, block, list(feed_arrays.keys()), fetch_names,
                     self._scope, steps_per_run, mesh=self._mesh,
-                    feed_ranks=feed_ranks, zero1_axis=zero1_axis,
+                    data_axes=self._data_axes, feed_ranks=feed_ranks,
+                    zero1_axis=zero1_axis, sharding_rules=bs_rules,
                 )
             else:
                 compiled = _CompiledBlock(
@@ -334,8 +371,10 @@ class ParallelExecutor:
                     fetch_names,
                     self._scope,
                     mesh=self._mesh,
+                    data_axes=self._data_axes,
                     feed_ranks=feed_ranks,
                     zero1_axis=zero1_axis,
+                    sharding_rules=bs_rules,
                 )
             self._cache[key] = compiled
 

@@ -18,7 +18,9 @@ from paddle_tpu.parallel.sharded_embedding import sharded_embedding_lookup
 
 def test_mesh_config_resolution():
     cfg = MeshConfig(dp=-1, sp=4)
-    assert cfg.resolve(8) == {"dp": 2, "tp": 1, "sp": 4, "ep": 1, "pp": 1}
+    assert cfg.resolve(8) == {
+        "dp": 2, "fsdp": 1, "tp": 1, "sp": 4, "ep": 1, "pp": 1
+    }
     with pytest.raises(ValueError):
         MeshConfig(dp=3).resolve(8)
     with pytest.raises(ValueError):

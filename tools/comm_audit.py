@@ -1,11 +1,12 @@
 """Communication-volume audit for the multi-device lowering paths.
 
 Compiles one real training step per parallelism path (dp all-reduce,
-zero1 = ReduceStrategy.Reduce, dp x tp x sp x ep attention, dp x pp GPipe) over
-the 8-device mesh, parses every collective out of the post-optimization HLO
-(the same HloIndex machinery as tools/mfu_audit.py), and tabulates per
-collective: op kind, tensor bytes, mesh axis (recovered from replica_groups),
-count per step, and per-chip ring wire bytes.
+zero1 = ReduceStrategy.Reduce, fsdp and tp via declarative sharding rules,
+dp x tp x sp x ep attention, dp x pp GPipe) over the 8-device mesh, parses
+every collective out of the post-optimization HLO (the same HloIndex
+machinery as tools/mfu_audit.py), and tabulates per collective: op kind,
+tensor bytes, mesh axis (recovered from replica_groups), count per step, and
+per-chip ring wire bytes.
 
 Cross-check (--check, run by CI): the dp path's reduce-combined bytes must
 match the analytic gradient bytes, and the zero1 path must additionally
@@ -14,6 +15,13 @@ compares COMBINED TENSOR bytes, not instruction opcodes, because backends
 spell the same semantics differently (the CPU partitioner emits the zero1
 reduce-scatter as all-reduce + dynamic-slice; TPU emits a real
 reduce-scatter) — the reduced bytes are invariant under that choice.
+
+The sharding-rule paths (BuildStrategy.sharding_rules) check the wire
+signatures of the two strategies the rule engine adds: the fsdp step must
+all-gather each sharded parameter once per step and must not combine any
+gradient as a full-tensor ring (check_fsdp); the tp step's dp gradient rings
+must carry each grad at its stored shard size and its only tp collective is
+the row-parallel activation all-reduce (check_tp) — all within 10%.
 
 Ring wire formulas (per chip, group size p, full tensor B bytes):
     all-reduce      2(p-1)/p * B
@@ -208,10 +216,29 @@ def _grad_bytes(program):
     return total
 
 
-def _shardable_param_bytes(program, mesh, axis="dp"):
+def _shardable_param_bytes(program, mesh, axis="dp", rules=None):
+    """Analytic f32 bytes of the trainable parameters that end up sharded.
+
+    Attribute mode (rules=None): the ZeRO-1 criterion — dim 0 divisible by
+    `axis`'s extent (collectives.zero1_shardable).
+
+    Rules mode: parameters whose declarative sharding rule survives pruning
+    on this mesh (parallel.sharding_rules.Resolver — same resolver the
+    executor uses, so divisibility degradation matches the compiled step).
+    """
+    total = 0
+    if rules is not None:
+        from paddle_tpu.parallel.sharding_rules import Resolver
+
+        res = Resolver(mesh, rules=rules)
+        for p in program.global_block().all_parameters():
+            if getattr(p, "trainable", True) and res.rule_spec(
+                p.name, tuple(p.shape)
+            ) is not None:
+                total += int(np.prod(p.shape)) * 4
+        return total
     from paddle_tpu.parallel.collectives import zero1_shardable
 
-    total = 0
     for p in program.global_block().all_parameters():
         if getattr(p, "trainable", True) and zero1_shardable(p.shape, mesh, axis):
             total += int(np.prod(p.shape)) * 4
@@ -249,6 +276,62 @@ def _mlp_step_hlo(reduce_strategy):
         hlo = pe.compiled_hlo()
         mesh = pe._mesh
     return hlo, mesh, main
+
+
+def _rules_mlp_step_hlo(mesh_kwargs, rules):
+    """Compile+run one MLP Adam step with declarative sharding rules
+    (BuildStrategy.sharding_rules, the PR-13 engine) on the given mesh;
+    return (hlo_text, mesh, main_program)."""
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.parallel import MeshConfig
+    from paddle_tpu.parallel_executor import BuildStrategy
+
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        loss = _build_mlp()
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    strat = BuildStrategy()
+    strat.sharding_rules = rules
+    n = jax.device_count()
+    rng = np.random.RandomState(0)
+    x = rng.randn(4 * n, 64).astype("float32")
+    y = rng.randint(0, 8, (4 * n, 1)).astype("int64")
+    scope = Scope(seed=0)
+    with scope_guard(scope):
+        fluid.Executor().run(startup)
+        pe = fluid.ParallelExecutor(
+            loss_name=loss.name, main_program=main, build_strategy=strat,
+            scope=scope, mesh_config=MeshConfig(**mesh_kwargs),
+        )
+        pe.run(fetch_list=[loss.name], feed={"x": x, "y": y})
+        hlo = pe.compiled_hlo()
+        mesh = pe._mesh
+    return hlo, mesh, main
+
+
+# fc params in _build_mlp (unique_name.guard): fc_0.w_0 (64,128),
+# fc_0.b_0 (128,), fc_1.w_0 (128,8), fc_1.b_0 (8,)
+_FSDP_RULES = [(r"^fc_\d+\.(w|b)_0$", ("fsdp",))]
+_TP_RULES = [
+    (r"^fc_0\.w_0$", (None, "tp")),   # column-parallel: hidden over tp
+    (r"^fc_0\.b_0$", ("tp",)),        # bias follows its weight's out dim
+    (r"^fc_1\.w_0$", ("tp", None)),   # row-parallel: reduce lands after fc_1
+]
+
+
+def _fsdp_step_hlo():
+    """dp2 x fsdp4 MLP step: every parameter (and its Adam moments, via the
+    resolver's accumulator alias) stored 1/4-sharded over fsdp."""
+    return _rules_mlp_step_hlo(dict(dp=2, fsdp=4), _FSDP_RULES)
+
+
+def _tp_step_hlo():
+    """dp4 x tp2 MLP step: Megatron column/row pair on the two fc layers."""
+    return _rules_mlp_step_hlo(dict(dp=4, tp=2), _TP_RULES)
 
 
 def _attention_step_hlo():
@@ -363,6 +446,49 @@ def _gpipe_step_hlo():
 # ---------------------------------------------------------------------------
 
 
+def _rule_resolver(mesh, rules):
+    from paddle_tpu.parallel.sharding_rules import Resolver, ShardingRules
+
+    if rules is not None and not isinstance(rules, ShardingRules):
+        rules = ShardingRules(rules)
+    return Resolver(mesh, rules=rules)
+
+
+def _rule_sharded_param_sizes(program, mesh, rules):
+    """f32 byte size of each trainable parameter whose sharding rule
+    survives pruning on this mesh (the tensors FSDP/TP actually shard)."""
+    res = _rule_resolver(mesh, rules)
+    return [
+        int(np.prod(p.shape)) * 4
+        for p in program.global_block().all_parameters()
+        if getattr(p, "trainable", True)
+        and res.rule_spec(p.name, tuple(p.shape)) is not None
+    ]
+
+
+def _dp_grad_ring_bytes(program, mesh, rules):
+    """Analytic bytes the dp gradient all-reduces carry: each grad rides the
+    ring at its parameter's STORED shard size — a rule-sharded grad is
+    constrained to the param's layout before the optimizer
+    (sharding_rules.opt_constrain_ins), so its dp ring moves 1/shards of
+    the tensor."""
+    res = _rule_resolver(mesh, rules)
+    total = 0
+    for p in program.global_block().all_parameters():
+        if not getattr(p, "trainable", True):
+            continue
+        spec = res.rule_spec(p.name, tuple(p.shape))
+        factor = 1
+        for entry in spec or ():
+            axes = entry if isinstance(entry, tuple) else (
+                (entry,) if entry else ()
+            )
+            for a in axes:
+                factor *= mesh.shape.get(a, 1)
+        total += int(np.prod(p.shape)) * 4 // factor
+    return total
+
+
 def check_dp(audit, grad_bytes, tol=0.10):
     """The dp step must reduce-combine exactly the gradients (+ the scalar
     loss fetch, <<1%)."""
@@ -392,6 +518,70 @@ def check_zero1(audit, grad_bytes, shardable_param_bytes, tol=0.10):
         % (gathered, shardable_param_bytes, 100 * g_err)
     )
     return r_err, g_err
+
+
+def check_tp(audit, dp_ring_bytes, act_ar_bytes, tol=0.10):
+    """The tp (Megatron column/row pair) step must all-reduce (a) every
+    gradient over dp at its stored shard size, and (b) exactly one
+    activation over tp: the row-parallel matmul's partial-sum output,
+    (batch/dp) x classes f32. Backward adds NO tp collective here because
+    the first operand (the data feed) takes no gradient — the dx
+    all-reduce Megatron pays per layer only appears between stacked pairs."""
+    dp_reduced = sum(
+        r["tensor_bytes"] * r["count"]
+        for r in audit["collectives"]
+        if r["op"] in ("all-reduce", "reduce-scatter") and r["axis"] == "dp"
+    )
+    tp_reduced = sum(
+        r["tensor_bytes"] * r["count"]
+        for r in audit["collectives"]
+        if r["op"] in ("all-reduce", "reduce-scatter") and r["axis"] == "tp"
+    )
+    dp_err = abs(dp_reduced - dp_ring_bytes) / dp_ring_bytes
+    tp_err = abs(tp_reduced - act_ar_bytes) / act_ar_bytes
+    assert dp_err <= tol, (
+        "tp path dp-reduced bytes %d vs analytic grad-shard bytes %d "
+        "(%.1f%% off)" % (dp_reduced, dp_ring_bytes, 100 * dp_err)
+    )
+    assert tp_err <= tol, (
+        "tp path tp-reduced bytes %d vs analytic row-parallel activation "
+        "bytes %d (%.1f%% off)" % (tp_reduced, act_ar_bytes, 100 * tp_err)
+    )
+    return dp_err, tp_err
+
+
+def check_fsdp(audit, sharded_param_sizes, grad_bytes, tol=0.10):
+    """The fsdp step must all-gather each rule-sharded parameter over the
+    fsdp axis exactly once per step (weight streaming; a second gather of
+    the same tensor is the double-gather regression the ZeRO-1 path also
+    guards against), and must NEVER combine gradients as full-tensor rings
+    — FSDP's grad combine happens at shard granularity, so reduced bytes
+    stay far below the replicated-grad total. The gather check matches
+    all-gathers BY TENSOR SIZE against the sharded parameter list; the
+    partitioner's discretionary activation gathers (it may rematerialize a
+    batch-sharded activation instead of reducing a grad — observed on the
+    CPU partitioner) don't collide with parameter sizes in this model."""
+    sizes = set(sharded_param_sizes)
+    param_bytes = sum(sharded_param_sizes)
+    gathered = sum(
+        r["tensor_bytes"] * r["count"]
+        for r in audit["collectives"]
+        if r["op"] == "all-gather"
+        and r["axis"] == "fsdp"
+        and r["tensor_bytes"] in sizes
+    )
+    g_err = abs(gathered - param_bytes) / param_bytes
+    assert g_err <= tol, (
+        "fsdp param-gather bytes %d vs sharded param bytes %d (%.1f%% off)"
+        % (gathered, param_bytes, 100 * g_err)
+    )
+    reduced = audit["totals"]["reduced_bytes"]
+    assert reduced < grad_bytes / 2, (
+        "fsdp path reduced %d bytes — full-tensor gradient rings appeared "
+        "(grads should combine at 1/fsdp shard granularity, << %d)"
+        % (reduced, grad_bytes)
+    )
+    return g_err
 
 
 def analytic_wire(grad_bytes, shardable_param_bytes, p):
@@ -549,10 +739,25 @@ def main():
     shardable = _shardable_param_bytes(prog, mesh_dp)
     dp_err = check_dp(dp_audit, grad_bytes)
     z1_r_err, z1_g_err = check_zero1(z1_audit, grad_bytes, shardable)
+
+    # -- declarative sharding rules (PR 13): fsdp and tp paths --------------
+    hlo_f, mesh_f, prog_f = _fsdp_step_hlo()
+    hlo_t, mesh_t, prog_t = _tp_step_hlo()
+    f_audit = audit_hlo(hlo_f, mesh_f)
+    t_audit = audit_hlo(hlo_t, mesh_t)
+    f_sizes = _rule_sharded_param_sizes(prog_f, mesh_f, _FSDP_RULES)
+    f_err = check_fsdp(f_audit, f_sizes, _grad_bytes(prog_f))
+    t_dp_bytes = _dp_grad_ring_bytes(prog_t, mesh_t, _TP_RULES)
+    # row-parallel forward all-reduce: the logits partial-sum, per dp shard
+    t_act_bytes = 4 * n // mesh_t.shape["dp"] * 8 * 4  # batch/dp x classes f32
+    t_dp_err, t_tp_err = check_tp(t_audit, t_dp_bytes, t_act_bytes)
+
     print(
         "check ok on %d devices: dp reduced within %.2f%%, zero1 reduced "
-        "within %.2f%% / gathered within %.2f%% of analytic"
-        % (n, 100 * dp_err, 100 * z1_r_err, 100 * z1_g_err)
+        "within %.2f%% / gathered within %.2f%%, fsdp param-gather within "
+        "%.2f%%, tp dp-ring within %.2f%% / tp-act within %.2f%% of analytic"
+        % (n, 100 * dp_err, 100 * z1_r_err, 100 * z1_g_err, 100 * f_err,
+           100 * t_dp_err, 100 * t_tp_err)
     )
     if args.check:
         return
@@ -565,11 +770,35 @@ def main():
             shardable_param_bytes=shardable,
             **analytic_wire(grad_bytes, shardable, mesh_dp.shape["dp"]),
         ),
-        "paths": {"dp_allreduce": dp_audit, "zero1": z1_audit},
+        "paths": {
+            "dp_allreduce": dp_audit,
+            "zero1": z1_audit,
+            "fsdp": f_audit,
+            "tp": t_audit,
+        },
+        "sharding_rules": {
+            "fsdp": {
+                "mesh": "dp2 x fsdp4",
+                "rules": [[p, list(s)] for p, s in _FSDP_RULES],
+                "sharded_param_bytes": sum(f_sizes),
+                "analytic_param_gather_wire_per_chip": sum(
+                    3 * b // 4 for b in f_sizes
+                ),
+            },
+            "tp": {
+                "mesh": "dp4 x tp2",
+                "rules": [[p, list(s)] for p, s in _TP_RULES],
+                "dp_grad_ring_bytes": t_dp_bytes,
+                "rowparallel_act_allreduce_bytes": t_act_bytes,
+            },
+        },
         "check_errors_pct": {
             "dp_reduced": round(100 * dp_err, 2),
             "zero1_reduced": round(100 * z1_r_err, 2),
             "zero1_gathered": round(100 * z1_g_err, 2),
+            "fsdp_param_gather": round(100 * f_err, 2),
+            "tp_dp_ring": round(100 * t_dp_err, 2),
+            "tp_act_allreduce": round(100 * t_tp_err, 2),
         },
     }
 
