@@ -1,0 +1,54 @@
+"""Whole-program static analysis + the fluidlint checker suite.
+
+The reference framework rejects malformed programs at build time with ~400
+per-op InferShape functions; this package is the port's equivalent gate,
+run over WHOLE programs at the compile seams instead of per append_op:
+
+- `analyze_program` (dataflow.py): forward abstract interpretation over the
+  Graph IR — shape (with symbolic dynamic dims), dtype, LoD, tensor-array
+  kinds, and sharding specs — plus backward liveness.
+- `lint_program` / `CHECKERS` (checkers.py): the ~8 registered fluidlint
+  checkers (donation-alias, sharding-rules, dtype-boundary, determinism,
+  dead-write, write-never-read, fetch-unwritten, cf-capture).
+- `static_verify` / `maybe_static_verify` / `verify_graph` (verify.py): the
+  FLAGS_static_verify gate the executors, serving loaders, and the
+  PassManager call.
+
+CLI: tools/fluidlint.py. Docs: docs/static_analysis.md.
+"""
+
+from .checkers import (
+    CHECKERS,
+    STRUCTURAL_CHECKS,
+    Finding,
+    lint_program,
+    register_checker,
+    render_findings,
+    run_checkers,
+)
+from .dataflow import Analysis, OpRecord, SymDim, VarFact, analyze_program
+from .verify import (
+    StaticVerifyError,
+    maybe_static_verify,
+    static_verify,
+    verify_graph,
+)
+
+__all__ = [
+    "Analysis",
+    "CHECKERS",
+    "Finding",
+    "OpRecord",
+    "STRUCTURAL_CHECKS",
+    "StaticVerifyError",
+    "SymDim",
+    "VarFact",
+    "analyze_program",
+    "lint_program",
+    "maybe_static_verify",
+    "register_checker",
+    "render_findings",
+    "run_checkers",
+    "static_verify",
+    "verify_graph",
+]

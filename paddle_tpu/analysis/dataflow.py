@@ -1,0 +1,488 @@
+"""Forward abstract interpretation + backward liveness over the Graph IR.
+
+The reference framework proves program well-formedness with ~400 hand-written
+per-op InferShape/InferVarType functions run at build time (operator.cc:705).
+This port derives shapes by tracing (ops/registry.infer_shape), which is
+always consistent with execution but only fires op-locally at append_op time
+— nothing re-checks a WHOLE program after passes rewrote it, after a model
+was loaded from disk, or before the serving runtime commits to an expensive
+XLA trace. This module is that whole-program pass:
+
+- `analyze_program` walks every block in execution order, propagating a
+  `VarFact` lattice per variable: shape (ints plus `SymDim` symbols for
+  dynamic axes), dtype, LoD level, kind (tensor / tensor-array / opaque),
+  and the sharding spec the PR 13 Resolver would assign. Per-op transfer
+  functions come from the registry: an op with an `abstract_eval` hook
+  (OpDef) is interpreted by the hook — control-flow ops recurse into their
+  sub-blocks with real entry facts — and every other lowering is abstracted
+  with `jax.eval_shape`, exactly the machinery ops/registry.infer_shape
+  uses, so the static facts agree with traced avals by construction.
+- `Analysis.live_after` is the backward pass: per-op live-variable sets over
+  the graph's def-use edges (sub-block-aware — a control-flow op reads every
+  parent var its sub-block tree touches), feeding the dead-write and
+  write-never-read checkers (analysis/checkers.py).
+
+The lattice is deliberately shallow: a fact is either precise or `opaque`
+(unknown), and transfer failures degrade to opaque + a recorded note instead
+of raising — the analyzer must never reject a program the executor would
+run. Checkers (analysis/checkers.py) turn facts into findings; the
+flag-gated compile gate lives in analysis/verify.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .. import framework
+from ..ops import registry
+
+__all__ = [
+    "SymDim",
+    "VarFact",
+    "OpRecord",
+    "Analysis",
+    "analyze_program",
+]
+
+# Symbolic-extent sentinels substituted for dynamic (-1) dims during
+# jax.eval_shape. The base matches ops/registry._DYN_SENTINEL; each further
+# symbol steps down by a prime stride so arithmetic on one symbol (conv
+# windows, pad, slice) does not land on a neighboring symbol's sentinel.
+# Collisions would only mislabel an analysis fact, never execution — the
+# executors re-trace with concrete feed shapes (same caveat as the registry).
+_SYM_BASE = 8191
+_SYM_STRIDE = 101
+_SYM_MAX = 40
+
+
+class SymDim:
+    """One symbolic dynamic extent (a -1 dim). Identity is the symbol: two
+    facts share a SymDim object iff the analyzer proved the extents equal
+    (same feed dim, or propagated through a transfer function)."""
+
+    __slots__ = ("name", "sentinel")
+
+    def __init__(self, name, sentinel):
+        self.name = name
+        self.sentinel = sentinel
+
+    def __repr__(self):
+        return "?%s" % self.name
+
+
+class VarFact:
+    """The abstract value of one variable name at one program point.
+
+    kind: "tensor" (shape/dtype meaningful), "array" (a tensor-array: shape
+    is the time-major BUFFER shape [cap, ...]), or "opaque" (unknown —
+    the bottom of the lattice; transfer functions degrade to it rather
+    than guess). shape entries are ints or SymDim; shape None means even
+    the rank is unknown. spec is the sharding-rule layout the Resolver
+    assigns (None replicated / no resolver bound). writer is the
+    producing (block_idx, op_index) or None for external values."""
+
+    __slots__ = ("shape", "dtype", "lod_level", "kind", "spec", "writer")
+
+    def __init__(self, shape=None, dtype=None, lod_level=0, kind="tensor",
+                 spec=None, writer=None):
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lod_level = lod_level
+        self.kind = kind
+        self.spec = spec
+        self.writer = writer
+
+    @property
+    def known(self):
+        """Precise enough to abstract into a ShapeDtypeStruct."""
+        return (
+            self.kind == "tensor"
+            and self.shape is not None
+            and self.dtype is not None
+        )
+
+    def concrete_shape(self):
+        """Shape with SymDims replaced by -1 (the Program metadata idiom)."""
+        if self.shape is None:
+            return None
+        return tuple(-1 if isinstance(d, SymDim) else int(d) for d in self.shape)
+
+    def __repr__(self):
+        if self.kind == "opaque":
+            return "VarFact(opaque)"
+        return "VarFact(%s%s %s)" % (
+            "array " if self.kind == "array" else "",
+            list(self.shape) if self.shape is not None else "?",
+            self.dtype,
+        )
+
+
+class OpRecord:
+    """One interpreted op: the facts flowing in and out, plus a note when
+    the transfer degraded ("host", "unregistered", "opaque-inputs",
+    "skip", or "transfer-error: ...")."""
+
+    __slots__ = ("op", "block_idx", "index", "opdef", "ins", "outs", "note")
+
+    def __init__(self, op, block_idx, index, opdef, ins, outs, note=None):
+        self.op = op
+        self.block_idx = block_idx
+        self.index = index
+        self.opdef = opdef
+        self.ins = ins
+        self.outs = outs
+        self.note = note
+
+    def display(self):
+        from ..observability.opprof import op_display_name
+
+        return op_display_name(self.op)
+
+
+class Analysis:
+    """The analyzer's report: final facts, per-op records, analyzer-level
+    problems, and the backward-liveness query the checkers consume."""
+
+    def __init__(self, program, graph, feed_names, fetch_names, scope, mesh,
+                 resolver, mode):
+        self.program = program
+        self.graph = graph
+        self.feed_names = tuple(feed_names)
+        self.fetch_names = tuple(fetch_names)
+        self.scope = scope
+        self.mesh = mesh
+        self.resolver = resolver
+        self.mode = mode
+        self.facts = {}  # block-0 final env: name -> VarFact
+        self.records = []  # [OpRecord] in interpretation order (all blocks)
+        self.problems = []  # [(block_idx, op_index, op, message)]
+        self.entry_origin = {}  # external name -> "feed" | "scope" | "declared"
+        self._live = {}  # block_idx -> [set(name) live AFTER each op]
+
+    def problem(self, block_idx, op_index, op, message):
+        self.problems.append((block_idx, op_index, op, message))
+
+    def records_in_block(self, block_idx):
+        return [r for r in self.records if r.block_idx == block_idx]
+
+    def live_after(self, block_idx=0):
+        """Backward liveness over the block's ops: live_after[i] is the set
+        of names read by any LATER op in the block (def-use through the
+        graph's sub-block-aware edges) or live out of the block (fetched,
+        persistable, scope-resident, or referenced below block 0)."""
+        cached = self._live.get(block_idx)
+        if cached is not None:
+            return cached
+        nodes = self.graph.op_nodes(block_idx)
+        roots = set(self.fetch_names)
+        sub_names = self.graph.subblock_reachable_names()
+        for node in nodes:
+            for vn in node.inputs + node.outputs:
+                if vn.persistable or vn.name in sub_names:
+                    roots.add(vn.name)
+                elif self.scope is not None and self.scope.find_var(vn.name) is not None:
+                    roots.add(vn.name)
+        live = set(roots)
+        out = [None] * len(nodes)
+        for i in range(len(nodes) - 1, -1, -1):
+            node = nodes[i]
+            out[i] = set(live)
+            # standard kill-then-gen: writes are whole-value rebinds in the
+            # functional lowering, so a write kills even a root — a fetched
+            # or persistable var overwritten before any read is dead there.
+            # Read-modify-write ops (sgd's Param/ParamOut) stay live via the
+            # gen of their own input below.
+            live -= {vn.name for vn in node.outputs}
+            live |= {vn.name for vn in node.inputs}
+        self._live[block_idx] = out
+        return out
+
+
+class _AbstractCtx:
+    """What an OpDef.abstract_eval hook sees: sub-block recursion, symbol
+    interning, and a problem sink (ops/control_flow_ops.py registers hooks
+    for while/cond/recurrent and the tensor-array family)."""
+
+    def __init__(self, analyzer, block_idx, op_index, op):
+        self._analyzer = analyzer
+        self.block_idx = block_idx
+        self.op_index = op_index
+        self.op = op
+
+    def sym(self, name):
+        return self._analyzer._sym(name)
+
+    def analyze_block(self, block, env):
+        """Interpret `block`'s ops with (and into) the given name->fact env;
+        returns the env after the last op."""
+        return self._analyzer._run_block(block, env)
+
+    def problem(self, message):
+        self._analyzer.report.problem(
+            self.block_idx, self.op_index, self.op, message
+        )
+
+    def opaque(self):
+        return VarFact(kind="opaque", writer=(self.block_idx, self.op_index))
+
+
+class _Analyzer:
+    def __init__(self, program, graph, feed_names, fetch_names, scope, mesh,
+                 resolver, mode, feed_facts=None):
+        self.program = graph.program  # analyze the graph's shadow program
+        self.graph = graph
+        self.scope = scope
+        self.resolver = resolver
+        self.feed_facts = dict(feed_facts or {})
+        self.report = Analysis(
+            program, graph, feed_names, fetch_names, scope, mesh, resolver,
+            mode,
+        )
+        self._symbols = {}  # name -> SymDim
+        self._by_sentinel = {}  # sentinel int -> SymDim
+
+    # ------------------------------------------------------------- symbols
+    def _sym(self, name):
+        s = self._symbols.get(name)
+        if s is None:
+            k = len(self._symbols)
+            sentinel = _SYM_BASE - _SYM_STRIDE * min(k, _SYM_MAX)
+            s = SymDim(name, sentinel)
+            self._symbols[name] = s
+            self._by_sentinel.setdefault(sentinel, s)
+        return s
+
+    def _shape_from_meta(self, name, shape):
+        """Program metadata shape -> fact shape; each -1 becomes the
+        per-(name, dim) symbol so distinct dynamic axes stay distinct."""
+        if shape is None:
+            return None
+        out = []
+        for i, d in enumerate(shape):
+            if d == -1:
+                # dim 0 of data vars is the batch axis; share one symbol so
+                # facts derived from different feeds stay comparable
+                key = "batch" if i == 0 else "%s.%d" % (name, i)
+                out.append(self._sym(key))
+            else:
+                out.append(int(d))
+        return tuple(out)
+
+    # ------------------------------------------------------- external facts
+    def _external_fact(self, name, block):
+        """Fact for a name read before any write: feed, scope state, or the
+        declared metadata (the _CompiledBlock classification order)."""
+        override = self.feed_facts.get(name)
+        if override is not None:
+            self.report.entry_origin.setdefault(name, "feed")
+            return override
+        if name in self.report.feed_names:
+            v = block._var_recursive(name) if block.has_var_recursive(name) else None
+            fact = self._fact_from_var(name, v)
+            self.report.entry_origin.setdefault(name, "feed")
+            return fact
+        if self.scope is not None and self.scope.find_var(name) is not None:
+            val = self.scope.vars[name]
+            shape = getattr(val, "shape", None)
+            dtype = getattr(val, "dtype", None)
+            if shape is not None and dtype is not None:
+                fact = VarFact(
+                    shape=tuple(int(d) for d in shape),
+                    dtype=framework.convert_np_dtype(dtype),
+                )
+            else:
+                fact = VarFact(kind="opaque")
+            self.report.entry_origin.setdefault(name, "scope")
+            return self._with_spec(name, fact)
+        v = block._var_recursive(name) if block.has_var_recursive(name) else None
+        self.report.entry_origin.setdefault(name, "declared")
+        return self._with_spec(name, self._fact_from_var(name, v))
+
+    def _fact_from_var(self, name, v):
+        if v is None or v.shape is None or v.dtype is None:
+            return VarFact(kind="opaque")
+        return VarFact(
+            shape=self._shape_from_meta(name, v.shape),
+            dtype=framework.convert_np_dtype(v.dtype),
+            lod_level=getattr(v, "lod_level", 0) or 0,
+        )
+
+    def _with_spec(self, name, fact):
+        if self.resolver is not None and fact.kind == "tensor":
+            try:
+                fact.spec = self.resolver.spec(name, fact.concrete_shape())
+            except Exception:
+                pass
+        return fact
+
+    # ------------------------------------------------------------ transfer
+    def _gather(self, op, env, block):
+        ins = {}
+        for slot, names in op.inputs.items():
+            if not names:
+                continue
+            row = []
+            for n in names:
+                if n == registry.EMPTY_VAR_NAME:
+                    row.append(None)
+                    continue
+                f = env.get(n)
+                if f is None:
+                    f = self._external_fact(n, block)
+                    env[n] = f
+                row.append(f)
+            ins[slot] = row
+        return ins
+
+    def _scatter(self, op, outs, env, site):
+        rec_outs = {}
+        for slot, names in op.outputs.items():
+            vals = (outs or {}).get(slot)
+            row = []
+            for i, n in enumerate(names):
+                f = vals[i] if vals is not None and i < len(vals) else None
+                if f is None:
+                    f = VarFact(kind="opaque")
+                f.writer = site
+                if n != registry.EMPTY_VAR_NAME:
+                    env[n] = self._with_spec(n, f)
+                row.append(f)
+            rec_outs[slot] = row
+        return rec_outs
+
+    def _default_transfer(self, op, opdef, ins):
+        """Abstract the lowering with jax.eval_shape, the exact machinery of
+        ops/registry.infer_shape — SymDims ride through as sentinel extents
+        and map back on output."""
+        abstract_ins = {}
+        for slot, facts in ins.items():
+            row = []
+            for f in facts:
+                if f is None:
+                    row.append(None)
+                    continue
+                if not f.known:
+                    return None, "opaque-inputs"
+                shape = tuple(
+                    d.sentinel if isinstance(d, SymDim) else int(d)
+                    for d in f.shape
+                )
+                row.append(jax.ShapeDtypeStruct(shape, jnp.dtype(f.dtype)))
+            abstract_ins[slot] = row
+
+        attrs = dict(op.attrs)
+
+        def run(a_ins):
+            c = registry.LowerCtx(
+                jax.random.key(0), is_test=bool(attrs.get("is_test", False))
+            )
+            return opdef.lower(c, a_ins, attrs)
+
+        try:
+            outs = jax.eval_shape(run, abstract_ins)
+        except Exception as e:
+            return None, "transfer-error: %s" % (str(e).splitlines() or [""])[0]
+
+        facts = {}
+        for slot, vals in outs.items():
+            row = []
+            for aval in vals:
+                if aval is None or not hasattr(aval, "shape"):
+                    row.append(None)
+                    continue
+                shape = tuple(
+                    self._by_sentinel.get(int(d), int(d)) for d in aval.shape
+                )
+                row.append(
+                    VarFact(
+                        shape=shape,
+                        dtype=framework.convert_np_dtype(aval.dtype),
+                    )
+                )
+            facts[slot] = row
+        return facts, None
+
+    # ----------------------------------------------------------- main walk
+    def _run_block(self, block, env):
+        for index, op in enumerate(block.ops):
+            site = (block.idx, index)
+            try:
+                opdef = registry.get(op.type)
+            except KeyError:
+                opdef = None
+            ins = self._gather(op, env, block)
+            note = None
+            outs = None
+            if opdef is None:
+                note = "unregistered"
+            elif opdef.skip_exec:
+                note = "skip"
+            elif opdef.abstract_eval is not None:
+                actx = _AbstractCtx(self, block.idx, index, op)
+                try:
+                    outs = opdef.abstract_eval(actx, op, ins)
+                except Exception as e:
+                    note = "transfer-error: %s" % (str(e).splitlines() or [""])[0]
+                    self.report.problem(block.idx, index, op, note)
+            elif opdef.is_host:
+                note = "host"
+            elif opdef.lower is None:
+                note = "no-lowering"
+            else:
+                outs, note = self._default_transfer(op, opdef, ins)
+                if note is not None and note.startswith("transfer-error"):
+                    self.report.problem(block.idx, index, op, note)
+            rec_outs = self._scatter(op, outs, env, site)
+            self.report.records.append(
+                OpRecord(op, block.idx, index, opdef, ins, rec_outs, note)
+            )
+        return env
+
+    def run(self):
+        env = {}
+        block = self.program.global_block()
+        # feeds enter the env up front so fed names never fall back to scope
+        for n in self.report.feed_names:
+            env[n] = self._with_spec(n, self._external_fact(n, block))
+        self._run_block(block, env)
+        self.report.facts = env
+        return self.report
+
+
+def analyze_program(program, feed_names=(), fetch_names=(), scope=None,
+                    mesh=None, rules=None, mode="training", feed_facts=None):
+    """Whole-program forward abstract interpretation.
+
+    Returns an `Analysis`. `rules` defaults to the program's attached
+    ShardingRules; with a `mesh` they bind into a Resolver so every fact
+    carries the layout the executor would assign. `feed_facts` (name ->
+    VarFact) overrides feed metadata with concrete run shapes. `mode` is
+    "training" / "inference" / "serving" — consumed by the determinism
+    checker, not the interpretation itself."""
+    from ..passes.graph import Graph
+
+    graph = program if isinstance(program, Graph) else Graph(program)
+    # report.program must be a Program (checkers call global_block on it);
+    # callers handing a live Graph get its shadow program as the identity
+    program = graph.program if isinstance(program, Graph) else program
+    resolver = None
+    if mesh is not None:
+        from ..parallel.sharding_rules import Resolver, ShardingRules
+
+        combined = ShardingRules()
+        combined.extend(getattr(graph.program, "_sharding_rules", None)
+                        or getattr(program, "_sharding_rules", None))
+        combined.extend(rules)
+        blk = graph.program.global_block()
+
+        def var_lookup(name):
+            try:
+                return blk._var_recursive(name)
+            except KeyError:
+                return None
+
+        resolver = Resolver(mesh, rules=combined, var_lookup=var_lookup)
+        resolver.add_aliases(graph.program.global_block().ops)
+    return _Analyzer(
+        program, graph, feed_names, fetch_names, scope, mesh, resolver, mode,
+        feed_facts=feed_facts,
+    ).run()

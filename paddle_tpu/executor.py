@@ -516,6 +516,14 @@ class _CompiledBlock:
             resolver = Resolver(mesh, rules=combined, var_lookup=var_lookup)
             resolver.add_aliases(self.ops)
             self._resolver = resolver
+            # dead-rule audit (analysis/sharding_dead_rules): a pattern that
+            # matches neither a declared var nor a scope resident is a typo
+            # silently replicating its target — surface it once per compile
+            if len(combined):
+                audit_names = set(scope.vars)
+                for b in program.blocks:
+                    audit_names.update(b.vars)
+                resolver.audit(audit_names)
 
             # ZeRO-1: optimizer-state tensors live sharded 1/dp per rank —
             # the ÷dp state-memory/HBM win. Names come from the optimizer
@@ -709,6 +717,12 @@ def aot_serve_lowering(program, feed_names, fetch_names, scope,
     program = _apply_pass_pipeline(
         program, scope, list(feed_names), list(fetch_names),
         pipeline=pass_pipeline if pass_pipeline else "off",
+    )
+    from .analysis import maybe_static_verify
+
+    maybe_static_verify(
+        program, list(feed_names), list(fetch_names), scope=scope,
+        mode="serving", where="aot_serve",
     )
     block = program.global_block()
     compiled = _CompiledBlock(
@@ -1814,6 +1828,16 @@ class Executor:
         compiled = self._cache.get(key) if use_program_cache else None
         _obs_cache_hit = compiled is not None
         if compiled is None:
+            # FLAGS_static_verify (docs/static_analysis.md): prove the program
+            # against the fluidlint suite before paying for the trace below
+            from .analysis import maybe_static_verify
+
+            maybe_static_verify(
+                program, list(feed_arrays.keys()), fetch_names, scope=scope,
+                mode="inference" if getattr(program, "_is_test", False)
+                else "training",
+                where="executor",
+            )
             has_host = any(
                 registry.is_registered(op.type) and registry.get(op.type).is_host
                 for op in block.ops

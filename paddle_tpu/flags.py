@@ -95,6 +95,15 @@ Honored flags:
   debugger.draw_block_graphviz) and a textual op diff, named
   <NN>_<pass>_{before,after}.dot / <NN>_<pass>_ops.diff; "" (default)
   disables.
+- static_verify: run the whole-program static analyzer (paddle_tpu/analysis,
+  docs/static_analysis.md) at every compile seam — Executor.run and
+  ParallelExecutor.run executable-cache misses, aot_serve_lowering (the
+  serving/generation model-load path), and the pass pipeline (stage 0 plus a
+  structural re-verification after every pass). Error-severity fluidlint
+  findings raise StaticVerifyError with op/var provenance BEFORE tracing;
+  warnings count into the observability registry. Verification never
+  mutates the program, so outputs are bit-identical with the flag off.
+  False (default) skips the gate entirely.
 - eager_delete_tensor_gb / fraction_of_gpu_memory_to_use /
   paddle_num_threads: accepted for API compatibility; storage lifetime and
   threading are XLA/PJRT-owned here (documented no-ops).
@@ -135,6 +144,7 @@ _DEFAULTS = {
     "elastic_barrier_timeout_s": 120.0,
     "pass_pipeline": "",
     "pass_debug_dir": "",
+    "static_verify": False,
 }
 
 _flags = {}

@@ -34,13 +34,108 @@ from jax import lax
 
 from .registry import LowerCtx, lower_ops, register
 
+# Why the remaining _noop_infer escapes are genuinely dynamic — one entry
+# per op still registered with it (tests/test_analysis.py asserts the two
+# sets match, so a new noop escape must document itself here). Everything
+# shape-inferable at build time has a real infer below; the static analyzer
+# (analysis/dataflow.py) sees through even these via the abstract_eval
+# hooks, which model array VALUES as (buffer, size) facts.
+NOOP_INFER_REASONS = {
+    "create_array": (
+        "the array VALUE is a (buffer, size) pair; a capacity-less array "
+        "has no buffer until the first trace-time write_to_array"
+    ),
+    "write_to_array": (
+        "buffer capacity evolves with trace-time growth bookkeeping "
+        "(init_cap / grow_slots) invisible in flat var metadata"
+    ),
+    "read_from_array": (
+        "the element shape lives in the array VALUE's buffer, not in the "
+        "array variable's flat metadata"
+    ),
+    "lod_tensor_to_array": (
+        "the output is an array value (time-major buffer, size) that flat "
+        "var metadata cannot carry"
+    ),
+    "array_to_lod_tensor": (
+        "the output shape is the input array VALUE's buffer transposed — "
+        "unknown until the buffer exists at trace time"
+    ),
+    # registered in decode_ops.py, documented here with the rest
+    "beam_search_decode": (
+        "hypothesis length is the Ids array VALUE's buffer capacity — the "
+        "step arrays carry no flat metadata to backtrack from"
+    ),
+}
+
 
 def _noop_infer(op, block):
-    """Output shapes are set at layer-build time (layers/control_flow.py);
-    array values are (buffer, size) tuples jax.eval_shape cannot abstract
-    from flat var metadata, and while/cond outputs alias their input names
-    whose shapes are already known."""
+    """No build-time inference — see NOOP_INFER_REASONS[op.type] for why
+    this op's outputs are genuinely dynamic. The analyzer still infers
+    through them via the op's abstract_eval hook."""
     return None
+
+
+def _copy_meta(block, src_name, dst_name):
+    """Copy shape/dtype/lod metadata from one var to another (the identity
+    build-time inference shared by print/shrink/reorder)."""
+    from .registry import EMPTY_VAR_NAME
+
+    if EMPTY_VAR_NAME in (src_name, dst_name) or src_name == dst_name:
+        return
+    if not (block.has_var_recursive(src_name) and block.has_var_recursive(dst_name)):
+        return
+    src = block._var_recursive(src_name)
+    dst = block._var_recursive(dst_name)
+    if src.shape is not None:
+        dst.shape = tuple(src.shape)
+    if src.dtype is not None:
+        dst.dtype = src.dtype
+    dst.lod_level = getattr(src, "lod_level", 0)
+
+
+def _set_meta(block, name, shape, dtype):
+    from .registry import EMPTY_VAR_NAME
+
+    if name == EMPTY_VAR_NAME or not block.has_var_recursive(name):
+        return
+    v = block._var_recursive(name)
+    if shape is not None:
+        v.shape = tuple(shape)
+    if dtype is not None:
+        v.dtype = dtype
+
+
+def _vf(**kw):
+    # lazy: analysis imports ops.registry; hooks only run under the analyzer
+    from ..analysis.dataflow import VarFact
+
+    return VarFact(**kw)
+
+
+def _known(f):
+    return f is not None and f.kind == "tensor" and f.shape is not None
+
+
+def _facts_conflict(a, b):
+    """True when two facts PROVABLY disagree (kind, dtype, rank, or a pair
+    of fully-static dims). Symbolic/unknown dims prove nothing."""
+    if a is None or b is None:
+        return False
+    if a.kind == "opaque" or b.kind == "opaque":
+        return False
+    if a.kind != b.kind:
+        return True
+    if a.dtype is not None and b.dtype is not None and a.dtype != b.dtype:
+        return True
+    if a.shape is None or b.shape is None:
+        return False
+    if len(a.shape) != len(b.shape):
+        return True
+    for da, db in zip(a.shape, b.shape):
+        if isinstance(da, int) and isinstance(db, int) and da != db:
+            return True
+    return False
 
 
 def _scalar_bool(x):
@@ -53,7 +148,54 @@ def _mask_rows(active, new, old):
     return jnp.where(a, new, old)
 
 
-@register("while", infer_shape=_noop_infer)
+def _while_infer(op, block):
+    """`while` outputs ALIAS their carried input names (the same variables,
+    metadata already propagated by the sub-block's per-op inference as it
+    was built), so there are no shapes to write — build-time inference
+    instead validates the structural contract the lowering assumes, the
+    checks while_op.cc's InferShape did by hand."""
+    attrs = op.attrs
+    carried = list(attrs.get("carried_names", ()))
+    x_names = set(attrs.get("x_names", ()))
+    cond = attrs.get("cond_name")
+    missing = [n for n in carried if n not in x_names]
+    if missing:
+        raise ValueError(
+            "while op: carried names %s are not in x_names — the lowering "
+            "env would have no initial value for them" % missing
+        )
+    if cond not in carried:
+        raise ValueError(
+            "while op: condition %r is not loop-carried — the loop could "
+            "never terminate" % cond
+        )
+
+
+def _while_abstract(actx, op, ins):
+    """Sub-block-aware transfer: interpret the body once with the entry
+    facts and require every loop-carried value to be shape/dtype-stable
+    (the lax.while_loop/scan carry contract). Out facts are the entry
+    facts — the fixed point of a stable carry."""
+    attrs = op.attrs
+    carried = list(attrs.get("carried_names", ()))
+    x_names = list(attrs.get("x_names", ()))
+    env = dict(zip(x_names, ins.get("X", ())))
+    entry = {n: env.get(n) for n in carried}
+    body = dict(env)
+    actx.analyze_block(attrs["sub_block"], body)
+    outs = []
+    for n in carried:
+        a, b = entry.get(n), body.get(n)
+        if _facts_conflict(a, b):
+            actx.problem(
+                "loop-carried %r is not shape/dtype-stable across "
+                "iterations: entry %r vs body exit %r" % (n, a, b)
+            )
+        outs.append(a if _known(a) or b is None else b)
+    return {"Out": outs}
+
+
+@register("while", infer_shape=_while_infer, abstract_eval=_while_abstract)
 def _while(ctx, ins, attrs):
     sub = attrs["sub_block"]
     carried = list(attrs["carried_names"])
@@ -106,7 +248,54 @@ def _while(ctx, ins, attrs):
     return {"Out": list(final)}
 
 
-@register("conditional_block", infer_shape=_noop_infer)
+def _cond_infer(op, block):
+    """conditional_block outputs alias the written parent vars (metadata
+    already known); validate the contract instead: every written name must
+    also ride x_names, because the false branch rebinds its PRIOR value."""
+    attrs = op.attrs
+    written = list(attrs.get("written_names", ()))
+    x_names = set(attrs.get("x_names", ()))
+    missing = [n for n in written if n not in x_names]
+    if missing:
+        raise ValueError(
+            "conditional_block op: written names %s are not in x_names — "
+            "the false branch would have no prior value to rebind" % missing
+        )
+
+
+def _cond_abstract(actx, op, ins):
+    """Interpret the branch body with the entry facts; both branches of the
+    lax.cond must agree, so a provable shape change in the taken branch is
+    a problem. Out dtype follows the PRIOR value (the lowering casts the
+    branch result to it)."""
+    attrs = op.attrs
+    written = list(attrs.get("written_names", ()))
+    x_names = list(attrs.get("x_names", ()))
+    env = dict(zip(x_names, ins.get("X", ())))
+    prior = {n: env.get(n) for n in written}
+    body = dict(env)
+    actx.analyze_block(attrs["sub_block"], body)
+    outs = []
+    for n in written:
+        p, b = prior.get(n), body.get(n)
+        # dtype divergence is fine — the lowering casts the branch result
+        # to the prior dtype; only a provable SHAPE/kind conflict breaks
+        # the lax.cond branch agreement
+        if p is not None and b is not None and _facts_conflict(
+            _vf(shape=p.shape, kind=p.kind), _vf(shape=b.shape, kind=b.kind)
+        ):
+            actx.problem(
+                "conditional_block writes %r with a shape differing from "
+                "its prior value: %r vs %r — lax.cond branches would "
+                "disagree" % (n, p, b)
+            )
+        outs.append(p if _known(p) or b is None else b)
+    return {"Out": outs}
+
+
+@register(
+    "conditional_block", infer_shape=_cond_infer, abstract_eval=_cond_abstract
+)
 def _conditional_block(ctx, ins, attrs):
     sub = attrs["sub_block"]
     written = list(attrs["written_names"])
@@ -139,7 +328,93 @@ def _conditional_block(ctx, ins, attrs):
     return {"Out": list(outs)}
 
 
-@register("recurrent", infer_shape=_noop_infer)
+def _recurrent_infer(op, block):
+    """Real sub-block-aware build-time inference: recompute the stacked
+    output shapes from the sub-block's per-step out vars plus the time
+    extent of the stacked X input, and the FinalState metadata from Boot —
+    previously hand-computed only in layers/control_flow._RNNBase._complete,
+    now re-derived here so raw append_op callers get the same metadata."""
+    attrs = op.attrs
+    sub = attrs.get("sub_block")
+    if sub is None:
+        return
+    tm = bool(attrs.get("time_major", False))
+    taxis = 0 if tm else 1
+    t = None
+    xs = op.inputs.get("X", ())
+    if xs and block.has_var_recursive(xs[0]):
+        v = block._var_recursive(xs[0])
+        if v.shape is not None and len(v.shape) > taxis:
+            t = v.shape[taxis]
+    if t is None:
+        t = int(attrs.get("length", 0)) or -1
+    for step_name, out_name in zip(
+        attrs.get("out_names", ()), op.outputs.get("Out", ())
+    ):
+        if not sub.has_var_recursive(step_name):
+            continue
+        o = sub._var_recursive(step_name)
+        if o.shape is None:
+            continue
+        s = list(o.shape)
+        stacked = [t] + s if tm else s[:1] + [t] + s[1:]
+        _set_meta(block, out_name, stacked, o.dtype)
+    for boot_name, final_name in zip(
+        op.inputs.get("Boot", ()), op.outputs.get("FinalState", ())
+    ):
+        _copy_meta(block, boot_name, final_name)
+
+
+def _recurrent_abstract(actx, op, ins):
+    """Transfer for the scan: per-step facts (time axis dropped from the
+    stacked X) flow through one interpretation of the sub-block; outputs
+    stack the time axis back on, and FinalState must be shape-stable
+    against Boot (the scan carry contract)."""
+    attrs = op.attrs
+    tm = bool(attrs.get("time_major", False))
+    taxis = 0 if tm else 1
+    xs = ins.get("X", ())
+    boot = ins.get("Boot", ())
+    env = dict(zip(attrs.get("closure_names", ()), ins.get("C", ())))
+    env.update(zip(attrs.get("pre_state_names", ()), boot))
+    t = None
+    for n, f in zip(attrs.get("x_names", ()), xs):
+        if _known(f) and len(f.shape) > taxis:
+            if t is None:
+                t = f.shape[taxis]
+            env[n] = _vf(
+                shape=f.shape[:taxis] + f.shape[taxis + 1:], dtype=f.dtype
+            )
+        else:
+            env[n] = _vf(kind="opaque")
+    if t is None:
+        t = int(attrs.get("length", 0)) or None
+    actx.analyze_block(attrs["sub_block"], env)
+    outs = []
+    for n in attrs.get("out_names", ()):
+        f = env.get(n)
+        if _known(f) and t is not None and (tm or len(f.shape) >= 1):
+            stacked = (
+                (t,) + f.shape if tm else f.shape[:1] + (t,) + f.shape[1:]
+            )
+            outs.append(_vf(shape=stacked, dtype=f.dtype))
+        else:
+            outs.append(actx.opaque())
+    finals = []
+    for n, b in zip(attrs.get("new_state_names", ()), boot):
+        f = env.get(n)
+        if _facts_conflict(f, b):
+            actx.problem(
+                "recurrent state %r is not shape-stable across steps: boot "
+                "%r vs step exit %r" % (n, b, f)
+            )
+        finals.append(b if _known(b) or f is None else f)
+    return {"Out": outs, "FinalState": finals}
+
+
+@register(
+    "recurrent", infer_shape=_recurrent_infer, abstract_eval=_recurrent_abstract
+)
 def _recurrent(ctx, ins, attrs):
     """scan over time. Inputs: X stacked sequence inputs, Boot initial states,
     C closure (params etc.), SeqLen optional per-row lengths. See layer classes
@@ -198,7 +473,76 @@ def _recurrent(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
-@register("create_array", infer_shape=_noop_infer)
+def _canon_dtype(dtype):
+    from ..framework import convert_np_dtype
+
+    try:
+        return convert_np_dtype(dtype)
+    except ValueError:
+        return None
+
+
+def _create_array_abstract(actx, op, ins):
+    shape = op.attrs.get("shape")
+    if not shape:
+        return {"Out": [_vf(kind="array")]}  # buffer shape set by first write
+    return {
+        "Out": [
+            _vf(
+                shape=tuple(shape),
+                dtype=_canon_dtype(op.attrs.get("dtype", "float32")),
+                kind="array",
+            )
+        ]
+    }
+
+
+def _write_to_array_abstract(actx, op, ins):
+    """Mirror the lowering's capacity bookkeeping on buffer-shape facts."""
+    x = ins["X"][0]
+    arr = (ins.get("Array") or [None])[0]
+    if arr is None or arr.kind != "array" or arr.shape is None:
+        if not _known(x):
+            return {"Out": [_vf(kind="array")]}
+        cap = int(op.attrs.get("init_cap", 1))
+        return {"Out": [_vf(shape=(cap,) + x.shape, dtype=x.dtype, kind="array")]}
+    grow = int(op.attrs.get("grow_slots", 0))
+    cap = arr.shape[0]
+    if grow and isinstance(cap, int):
+        cap = cap + grow
+    return {"Out": [_vf(shape=(cap,) + arr.shape[1:], dtype=arr.dtype, kind="array")]}
+
+
+def _read_from_array_abstract(actx, op, ins):
+    arr = ins["X"][0]
+    if arr is None or arr.kind != "array" or arr.shape is None:
+        return {"Out": [actx.opaque()]}
+    return {"Out": [_vf(shape=arr.shape[1:], dtype=arr.dtype)]}
+
+
+def _array_length_abstract(actx, op, ins):
+    return {"Out": [_vf(shape=(1,), dtype="int64")]}
+
+
+def _lod_tensor_to_array_abstract(actx, op, ins):
+    x = ins["X"][0]
+    if not _known(x) or len(x.shape) < 2:
+        return {"Out": [_vf(kind="array")]}
+    buf = (x.shape[1], x.shape[0]) + x.shape[2:]
+    return {"Out": [_vf(shape=buf, dtype=x.dtype, kind="array")]}
+
+
+def _array_to_lod_tensor_abstract(actx, op, ins):
+    arr = ins["X"][0]
+    if arr is None or arr.kind != "array" or arr.shape is None or len(arr.shape) < 2:
+        return {"Out": [actx.opaque()]}
+    out = (arr.shape[1], arr.shape[0]) + arr.shape[2:]
+    return {"Out": [_vf(shape=out, dtype=arr.dtype)]}
+
+
+@register(
+    "create_array", infer_shape=_noop_infer, abstract_eval=_create_array_abstract
+)
 def _create_array(ctx, ins, attrs):
     shape = attrs.get("shape")
     if not shape:
@@ -209,7 +553,11 @@ def _create_array(ctx, ins, attrs):
     return {"Out": [(buf, jnp.asarray(0, jnp.int32))]}
 
 
-@register("write_to_array", infer_shape=_noop_infer)
+@register(
+    "write_to_array",
+    infer_shape=_noop_infer,
+    abstract_eval=_write_to_array_abstract,
+)
 def _write_to_array(ctx, ins, attrs):
     """Growable writes carry static capacity bookkeeping from the layer
     (layers/control_flow.py array_write): ``init_cap`` sizes the buffer of a
@@ -238,7 +586,11 @@ def _write_to_array(ctx, ins, attrs):
     return {"Out": [(buf, size)]}
 
 
-@register("read_from_array", infer_shape=_noop_infer)
+@register(
+    "read_from_array",
+    infer_shape=_noop_infer,
+    abstract_eval=_read_from_array_abstract,
+)
 def _read_from_array(ctx, ins, attrs):
     (arr,) = ins["X"]
     (i,) = ins["I"]
@@ -247,14 +599,28 @@ def _read_from_array(ctx, ins, attrs):
     return {"Out": [lax.dynamic_index_in_dim(buf, i, 0, keepdims=False)]}
 
 
-@register("lod_array_length", no_grad=True, infer_shape=_noop_infer)
+def _scalar_i64_infer(op, block):
+    for n in op.outputs.get("Out", ()):
+        _set_meta(block, n, (1,), "int64")
+
+
+@register(
+    "lod_array_length",
+    no_grad=True,
+    infer_shape=_scalar_i64_infer,
+    abstract_eval=_array_length_abstract,
+)
 def _array_length(ctx, ins, attrs):
     (arr,) = ins["X"]
     _, size = arr
     return {"Out": [jnp.reshape(size, (1,)).astype(jnp.int64)]}
 
 
-@register("lod_tensor_to_array", infer_shape=_noop_infer)
+@register(
+    "lod_tensor_to_array",
+    infer_shape=_noop_infer,
+    abstract_eval=_lod_tensor_to_array_abstract,
+)
 def _lod_tensor_to_array(ctx, ins, attrs):
     """Padded-dense [B, T, ...] -> time-major array buffer [T, B, ...] with
     size=T (reference lod_tensor_to_array_op.cc scattered per-rank-table rows;
@@ -264,14 +630,27 @@ def _lod_tensor_to_array(ctx, ins, attrs):
     return {"Out": [(buf, jnp.asarray(buf.shape[0], jnp.int32))]}
 
 
-@register("array_to_lod_tensor", infer_shape=_noop_infer)
+@register(
+    "array_to_lod_tensor",
+    infer_shape=_noop_infer,
+    abstract_eval=_array_to_lod_tensor_abstract,
+)
 def _array_to_lod_tensor(ctx, ins, attrs):
     (arr,) = ins["X"]
     buf, _ = arr
     return {"Out": [jnp.swapaxes(buf, 0, 1)]}
 
 
-@register("shrink_rnn_memory", infer_shape=_noop_infer)
+def _identity_infer(op, block):
+    """Build-time metadata copy for ops whose output is shaped exactly like
+    their X input (identity / row-permutation lowerings)."""
+    xs = op.inputs.get("X", ())
+    outs = op.outputs.get("Out", ())
+    if xs and outs:
+        _copy_meta(block, xs[0], outs[0])
+
+
+@register("shrink_rnn_memory", infer_shape=_identity_infer)
 def _shrink_rnn_memory(ctx, ins, attrs):
     # reference shrink_memory drops finished rows from the batch; the padded
     # representation keeps them and masks instead (recurrent op) — identity.
@@ -279,20 +658,37 @@ def _shrink_rnn_memory(ctx, ins, attrs):
     return {"Out": [x]}
 
 
-@register("max_sequence_len", no_grad=True, infer_shape=_noop_infer)
+@register("max_sequence_len", no_grad=True, infer_shape=_scalar_i64_infer)
 def _max_sequence_len(ctx, ins, attrs):
     (seqlen,) = ins["X"]
     return {"Out": [jnp.max(seqlen.reshape(-1)).reshape(1).astype(jnp.int64)]}
 
 
-@register("reorder_lod_tensor_by_rank", infer_shape=_noop_infer)
+@register("reorder_lod_tensor_by_rank", infer_shape=_identity_infer)
 def _reorder_by_rank(ctx, ins, attrs):
     (x,) = ins["X"]
     (rank,) = ins["RankTable"]
     return {"Out": [jnp.take(x, rank.reshape(-1).astype(jnp.int32), axis=0)]}
 
 
-@register("lod_rank_table", no_grad=True, infer_shape=_noop_infer)
+def _lod_rank_table_infer(op, block):
+    xs = op.inputs.get("X", ())
+    outs = op.outputs.get("Out", ())
+    if not (xs and outs):
+        return
+    numel = -1
+    if block.has_var_recursive(xs[0]):
+        v = block._var_recursive(xs[0])
+        if v.shape is not None and all(
+            isinstance(d, int) and d >= 0 for d in v.shape
+        ):
+            numel = 1
+            for d in v.shape:
+                numel *= d
+    _set_meta(block, outs[0], (numel,), "int64")
+
+
+@register("lod_rank_table", no_grad=True, infer_shape=_lod_rank_table_infer)
 def _lod_rank_table(ctx, ins, attrs):
     """Row indices sorted by sequence length, descending (reference
     lod_rank_table.h). Input is the SeqLen companion vector."""
@@ -301,7 +697,16 @@ def _lod_rank_table(ctx, ins, attrs):
     return {"Out": [order.astype(jnp.int64)]}
 
 
-@register("print", no_grad=False, infer_shape=_noop_infer)
+def _print_abstract(actx, op, ins):
+    return {"Out": [ins["X"][0]]}  # value passthrough; side effect only
+
+
+@register(
+    "print",
+    no_grad=False,
+    infer_shape=_identity_infer,
+    abstract_eval=_print_abstract,
+)
 def _print(ctx, ins, attrs):
     (x,) = ins["X"]
     msg = attrs.get("message", "")
@@ -313,7 +718,34 @@ def _print(ctx, ins, attrs):
     return {"Out": [x]}
 
 
-@register("parallel_do", infer_shape=_noop_infer)
+def _parallel_do_infer(op, block):
+    sub = op.attrs.get("sub_block")
+    if sub is None:
+        return
+    for step_name, out_name in zip(
+        op.attrs.get("out_names", ()), op.outputs.get("Out", ())
+    ):
+        if sub.has_var_recursive(step_name):
+            src = sub._var_recursive(step_name)
+            _set_meta(block, out_name, src.shape, src.dtype)
+
+
+def _parallel_do_abstract(actx, op, ins):
+    attrs = op.attrs
+    env = dict(zip(attrs.get("x_names", ()), ins.get("X", ())))
+    actx.analyze_block(attrs["sub_block"], env)
+    return {
+        "Out": [
+            env.get(n) or actx.opaque() for n in attrs.get("out_names", ())
+        ]
+    }
+
+
+@register(
+    "parallel_do",
+    infer_shape=_parallel_do_infer,
+    abstract_eval=_parallel_do_abstract,
+)
 def _parallel_do(ctx, ins, attrs):
     """Deprecated intra-graph data-parallel islands (reference
     controlflow/parallel_do_op.cc: split the batch across places, run the

@@ -26,8 +26,38 @@ from .registry import register
 
 def _noop_infer(op, block):
     """Tensor-array inputs are (buffer, size) pairs that flat var metadata
-    cannot describe; output shapes come from the first trace."""
+    cannot describe; output shapes come from the first trace. (Documented in
+    control_flow_ops.NOOP_INFER_REASONS with the other array-kind escapes.)"""
     return None
+
+
+def _beam_search_decode_abstract(actx, op, ins):
+    """Analyzer transfer: recover [B, beam, T] from the Ids ARRAY fact's
+    buffer shape, mirroring the lowering's reshape arithmetic."""
+    from .control_flow_ops import _vf
+
+    arr = ins["Ids"][0]
+    beam = int(op.attrs["beam_size"])
+    shape = arr.shape if arr is not None and arr.kind == "array" else None
+    if (
+        shape is None
+        or len(shape) < 2
+        or not isinstance(shape[0], int)
+        or not isinstance(shape[1], int)
+    ):
+        return {
+            "SentenceIds": [actx.opaque()],
+            "SentenceScores": [actx.opaque()],
+            "SentenceLength": [actx.opaque()],
+        }
+    t_cap, n = shape[0], shape[1]
+    b = n // beam
+    return {
+        "SentenceIds": [_vf(shape=(b, beam, t_cap), dtype="int64")],
+        "SentenceScores": [_vf(shape=(b, beam), dtype="float32")],
+        "SentenceLength": [_vf(shape=(b, beam), dtype="int32")],
+    }
+
 
 NEG_INF = -1e9
 
@@ -70,7 +100,12 @@ def _beam_search(ctx, ins, attrs):
     }
 
 
-@register("beam_search_decode", no_grad=True, infer_shape=_noop_infer)
+@register(
+    "beam_search_decode",
+    no_grad=True,
+    infer_shape=_noop_infer,
+    abstract_eval=_beam_search_decode_abstract,
+)
 def _beam_search_decode(ctx, ins, attrs):
     """Backtrack (ids, parents) step arrays into [B, beam, T] hypotheses,
     best beam first per source."""

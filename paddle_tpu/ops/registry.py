@@ -57,10 +57,20 @@ class OpDef:
         stochastic=False,
         skip_exec=False,
         host_fn=None,
+        abstract_eval=None,
     ):
         self.type = type
         self.lower = lower
         self.custom_infer_shape = infer_shape
+        # abstract_eval: the static analyzer's transfer function
+        # (analysis/dataflow.py), `fn(actx, op, ins) -> {slot: [VarFact]}`.
+        # Most ops need none — the analyzer abstracts the lowering itself
+        # with jax.eval_shape, the same machinery infer_shape below uses.
+        # Register one only where the lowering cannot be abstracted from
+        # flat tensor facts: control-flow ops recurse into their sub-blocks
+        # (actx.analyze_block), tensor-array ops model (buffer, size) pairs
+        # (ops/control_flow_ops.py).
+        self.abstract_eval = abstract_eval
         # grad: fn(op, block, grad_name_map) -> list of op-spec dicts, or None
         # for the generic vjp-derived gradient.
         self.grad = grad

@@ -219,6 +219,13 @@ class Resolver:
         self.aliases = {}  # state/accumulator name -> param name
         self.zero1_axis = None
         self.zero1_names = frozenset()
+        # structured record of every divisibility degradation _prune applied
+        # (was silent before the static analyzer landed): [(name, dim, axes,
+        # dim_size, extent)], recorded once per (name, dim) and counted into
+        # the observability registry (analysis/sharding_degraded). fluidlint's
+        # sharding-rules checker reports the same condition statically.
+        self.degraded = []
+        self._degraded_seen = set()
 
     def set_zero1(self, axis, names):
         self.zero1_axis = axis
@@ -240,7 +247,21 @@ class Resolver:
                 for name in op.inputs.get(slot, ()):
                     self.aliases[name] = params[0]
 
-    def _prune(self, spec, shape):
+    def _record_degraded(self, name, dim, axes, dim_size, extent):
+        key = (name, dim)
+        if name is None or key in self._degraded_seen:
+            return
+        self._degraded_seen.add(key)
+        self.degraded.append((name, dim, axes, dim_size, extent))
+        from ..observability import registry as _registry
+
+        _registry.default_registry().counter(
+            "analysis/sharding_degraded",
+            "spec dims degraded to replication because the dim size did not "
+            "divide the mesh-axes extent",
+        ).inc(axes="+".join(axes))
+
+    def _prune(self, spec, shape, name=None):
         if spec is None:
             return None
         shape = tuple(shape) if shape is not None else None
@@ -255,6 +276,7 @@ class Resolver:
             if kept and shape is not None:
                 extent = int(np.prod([self.mesh.shape[a] for a in kept]))
                 if shape[dim] % extent != 0:
+                    self._record_degraded(name, dim, kept, shape[dim], extent)
                     kept = ()
             out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
         if all(e is None for e in out):
@@ -277,7 +299,32 @@ class Resolver:
             spec = getattr(v, "sharding_spec", None)
             if spec is not None:
                 raw = _normalize_spec(spec)
-        return self._prune(raw, shape)
+        return self._prune(raw, shape, name=name)
+
+    def audit(self, names):
+        """Dead-rule audit: patterns matching none of `names` (typically the
+        lowered block's vars plus the scope's persistables) are typos or
+        stale layouts silently replicating their target. Returns the dead
+        pattern list and counts each into the observability registry
+        (analysis/sharding_dead_rules); the executor runs this once per
+        compile, fluidlint's sharding-rules checker statically."""
+        if self.rules is None:
+            return []
+        names = list(names)
+        dead = []
+        for pattern, rx, _ in self.rules._rules:
+            if not any(rx.search(n) for n in names):
+                dead.append(pattern)
+        if dead:
+            from ..observability import registry as _registry
+
+            c = _registry.default_registry().counter(
+                "analysis/sharding_dead_rules",
+                "sharding rules whose pattern matched no var at compile",
+            )
+            for pattern in dead:
+                c.inc(pattern=pattern)
+        return dead
 
     def spec(self, name, shape=None):
         """Full precedence chain -> pruned spec tuple or None (replicated)."""

@@ -335,6 +335,18 @@ class ParallelExecutor:
         compiled = self._cache.get(key)
         _obs_cache_hit = compiled is not None
         if compiled is None:
+            # FLAGS_static_verify (docs/static_analysis.md): mesh-aware lint —
+            # the analyzer resolves sharding specs through the same Resolver
+            # precedence the compile below uses
+            from .analysis import maybe_static_verify
+
+            maybe_static_verify(
+                program, list(feed_arrays.keys()), fetch_names,
+                scope=self._scope, mesh=self._mesh, rules=bs_rules,
+                mode="inference" if getattr(program, "_is_test", False)
+                else "training",
+                where="parallel_executor",
+            )
             # feed_ranks are UNSTACKED ranks: rank 0 (scalars) replicate
             feed_ranks = {
                 n: np.ndim(a) - batch_dim for n, a in feed_arrays.items()

@@ -168,9 +168,15 @@ class PassManager:
         telemetry) per pass. Mutates `graph`; returns ctx.results."""
         from .. import flags as _flags
 
+        from ..analysis import verify_graph
+
         debug_dir = _flags.get_flags("pass_debug_dir")["pass_debug_dir"]
         m = _metrics()
         graph.verify()
+        # FLAGS_static_verify stage 0: structural fluidlint over the pristine
+        # graph, so pre-existing defects are attributed to the program, not
+        # to whichever pass happens to run first
+        verify_graph(graph, ctx, stage="0")
         # "+" not "," — snapshot label strings are comma-joined pairs, so a
         # comma inside a value would be ambiguous to every label consumer
         pipeline_label = "+".join(self.pass_names)
@@ -184,6 +190,8 @@ class PassManager:
             p.apply(graph, ctx)
             graph.refresh()
             graph.verify()  # per-pass invariant re-verification
+            # a failure here names the pass that broke capture/fetch/donation
+            verify_graph(graph, ctx, stage=name)
             wall_ms = (time.perf_counter() - t0) * 1000.0
             ops_after = graph.num_ops()
             m["applied"].inc(**{"pass": name})

@@ -108,6 +108,16 @@ class ServingEngine:
             if v.dtype is not None:
                 self._feed_dtypes[n] = v.dtype
 
+        # FLAGS_static_verify: lint the loaded artifact as-deserialized (the
+        # aot_serve_lowering gate below re-verifies post-pipeline), so a
+        # corrupt or mis-exported model names its defect at load, not at the
+        # first request
+        from ..analysis import maybe_static_verify
+
+        maybe_static_verify(
+            program, self.feed_names, self.fetch_names, scope=self.scope,
+            mode="serving", where="serving:%s" % self.name,
+        )
         with scope_guard(self.scope):
             self._serve, self._ro, self._mut = aot_serve_lowering(
                 program, self.feed_names, self.fetch_names, self.scope

@@ -314,6 +314,12 @@ class GenerationEngine:
         import jax
         from jax import export as jax_export
 
+        from ..analysis import maybe_static_verify
+
+        maybe_static_verify(
+            main, feed_names, fetch_names, scope=self.scope,
+            mode="serving", where="generation:%s" % kind,
+        )
         with scope_guard(self.scope):
             serve, ro, mut = aot_serve_lowering(
                 main, feed_names, fetch_names, self.scope, return_state=True

@@ -590,6 +590,51 @@ print("pallas smoke ok: dispatched %s, fused/unfused rel loss delta %.2g"
       % (dict(pk.KERNEL_DISPATCHES), delta))
 PY
 
+echo "== fluidlint smoke (docs/static_analysis.md) =="
+# the whole model zoo — incl. the NMT beam-search while-loop and the gpt
+# prefill/decode serving programs — must lint at zero findings under
+# --strict, and the FLAGS_static_verify compile gate must be
+# bit-transparent through the Executor (tests/test_fluidlint.py holds the
+# per-seam strict form incl. ParallelExecutor and aot_serve_lowering)
+JAX_PLATFORMS=cpu python tools/fluidlint.py --zoo --strict
+# the seeded-defect corpus: every checker must name its planted defect
+JAX_PLATFORMS=cpu python -m pytest -q tests/test_fluidlint.py -k "seeded"
+JAX_PLATFORMS=cpu python - <<'PY'
+import numpy as np
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.observability import registry as obs_registry
+
+def run(verify_on):
+    pt.set_flags({"static_verify": verify_on})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            loss = fluid.layers.mean(fluid.layers.fc(x, size=4, act="relu"))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        xv = np.random.RandomState(0).randn(6, 8).astype("float32")
+        with scope_guard(Scope(seed=7)):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            return [np.asarray(exe.run(main, feed={"x": xv},
+                                       fetch_list=[loss.name])[0])
+                    for _ in range(3)]
+    finally:
+        pt.set_flags({"static_verify": False})
+
+off = run(False)
+on = run(True)
+for a, b in zip(off, on):
+    assert (a == b).all(), "static_verify gate perturbed results"
+verifies = obs_registry.default_registry().counter(
+    "analysis/verifies", "").value(where="executor")
+assert verifies > 0, "gate never ran with the flag on"
+print("fluidlint smoke ok: zoo clean, gate bit-transparent "
+      "(%d verifications)" % verifies)
+PY
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

@@ -1,0 +1,289 @@
+"""Static analyzer tier tests (paddle_tpu/analysis/dataflow.py).
+
+The core property: forward abstract interpretation agrees with the traced
+avals. Program var metadata IS the traced result — append_op runs
+registry.infer_shape (jax.eval_shape over the lowering) as each op is built
+— so checking every analyzer fact against the declared metadata across the
+whole zoo checks the analyzer against ~300 op types' real traces, including
+the while/recurrent/tensor-array control-flow family. A second test closes
+the loop end-to-end: with concrete feed facts the analyzer's fetch facts
+must equal the shapes/dtypes the Executor actually returns.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.analysis import SymDim, VarFact, analyze_program, lint_program
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.ops import registry
+from paddle_tpu.ops.control_flow_ops import NOOP_INFER_REASONS
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, "..", "tools"))
+import fluidlint  # noqa: E402  (the zoo registry, tools/fluidlint.py)
+
+
+def _fresh():
+    return framework.Program(), framework.Program()
+
+
+def _dims_agree(fact_shape, declared):
+    """Per-dim agreement: a declared -1 matches anything, a symbolic fact
+    dim matches anything, static dims must be equal."""
+    if len(fact_shape) != len(declared):
+        return False
+    for fd, dd in zip(fact_shape, declared):
+        if dd == -1 or isinstance(fd, SymDim):
+            continue
+        if int(fd) != int(dd):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# symbolic interpretation basics
+# ---------------------------------------------------------------------------
+
+
+def test_symbolic_batch_propagates_through_fc():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=4, act="relu")
+        loss = fluid.layers.mean(h)
+    a = analyze_program(main, ["x"], [loss.name])
+    fx, fh, fl = a.facts["x"], a.facts[h.name], a.facts[loss.name]
+    # the dynamic batch dim is ONE shared symbol, not -1 and not a guess
+    assert isinstance(fx.shape[0], SymDim) and fx.shape[1] == 8
+    assert fh.shape == (fx.shape[0], 4)  # same SymDim object: proven equal
+    assert fh.dtype == "float32"
+    assert fl.concrete_shape() == (1,) and fl.dtype == "float32"
+    assert not a.problems
+
+
+def test_concrete_feed_facts_override_metadata():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=4)
+    a = analyze_program(
+        main, ["x"], [h.name],
+        feed_facts={"x": VarFact(shape=(3, 8), dtype="float32")},
+    )
+    assert a.facts[h.name].concrete_shape() == (3, 4)
+
+
+def test_facts_match_executed_shapes():
+    """End-to-end: with concrete feed facts, the analyzer's fetch facts
+    equal what the Executor actually returns, bit for bit on shape/dtype."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=4, act="relu")
+        s = fluid.layers.softmax(h)
+        loss = fluid.layers.mean(s)
+    fetches = [h.name, s.name, loss.name]
+    with scope_guard(Scope(seed=0)):
+        exe = fluid.Executor()
+        exe.run(startup)
+        vals = exe.run(
+            main, feed={"x": np.zeros((3, 8), "float32")}, fetch_list=fetches
+        )
+    a = analyze_program(
+        main, ["x"], fetches,
+        feed_facts={"x": VarFact(shape=(3, 8), dtype="float32")},
+    )
+    for name, val in zip(fetches, vals):
+        f = a.facts[name]
+        assert f.concrete_shape() == tuple(np.asarray(val).shape), name
+        assert f.dtype == framework.convert_np_dtype(np.asarray(val).dtype)
+
+
+# ---------------------------------------------------------------------------
+# zoo-wide property: facts agree with the traced (declared) metadata, and
+# the zoo lints clean.  One parametrization builds each model ONCE and
+# asserts both — building the zoo is the expensive part, so the lint-clean
+# contract (tests/test_fluidlint.py contract 2) lives here too instead of
+# re-building all fourteen models in a second parametrized test.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("model", sorted(fluidlint.ZOO))
+def test_zoo_facts_agree_with_traced_metadata(model):
+    program, feeds, fetches = fluidlint.ZOO[model]()
+    a, findings = lint_program(program, feeds, fetches)
+    # 0. the zoo is clean: zero findings (same programs the CLI lints)
+    assert findings == [], [f.format() for f in findings]
+    # 1. the interpretation covered the program: no transfer errors, no
+    #    analyzer problems anywhere in the block tree
+    errs = [r for r in a.records
+            if r.note and r.note.startswith("transfer-error")]
+    assert not errs, [(r.op.type, r.note) for r in errs]
+    assert not a.problems, a.problems
+    # 2. every fetch got a usable fact
+    for name in fetches:
+        f = a.facts.get(name)
+        assert f is not None and f.kind != "opaque", (name, f)
+    # 3. every tensor fact agrees with the declared metadata — which the
+    #    per-op eval_shape tracing wrote at build time
+    block = program.global_block()
+    checked = 0
+    for name, f in a.facts.items():
+        if f.kind != "tensor" or f.shape is None:
+            continue
+        if not block.has_var_recursive(name):
+            continue
+        v = block._var_recursive(name)
+        if v.shape is None or v.dtype is None:
+            continue
+        assert _dims_agree(f.shape, v.shape), (
+            model, name, f.shape, tuple(v.shape)
+        )
+        if f.dtype is not None:
+            assert f.dtype == framework.convert_np_dtype(v.dtype), (
+                model, name, f.dtype, v.dtype
+            )
+        checked += 1
+    assert checked >= 10, "suspiciously few comparable facts: %d" % checked
+
+
+# ---------------------------------------------------------------------------
+# per-op transfer coverage: the noop audit
+# ---------------------------------------------------------------------------
+
+
+def test_noop_infer_audit():
+    """Every remaining _noop_infer is documented in NOOP_INFER_REASONS and
+    carries an abstract_eval hook (the analyzer models it even though
+    build-time metadata inference cannot); everything else infers for real."""
+    noop = {
+        t for t, d in registry.OPS.items()
+        if d.custom_infer_shape is not None
+        and getattr(d.custom_infer_shape, "__name__", "") == "_noop_infer"
+    }
+    assert noop == set(NOOP_INFER_REASONS), (
+        "undocumented noop inference", noop ^ set(NOOP_INFER_REASONS)
+    )
+    for t in noop:
+        assert registry.OPS[t].abstract_eval is not None, t
+    inferable = [
+        t for t, d in registry.OPS.items()
+        if (d.lower is not None or d.custom_infer_shape is not None)
+        and t not in noop
+    ]
+    assert len(inferable) >= 280, len(inferable)
+
+
+# ---------------------------------------------------------------------------
+# control-flow transfer functions
+# ---------------------------------------------------------------------------
+
+
+def test_tensor_array_facts():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.fill_constant(shape=[2, 3], dtype="float32", value=1.0)
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        arr = fluid.layers.array_write(x, i)
+        y = fluid.layers.array_read(arr, i)
+        n = fluid.layers.array_length(arr)
+    a = analyze_program(main, [], [y.name, n.name])
+    assert a.facts[arr.name].kind == "array"
+    assert a.facts[arr.name].shape[1:] == (2, 3)  # [cap, *element]
+    assert a.facts[y.name].kind == "tensor"
+    assert a.facts[y.name].concrete_shape() == (2, 3)
+    assert a.facts[n.name].concrete_shape() == (1,)
+    assert a.facts[n.name].dtype == "int64"
+    assert not a.problems
+
+
+def test_while_stable_carry_is_clean():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=4)
+        acc = fluid.layers.fill_constant(shape=[2], dtype="float32", value=0.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            a2 = fluid.layers.elementwise_add(
+                acc, fluid.layers.fill_constant([2], "float32", 1.0)
+            )
+            fluid.layers.assign(a2, acc)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+    a = analyze_program(main, [], [acc.name])
+    assert not a.problems, a.problems
+    assert a.facts[acc.name].concrete_shape() == (2,)
+
+
+def test_while_unstable_carry_reports_problem():
+    """A loop-carried value whose body write changes shape breaks the
+    lax.while_loop carry contract — the analyzer names it instead of
+    letting XLA fail deep inside the trace."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=4)
+        acc = fluid.layers.fill_constant(shape=[2], dtype="float32", value=0.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            grown = fluid.layers.concat([acc, acc], axis=0)  # (2,) -> (4,)
+            fluid.layers.assign(grown, acc)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+    a = analyze_program(main, [], [acc.name])
+    msgs = [m for (_, _, _, m) in a.problems]
+    assert any("not shape/dtype-stable" in m for m in msgs), a.problems
+
+
+def test_conditional_block_facts():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        step = fluid.layers.fill_constant(shape=[1], dtype="int64", value=7)
+        lr = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        b1 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=5)
+        sw = fluid.layers.Switch()
+        with sw.case(fluid.layers.less_than(step, b1)):
+            fluid.layers.assign(
+                fluid.layers.fill_constant([1], "float32", 1.0), lr
+            )
+        with sw.default():
+            fluid.layers.assign(
+                fluid.layers.fill_constant([1], "float32", 0.01), lr
+            )
+    a = analyze_program(main, [], [lr.name])
+    assert a.facts[lr.name].concrete_shape() == (1,)
+    assert a.facts[lr.name].dtype == "float32"
+    assert not a.problems
+
+
+# ---------------------------------------------------------------------------
+# backward liveness
+# ---------------------------------------------------------------------------
+
+
+def test_live_after_kills_rebound_fetch():
+    """Liveness is kill-then-gen even for fetched names: a fetch is the
+    LAST write's value, so the name is dead between an overwrite and the
+    preceding write (the dead-write checker's foundation)."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        a_ = fluid.layers.fill_constant(shape=[2], dtype="float32", value=1.0)
+        b_ = fluid.layers.fill_constant(shape=[2], dtype="float32", value=2.0)
+        v = fluid.layers.fill_constant(shape=[2], dtype="float32", value=0.0)
+        fluid.layers.assign(a_, output=v)
+        fluid.layers.assign(b_, output=v)
+    rep = analyze_program(main, [], [v.name])
+    live = rep.live_after(0)
+    # ops: 0..2 fill_constant, 3 assign(a->v), 4 assign(b->v)
+    assert v.name not in live[2]  # next access is the op-3 write: dead
+    assert v.name not in live[3]  # rebound again at op 4
+    assert v.name in live[4]  # live out: fetched
+    assert a_.name in live[2] and a_.name not in live[3]

@@ -32,6 +32,7 @@ MODULES = [
     "paddle_tpu.profiler",
     "paddle_tpu.transpiler",
     "paddle_tpu.passes",
+    "paddle_tpu.analysis",
     "paddle_tpu.reader",
     "paddle_tpu.reader.creator",
     "paddle_tpu.imperative",
