@@ -1793,6 +1793,256 @@ def run_passes_bench(smoke=False):
     return record
 
 
+def run_online_bench(smoke=False):
+    """Online-learning evidence pass (PR 15 -> ONLINE.json; docs/online.md).
+
+    One process, the full loop: a DeepFM CTR model trains on a synthetic
+    clickstream (OnlineTrainer over the elastic Supervisor), publishing a
+    base + delta chain into a model repository every `interval` steps, while
+    a ModelServer serves the SAME model to concurrent HTTP clients and a
+    HotReloader lands each published version in the live engine. Proves:
+
+      - zero 5xx across >= `swaps_target` hot swaps under load;
+      - every response names the version that computed it, and each
+        client's observed version sequence is monotone;
+      - staleness stays under the contract bound (gauge sampled all run);
+      - bit-parity: for sampled versions k, an OFFLINE engine restored from
+        base+deltas(<=k) reproduces the served prediction exactly;
+      - sustained trainer rows/sec while serving.
+    """
+    import io as stdio  # noqa: F401  (kept for parity with serving bench)
+    import shutil
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.models.deepfm import deepfm
+    from paddle_tpu.observability import registry as _registry
+    from paddle_tpu.online import (
+        HotReloader,
+        ModelPublisher,
+        OnlineTrainer,
+        StalenessContract,
+        read_latest,
+    )
+    from paddle_tpu.resilience import async_ckpt as ac
+    from paddle_tpu.serving import ModelServer, ServingEngine
+
+    rows = 512 if smoke else 4096
+    fields, dim, batch = 4, 8, 32
+    interval = 5
+    swaps_target = 3 if smoke else 10
+    steps = interval * (swaps_target + 2)
+    contract = StalenessContract(max_staleness_steps=10 * interval)
+
+    work = tempfile.mkdtemp(prefix="online-bench-")
+    repo = os.path.join(work, "repo")
+    record = {
+        "metric": "online_learning",
+        "mode": "smoke" if smoke else "full",
+        "table_rows": rows,
+        "num_fields": fields,
+        "batch_size": batch,
+        "publish_interval": interval,
+        "max_staleness_steps": contract.max_staleness_steps,
+    }
+    try:
+        main_p, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+            ids = fluid.layers.data(
+                name="ids", shape=[fields, 1], dtype="int64"
+            )
+            label = fluid.layers.data(
+                name="label", shape=[1], dtype="float32"
+            )
+            loss, pred, _ = deepfm(
+                ids, label, num_features=rows, num_fields=fields,
+                embedding_size=dim, layer_sizes=(16,),
+                is_sparse=True, use_distributed=True,
+            )
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+        exe = fluid.Executor()
+        scope = Scope(seed=0)
+        model_dir = os.path.join(work, "model")
+        with scope_guard(scope):
+            exe.run(startup)
+            fluid.io.save_inference_model(
+                model_dir, ["ids"], [pred], exe, main_program=main_p
+            )
+
+        srv = ModelServer(port=0)
+        eng = srv.add_model(
+            "ctr", model_dir, batch_buckets=(1, 2, 4),
+            batcher_opts={"max_batch_delay_ms": 1.0},
+        )
+        serve_names = eng.param_names()
+        port = srv.start()
+        base_url = "http://127.0.0.1:%d" % port
+
+        trainer = OnlineTrainer(
+            exe, main_p, repo, serve_names,
+            publisher=ModelPublisher(
+                repo, max_chain=steps, contract=contract
+            ),
+            publish_interval=interval, scope=scope,
+        )
+        reloader = HotReloader(
+            repo, {"ctr": eng}, consumer="bench", poll_interval_s=0.02,
+            contract=contract,
+        ).start()
+
+        def stream():
+            rng = np.random.RandomState(11)
+            for _ in range(steps):
+                yield {
+                    "ids": rng.randint(
+                        0, rows, (batch, fields, 1)
+                    ).astype(np.int64),
+                    "label": (
+                        rng.rand(batch, 1) < 0.5
+                    ).astype(np.float32),
+                }
+
+        train_curve = []
+        train_wall = []
+
+        def train():
+            t0 = time.perf_counter()
+            train_curve.extend(
+                trainer.run(stream(), fetch_list=[loss.name])
+            )
+            train_wall.append(time.perf_counter() - t0)
+
+        payload = json.dumps({
+            "inputs": {
+                "ids": np.random.RandomState(5).randint(
+                    0, rows, (2, fields, 1)
+                ).tolist()
+            }
+        }).encode()
+        stop = threading.Event()
+        n_clients = 3
+        per_client = [[] for _ in range(n_clients)]  # (version, outputs)
+        errors_5xx, errors_other = [], []
+        staleness_seen = []
+
+        def client(i):
+            while not stop.is_set():
+                try:
+                    req = urllib.request.Request(
+                        base_url + "/v1/models/ctr:predict", data=payload,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    doc = json.load(urllib.request.urlopen(req, timeout=30))
+                    out = np.asarray(
+                        list(doc["outputs"].values())[0], np.float32
+                    )
+                    per_client[i].append((int(doc["model_version"]), out))
+                except urllib.error.HTTPError as e:
+                    (errors_5xx if e.code >= 500 else errors_other).append(e)
+                except Exception as e:
+                    errors_other.append(e)
+
+        def sample_staleness():
+            snap = _registry.default_registry().snapshot()
+            vals = snap.get("online/serving_staleness_steps", {})
+            for v in (vals.get("values") or {}).values():
+                staleness_seen.append(float(v))
+
+        tthread = threading.Thread(target=train, daemon=True)
+        cthreads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(n_clients)
+        ]
+        tthread.start()
+        for t in cthreads:
+            t.start()
+        while tthread.is_alive():
+            tthread.join(0.05)
+            sample_staleness()
+        # let the reloader land the final version, then stop the load
+        deadline = time.perf_counter() + 30
+        while time.perf_counter() < deadline:
+            latest = read_latest(repo)
+            if latest and reloader.applied_version == latest["version"]:
+                break
+            time.sleep(0.05)
+            sample_staleness()
+        time.sleep(0.2)  # a few requests against the final version
+        stop.set()
+        for t in cthreads:
+            t.join(30)
+        reloader.stop()
+        srv.stop(drain=True)
+
+        samples = [s for cs in per_client for s in cs]
+        versions = sorted({v for v, _ in samples})
+        for cs in per_client:  # served version is monotone per client
+            vs = [v for v, _ in cs]
+            assert vs == sorted(vs), "served version went backwards"
+        assert not errors_5xx, errors_5xx[:3]
+        assert reloader.reloads >= swaps_target, (
+            "only %d hot swaps" % reloader.reloads
+        )
+        latest = read_latest(repo)
+        assert latest and reloader.applied_version == latest["version"]
+        assert max(staleness_seen or [0.0]) <= contract.max_staleness_steps
+
+        # bit-parity: offline engine from base+deltas(<=k) == served output
+        by_version = {}
+        for v, out in samples:
+            by_version.setdefault(v, out)
+        check = [v for v in versions if v > 0][-4:]
+        feed = {
+            "ids": np.asarray(
+                json.loads(payload)["inputs"]["ids"], np.int64
+            )
+        }
+        parity = True
+        for k in check:
+            step_k, arrays, _info = ac.load_with_deltas(repo, upto_step=k)
+            assert step_k == k
+            off = ServingEngine(
+                model_dir, name="off%d" % k, batch_buckets=(1, 2, 4)
+            )
+            off.set_params(arrays, version=k)
+            (out_k,) = off.run(feed)
+            parity = parity and np.array_equal(
+                np.asarray(out_k, np.float32), by_version[k]
+            )
+        assert parity, "served prediction != offline base+delta replay"
+
+        wall = train_wall[0] if train_wall else float("nan")
+        pub = trainer.publisher.stats()
+        record.update({
+            "train_steps": trainer.steps,
+            "train_wall_s": round(wall, 3),
+            "rows_per_sec": round(trainer.steps * batch / wall, 1),
+            "loss_first": round(train_curve[0], 5) if train_curve else None,
+            "loss_last": round(train_curve[-1], 5) if train_curve else None,
+            "publishes": pub["published"],
+            "publish_throttled": pub["throttled"],
+            "delta_chain_len": pub["chain_len"],
+            "hot_swaps": reloader.reloads,
+            "reload_errors": reloader.errors,
+            "requests_total": len(samples),
+            "errors_5xx": len(errors_5xx),
+            "errors_other": len(errors_other),
+            "versions_served": versions,
+            "max_staleness_steps_observed": max(staleness_seen or [0.0]),
+            "parity_versions_checked": check,
+            "parity_bit_exact": bool(parity),
+        })
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return record
+
+
 def run_recovery_bench(smoke=False):
     """Elastic-recovery evidence pass (ISSUE 9 -> RECOVERY.json).
 
@@ -1932,6 +2182,21 @@ def main():
         if not smoke:
             out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "RECOVERY.json")
+            with open(out, "w") as f:
+                json.dump(rec, f, indent=1)
+        print(json.dumps(rec, indent=1))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "online":
+        # online-learning evidence pass (ISSUE 15): streaming DeepFM trainer
+        # publishing base+delta versions while a ModelServer hot-swaps them
+        # under client load — zero 5xx, bounded staleness, offline bit-
+        # parity; writes ONLINE.json next to this file ("smoke" shrinks the
+        # run, skips the tracked file)
+        smoke = "smoke" in sys.argv[2:]
+        rec = run_online_bench(smoke=smoke)
+        if not smoke:
+            out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "ONLINE.json")
             with open(out, "w") as f:
                 json.dump(rec, f, indent=1)
         print(json.dumps(rec, indent=1))

@@ -21,7 +21,7 @@ import numpy as np
 from . import framework, native
 from .executor import Executor, global_scope
 
-__all__ = ["AsyncExecutor"]
+__all__ = ["AsyncExecutor", "stream_batches"]
 
 
 def _bucket(n, buckets=(1, 2, 4, 8, 16, 32, 64, 128)):
@@ -50,6 +50,40 @@ def _assemble_batch(batch, used):
                 arr[i, : len(c)] = c
         feeds[slot.name] = arr
     return feeds
+
+
+def stream_batches(data_feed, filelist, thread_num=1, loop=False):
+    """Yield assembled feed dicts (name -> fixed-shape array) straight off
+    the native multi-slot feed — the unbounded-stream source an
+    online.OnlineTrainer consumes. `loop=True` restarts the file list each
+    time it drains, turning a finite clickstream dump into an endless
+    stream (each pass is a new feed instance, so file errors still raise
+    per pass)."""
+    used = data_feed.used_slots()
+    if not used:
+        raise ValueError("data_feed has no used slots (set_use_slots)")
+    bs = data_feed.batch_size
+    while True:
+        feed = native.MultiSlotDataFeed(
+            data_feed.native_slot_types(), queue_capacity=4 * bs
+        )
+        feed.start(list(filelist), nthreads=max(1, int(thread_num)))
+        batch = []
+        for sample in feed:
+            batch.append(sample)
+            if len(batch) == bs:
+                yield _assemble_batch(batch, used)
+                batch = []
+        if batch:
+            yield _assemble_batch(batch, used)
+        feed.join()
+        if feed.file_errors():
+            raise IOError(
+                "stream_batches: %d input files could not be opened"
+                % feed.file_errors()
+            )
+        if not loop:
+            return
 
 
 class _FileShardDecode:

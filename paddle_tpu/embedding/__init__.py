@@ -6,7 +6,7 @@ SelectedRows-style sparse gradients whose cost scales with touched rows, and
 per-row optimizer updates with row-sharded moments. See docs/embedding.md.
 """
 
-from .engine import EmbeddingEngine
+from .engine import EmbeddingEngine, engines_of
 from .lookup import sharded_embedding_lookup
 from .selected_rows import (
     ROW_SENTINEL,
@@ -19,6 +19,7 @@ from .selected_rows import (
 
 __all__ = [
     "EmbeddingEngine",
+    "engines_of",
     "sharded_embedding_lookup",
     "ROW_SENTINEL",
     "densify",

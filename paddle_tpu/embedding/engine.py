@@ -35,9 +35,16 @@ from ..framework import default_main_program
 from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 
-__all__ = ["EmbeddingEngine"]
+__all__ = ["EmbeddingEngine", "engines_of"]
 
 _MANIFEST = "EMBEDDING_MANIFEST.json"
+
+
+def engines_of(program):
+    """Every EmbeddingEngine built inside `program` (layers.distributed_embedding
+    constructs engines internally without returning them; the online trainer
+    discovers them here to wire touched-rows bookkeeping)."""
+    return list(getattr(program, "_embedding_engines", ()))
 
 
 def _registry():
@@ -99,6 +106,14 @@ class EmbeddingEngine:
             "^%s(_.*)?$" % _re.escape(self.table.name), (axis_name, None)
         )
         self.name = name if name is not None else self.table.name
+        # last-touched step per row, allocated lazily on the first
+        # note_touched (num_rows can be recsys-scale; pay only when the
+        # online delta path is in use). -1 = never touched.
+        self._last_touched = None
+        program = self.table.block.program
+        if not hasattr(program, "_embedding_engines"):
+            program._embedding_engines = []
+        program._embedding_engines.append(self)
         self._emit_static_gauges()
 
     # ------------------------------------------------------------------ build
@@ -121,6 +136,37 @@ class EmbeddingEngine:
         if getattr(ids, "_len_name", None):
             out._len_name = ids._len_name
         return out
+
+    # -------------------------------------------------- touched-row tracking
+    def touched_rows_var_name(self):
+        """The SelectedRows row-id var the sparse grad maker emits for this
+        table (`<table>@GRAD@ROWS`, ops/sparse_ops._lookup_grad_maker) —
+        fetch it alongside the loss to feed note_touched."""
+        from ..framework import grad_var_name
+        from .selected_rows import rows_var_name
+
+        return rows_var_name(grad_var_name(self.table.name))
+
+    def note_touched(self, step, rows):
+        """Record that `rows` (the fetched SelectedRows row ids, ROW_SENTINEL
+        and out-of-range padding slots tolerated) were updated at training
+        step `step`. O(ids) per step; the tracker is one int64 per table
+        row."""
+        rows = np.asarray(rows).reshape(-1)
+        if self._last_touched is None:
+            self._last_touched = np.full(self.num_rows, -1, np.int64)
+        valid = rows[(rows >= 0) & (rows < self.num_rows)]
+        if valid.size:
+            self._last_touched[valid] = int(step)
+
+    def touched_rows_since(self, step):
+        """Sorted row ids updated AFTER training step `step` (exclusive) —
+        the rows an incremental checkpoint delta must ship. Rows never noted
+        are never returned; an engine with no bookkeeping yet returns
+        empty."""
+        if self._last_touched is None:
+            return np.empty(0, np.int64)
+        return np.nonzero(self._last_touched > int(step))[0].astype(np.int64)
 
     # ------------------------------------------------------------- accounting
     def state_var_names(self, program=None):

@@ -22,7 +22,13 @@ Live view: `python tools/monitor.py <telemetry_dir>`.
 
 from . import export, opprof, registry, stepstats  # noqa: F401
 from .registry import Counter, Gauge, Histogram, MetricRegistry, default_registry
-from .stepstats import StepStats, StepStatsCollector, active, collector
+from .stepstats import (
+    StepStats,
+    StepStatsCollector,
+    active,
+    collector,
+    maybe_flush,
+)
 
 __all__ = [
     "Counter",
@@ -34,6 +40,7 @@ __all__ = [
     "StepStatsCollector",
     "active",
     "collector",
+    "maybe_flush",
     "registry",
     "stepstats",
     "export",

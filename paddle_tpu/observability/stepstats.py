@@ -341,3 +341,15 @@ def collector():
             if _collector is None:
                 _collector = StepStatsCollector()
     return _collector
+
+
+def maybe_flush():
+    """Flush a snapshot/Prometheus rewrite IF telemetry is on — the interval
+    clock for subsystems with no training step to ride (the serving batcher
+    every N dispatches, the online HotReloader after a swap). Never raises:
+    telemetry must not fail the caller's hot path."""
+    try:
+        if active():
+            collector().flush()
+    except Exception:
+        pass

@@ -1,0 +1,249 @@
+"""HotReloader — applies published versions to live serving engines.
+
+The reader half of the publish/reload protocol (docs/online.md): a daemon
+poll loop (or an explicit ``check_once()`` — the testable face) watches the
+model repository's LATEST.json pointer and, when it advances, lands the new
+version in every registered engine WITHOUT recompiling or dropping requests:
+
+- **incremental**: when the pointer stays on the engines' current base and
+  the delta chain links cleanly past the applied version, each pending delta
+  is replayed directly — dense params swap wholesale, touched table rows
+  scatter into a COPY of the live table (copy-on-publish; the in-flight
+  request keeps the old buffer) — one ``engine.set_params`` per version, so
+  the ``model_version`` served with each response is a real published
+  version, never a half-applied blend;
+- **full**: a base change (compaction), a chain gap, or a cold start falls
+  back to ``load_with_deltas`` — the same arrays an offline Predictor would
+  restore, which is exactly what the bench's bit-parity assert checks;
+- after catching up the reloader ACKs the version into the repository
+  (online.staleness.write_ack) — the trainer's throttle input — and updates
+  the ``online/serving_version`` + ``online/serving_staleness_steps`` /
+  ``_seconds`` gauges (scraped via the ModelServer's /metrics).
+
+Engines are anything with ``scope.vars``, ``set_params(updates, version=,
+stamp=)`` and a ``name`` — ServingEngine and GenerationEngine both qualify.
+A torn read (the publisher GC'ing underfoot) is counted, logged, and retried
+at the next poll; the engines keep serving the version they have.
+"""
+
+import threading
+import time
+import warnings
+
+import numpy as np
+
+from ..resilience import async_ckpt
+from . import publisher as _publisher
+from . import staleness as _staleness
+
+__all__ = ["HotReloader"]
+
+
+def _registry():
+    from ..observability.registry import default_registry
+
+    return default_registry()
+
+
+class HotReloader:
+    """Keep live engines at the model repository's newest version."""
+
+    def __init__(self, repo, engines, consumer="server", poll_interval_s=0.5,
+                 contract=None):
+        self.repo = repo
+        if isinstance(engines, dict):
+            self.engines = dict(engines)
+        else:
+            engines = list(engines)
+            self.engines = {e.name: e for e in engines}
+        if not self.engines:
+            raise ValueError("HotReloader needs at least one engine")
+        self.consumer = str(consumer)
+        self.poll_interval = float(poll_interval_s)
+        self.contract = contract or _staleness.StalenessContract()
+        self.applied_version = None
+        self.applied_base = None
+        self.applied_stamp = {}
+        self.reloads = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+        reg = _registry()
+        self._m_reloads = reg.counter(
+            "online/reloads", "published versions applied to live engines"
+        )
+        self._m_errors = reg.counter(
+            "online/reload_errors", "reload attempts that failed (retried)"
+        )
+        self._m_version = reg.gauge(
+            "online/serving_version", "version live in the engines, by model"
+        )
+        self._m_lag_steps = reg.gauge(
+            "online/serving_staleness_steps",
+            "training steps the served version trails the newest published",
+        )
+        self._m_lag_secs = reg.gauge(
+            "online/serving_staleness_seconds",
+            "publisher-stamp seconds the served version trails the newest",
+        )
+        reg.gauge(
+            "online/max_staleness_seconds",
+            "the staleness contract's serving budget",
+        ).set(self.contract.max_staleness_seconds)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        """Begin the daemon poll loop (idempotent)."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="hot-reloader", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=10.0):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout)
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.check_once()
+            except Exception:
+                # the poll loop must survive anything; check_once already
+                # counted what it could
+                self.errors += 1
+
+    # -------------------------------------------------------------- polling
+    def check_once(self):
+        """One poll: read the pointer, apply anything new, ack, update the
+        staleness gauges. Returns the number of versions applied (0 when
+        already current or the repository is unreadable)."""
+        with self._lock:
+            return self._check_locked()
+
+    def _check_locked(self):
+        pointer = _publisher.read_latest(self.repo)
+        if pointer is None:
+            return 0
+        latest = int(pointer["version"])
+        stamp = dict(pointer.get("stamp") or {})
+        if self.applied_version is not None and latest <= self.applied_version:
+            self._set_gauges(stamp)
+            return 0
+        try:
+            applied = self._apply_upto(pointer)
+        except (IOError, OSError, KeyError, ValueError) as e:
+            # publisher GC / a torn read underfoot: keep serving, retry
+            self.errors += 1
+            self._m_errors.inc()
+            warnings.warn("hot reload of version %d failed (%r); retrying"
+                          % (latest, e))
+            return 0
+        if applied:
+            self.reloads += applied
+            _staleness.write_ack(
+                self.repo, self.consumer, self.applied_version,
+                self.applied_stamp,
+            )
+            from ..observability import stepstats as _stepstats
+
+            _stepstats.maybe_flush()
+        self._set_gauges(stamp)
+        return applied
+
+    def _apply_upto(self, pointer):
+        latest = int(pointer["version"])
+        base_step = pointer.get("base_step")
+        chain = async_ckpt.resolve_delta_chain(self.repo, upto_step=latest)
+        if chain is None:
+            raise IOError("no recoverable base in %s" % self.repo)
+        rbase, _rdir, links = chain
+        incremental = (
+            self.applied_version is not None
+            and self.applied_base == rbase
+            and base_step == rbase
+            and (self.applied_version == rbase
+                 or any(s == self.applied_version for s, _ in links))
+        )
+        if incremental:
+            pending = [(s, d) for s, d in links if s > self.applied_version]
+            applied = 0
+            for step, delta_dir in pending:
+                self._apply_delta_live(step, delta_dir)
+                applied += 1
+            return applied
+        # cold start / base changed / gap: full restore, one swap per engine
+        loaded = async_ckpt.load_with_deltas(self.repo, upto_step=latest)
+        if loaded is None:
+            raise IOError("no loadable version in %s" % self.repo)
+        step, arrays, info = loaded
+        st = dict(info.get("stamp") or pointer.get("stamp") or {})
+        for engine in self.engines.values():
+            engine.set_params(arrays, version=step, stamp=st)
+        self.applied_version = int(step)
+        self.applied_base = info["base_step"]
+        self.applied_stamp = st
+        self._m_reloads.inc()
+        return 1
+
+    def _apply_delta_live(self, step, delta_dir):
+        """Replay one delta onto each engine's live buffers: seed apply_delta
+        with the engine's CURRENT table arrays (from its scope) so row
+        scatters land on what is actually being served — copy-on-publish
+        happens inside apply_delta."""
+        manifest = async_ckpt._read_manifest(delta_dir)
+        table_names = [
+            n for n, m in manifest["arrays"].items() if m["kind"] == "rows"
+        ]
+        for engine in self.engines.values():
+            seed = {}
+            for n in table_names:
+                cur = engine.scope.vars.get(n)
+                if cur is not None:
+                    seed[n] = np.asarray(cur)
+            _s, updated, mf = async_ckpt.apply_delta(delta_dir, seed)
+            updates = {
+                n: updated[n] for n in mf["arrays"] if n in updated
+            }
+            st = dict(mf.get("stamp") or {})
+            engine.set_params(updates, version=step, stamp=st)
+            self.applied_stamp = st
+        self.applied_version = int(step)
+        self.applied_base = manifest["base_step"]
+        self._m_reloads.inc()
+
+    # -------------------------------------------------------------- gauges
+    def _set_gauges(self, latest_stamp):
+        served = dict(self.applied_stamp or {})
+        lag_steps = max(
+            0,
+            int(latest_stamp.get("train_step", 0))
+            - int(served.get("train_step", 0)),
+        ) if served else 0
+        lag_secs = max(
+            0.0,
+            float(latest_stamp.get("wall_time", 0.0))
+            - float(served.get("wall_time", 0.0)),
+        ) if served else 0.0
+        for name, engine in self.engines.items():
+            self._m_version.set(
+                float(getattr(engine, "model_version", 0) or 0), model=name
+            )
+            self._m_lag_steps.set(float(lag_steps), model=name)
+            self._m_lag_secs.set(lag_secs, model=name)
+
+    def stats(self):
+        return {
+            "applied_version": self.applied_version,
+            "applied_base": self.applied_base,
+            "reloads": self.reloads,
+            "errors": self.errors,
+            "consumer": self.consumer,
+        }

@@ -42,6 +42,21 @@ re-places state via GSPMD).
 `AsyncCheckpointer` is the training-loop face: `save()` blocks only for the
 device→host copy (the measured step stall, resilience/ckpt_stall_ms) and a
 daemon writer does everything else off the step path.
+
+Incremental deltas (the online-learning format, docs/online.md): a
+`eckpt-delta-%08d` directory references a base eckpt and carries only the
+params that changed since its parent — dirty dense arrays whole, embedding
+tables as (touched row ids, touched row values) pairs keyed `<name>` +
+`<name>@rows`. Deltas form a chain base → d1 → d2 → … linked by
+`parent_step`; each link reuses the shard/commit/manifest-last ladder (no
+neighbor replicas: deltas are small and frequent, and losing one only costs
+staleness — the base checkpoint is the durability anchor).
+`resolve_delta_chain` returns the longest valid prefix, skipping a
+torn/manifest-less delta the same way `latest_valid_elastic` skips torn
+bases; `load_with_deltas` replays the chain into full arrays. Compaction is
+the writer's job: publish a fresh base once the chain exceeds its budget,
+then `gc_elastic_deltas` (manifest-first, like base GC) retires the stale
+chain.
 """
 
 import json
@@ -67,10 +82,21 @@ __all__ = [
     "latest_valid_elastic",
     "load_elastic",
     "list_elastic_checkpoints",
+    "write_elastic_delta",
+    "list_elastic_deltas",
+    "verify_elastic_delta",
+    "resolve_delta_chain",
+    "apply_delta",
+    "load_with_deltas",
+    "gc_elastic_deltas",
 ]
 
 MANIFEST = "MANIFEST.json"
 _ECKPT_RE = re.compile(r"^eckpt-(\d+)$")
+_DELTA_RE = re.compile(r"^eckpt-delta-(\d+)$")
+# npz key suffix for a table delta's touched-row-id array; the bare key holds
+# the touched rows' values. "@" keeps the pair out of any var namespace.
+ROWS_KEY = "@rows"
 
 
 def _registry():
@@ -456,6 +482,359 @@ def load_elastic(ckpt_dir):
 
             out[name] = jnp.asarray(out[name], dtype=jnp.bfloat16)
     return manifest["step"], out, manifest
+
+
+# ------------------------------------------------------ incremental deltas
+
+
+def _plan_delta(dense_shapes, rows_counts, num_hosts):
+    """Ownership plan for a delta's payload keys. Dense keys reuse
+    plan_host_ranges; each table's (values, @rows) pair splits over the SAME
+    touched-row ranges so a host's shard is self-contained (scattering host
+    h's values needs host h's ids)."""
+    plans = plan_host_ranges(dense_shapes, num_hosts)
+    for name in sorted(rows_counts):
+        n = int(rows_counts[name])
+        if n >= num_hosts > 1:
+            for h in range(num_hosts):
+                lo, hi = h * n // num_hosts, (h + 1) * n // num_hosts
+                plans[h][name] = [lo, hi]
+                plans[h][name + ROWS_KEY] = [lo, hi]
+        else:
+            owner = zlib.crc32(name.encode()) % num_hosts
+            plans[owner][name] = None
+            plans[owner][name + ROWS_KEY] = None
+    return plans
+
+
+def write_elastic_delta(
+    root,
+    step,
+    base_step,
+    parent_step,
+    dense,
+    rows=None,
+    num_hosts=1,
+    host_id=0,
+    cursor=None,
+    stamp=None,
+    barrier_timeout=None,
+):
+    """One host's contribution to incremental delta `step` on the chain
+    rooted at `base_step` (parent_step = the previous link, or base_step for
+    the first delta). `dense` maps name -> full dirty array; `rows` maps
+    table name -> (row_ids, row_values, full_shape). Same commit discipline
+    as the base format minus the neighbor replica; rank 0 runs the barrier
+    and publishes the manifest LAST, so a crash mid-write leaves a
+    manifest-less dir that resolve_delta_chain skips. Returns the delta
+    dir."""
+    if barrier_timeout is None:
+        from .. import flags as _flags
+
+        barrier_timeout = float(
+            _flags.get_flags("elastic_barrier_timeout_s")[
+                "elastic_barrier_timeout_s"]
+        )
+    rows = rows or {}
+    delta_dir = os.path.join(root, "eckpt-delta-%08d" % step)
+    os.makedirs(delta_dir, exist_ok=True)
+    payload = {}
+    meta = {}
+    rows_counts = {}
+    for n, a in dense.items():
+        stored, orig = _widen(a)
+        payload[n] = np.asarray(a)
+        meta[n] = {
+            "kind": "dense",
+            "shape": list(stored.shape),
+            "dtype": orig,
+            "stored_dtype": str(stored.dtype),
+        }
+    for n, (ids, vals, full_shape) in rows.items():
+        ids = np.asarray(ids, dtype=np.int64)
+        stored, orig = _widen(vals)
+        if stored.shape[:1] != ids.shape:
+            raise ValueError(
+                "table %r delta: %d row values for %d row ids"
+                % (n, stored.shape[0], ids.shape[0])
+            )
+        payload[n] = vals
+        payload[n + ROWS_KEY] = ids
+        rows_counts[n] = ids.shape[0]
+        meta[n] = {
+            "kind": "rows",
+            "shape": list(full_shape),
+            "dtype": orig,
+            "stored_dtype": str(stored.dtype),
+            "rows": int(ids.shape[0]),
+        }
+    dense_shapes = {n: np.asarray(a).shape for n, a in dense.items()}
+    plans = _plan_delta(dense_shapes, rows_counts, num_hosts)
+    files = {}
+    marker = _write_host_shard(delta_dir, host_id, num_hosts, payload,
+                               plans[host_id])
+    files[marker["file"]] = {"sha256": marker["sha256"],
+                             "size": marker["size"]}
+    _write_commit(delta_dir, host_id, files)
+    if host_id == 0:
+        _wait_commit_barrier(delta_dir, num_hosts, barrier_timeout)
+        all_files = {}
+        for h in range(num_hosts):
+            with open(os.path.join(delta_dir, _commit_file(h))) as f:
+                all_files.update(json.load(f)["files"])
+        manifest = {
+            "version": 1,
+            "kind": "delta",
+            "step": int(step),
+            "base_step": int(base_step),
+            "parent_step": int(parent_step),
+            "num_hosts": int(num_hosts),
+            "cursor": dict(cursor or {}),
+            "stamp": dict(stamp or {}),
+            "arrays": meta,
+            "ranges": [{n: r for n, r in plan.items()} for plan in plans],
+            "files": all_files,
+        }
+        faults.crash("manifest_crash", delta_dir)
+        _atomic_write(os.path.join(delta_dir, MANIFEST),
+                      json.dumps(manifest, indent=1))
+        try:
+            _registry().counter(
+                "resilience/delta_commits",
+                help="incremental checkpoint deltas committed",
+            ).inc()
+        except Exception:
+            pass
+    return delta_dir
+
+
+def list_elastic_deltas(root):
+    """[(step, dirpath)] of delta dirs, newest first."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _DELTA_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def _delta_source(delta_dir, manifest, h):
+    """Host h's checksum-verified delta shard, or None. Deltas have no
+    replicas — a torn shard makes the whole delta (and everything chained
+    past it) unusable, which costs only staleness."""
+    fname = _shard_file(h, manifest["num_hosts"])
+    meta = manifest["files"].get(fname)
+    if meta is None:
+        return None
+    path = os.path.join(delta_dir, fname)
+    try:
+        if (os.path.getsize(path) == meta["size"]
+                and _sha256(path) == meta["sha256"]):
+            return path
+    except OSError:
+        pass
+    return None
+
+
+def verify_elastic_delta(delta_dir):
+    """True iff the delta's manifest exists and every host's shard is
+    intact."""
+    try:
+        manifest = _read_manifest(delta_dir)
+    except (OSError, ValueError):
+        return False
+    if manifest.get("kind") != "delta":
+        return False
+    try:
+        return all(
+            _delta_source(delta_dir, manifest, h) is not None
+            for h in range(manifest["num_hosts"])
+        )
+    except (KeyError, TypeError):
+        return False
+
+
+def resolve_delta_chain(root, upto_step=None):
+    """(base_step, base_dir, [(step, delta_dir), ...]) — the newest valid
+    base at or below `upto_step` plus the longest valid chain of deltas
+    rooted at it (ascending, each link's parent_step matching the previous
+    step). A torn/manifest-less delta ends the chain THERE — later deltas
+    reference an unusable parent — exactly the skip discipline
+    latest_valid_elastic applies to torn bases. Returns None when no valid
+    base exists."""
+    base = None
+    for step, ckpt_dir in list_elastic_checkpoints(root):
+        if upto_step is not None and step > upto_step:
+            continue
+        if verify_elastic_checkpoint(ckpt_dir):
+            base = (step, ckpt_dir)
+            break
+        health.incr("ckpt_skipped_invalid")
+    if base is None:
+        return None
+    base_step, base_dir = base
+    chain = []
+    parent = base_step
+    for step, delta_dir in sorted(list_elastic_deltas(root)):
+        if step <= base_step:
+            continue
+        if upto_step is not None and step > upto_step:
+            break
+        try:
+            manifest = _read_manifest(delta_dir)
+        except (OSError, ValueError):
+            manifest = None
+        if manifest is None or not verify_elastic_delta(delta_dir):
+            health.incr("delta_skipped_invalid")
+            warnings.warn(
+                "skipping torn/manifest-less delta %s; chain ends at step %d"
+                % (delta_dir, parent)
+            )
+            break
+        if manifest.get("base_step") != base_step:
+            # a stale chain rooted at an older (or GC'd) base: not ours
+            continue
+        if manifest.get("parent_step") != parent:
+            warnings.warn(
+                "delta %s parents step %s but the chain is at %d — gap; "
+                "chain ends" % (delta_dir, manifest.get("parent_step"), parent)
+            )
+            break
+        chain.append((step, delta_dir))
+        parent = step
+    return base_step, base_dir, chain
+
+
+def apply_delta(delta_dir, arrays):
+    """Replay one delta onto a full name->array dict (from load_elastic or a
+    previous apply_delta): dense entries overwrite whole arrays, table
+    entries scatter touched-row values at their ids. Never mutates the input
+    dict's arrays — touched tables are copied first (the reader-side
+    copy-on-publish). Returns (step, new arrays dict, manifest)."""
+    manifest = _read_manifest(delta_dir)
+    meta = manifest["arrays"]
+    num_hosts = manifest["num_hosts"]
+
+    buffers = {}
+
+    def _buffer(key, shape, dtype):
+        if key not in buffers:
+            buffers[key] = np.empty(tuple(shape), dtype=np.dtype(dtype))
+        return buffers[key]
+
+    for h in range(num_hosts):
+        ranges = manifest["ranges"][h]
+        if not ranges:
+            continue
+        src = _delta_source(delta_dir, manifest, h)
+        if src is None:
+            raise IOError(
+                "delta %s: host %d shard missing or torn" % (delta_dir, h)
+            )
+        with np.load(src) as z:
+            for key, rng in ranges.items():
+                name = key[:-len(ROWS_KEY)] if key.endswith(ROWS_KEY) else key
+                m = meta[name]
+                if m["kind"] == "dense":
+                    buf = _buffer(key, m["shape"], m["stored_dtype"])
+                elif key.endswith(ROWS_KEY):
+                    buf = _buffer(key, (m["rows"],), "int64")
+                else:
+                    buf = _buffer(
+                        key, (m["rows"],) + tuple(m["shape"][1:]),
+                        m["stored_dtype"],
+                    )
+                if rng is None:
+                    buf[...] = np.asarray(z[key]).reshape(buf.shape)
+                else:
+                    buf[rng[0]:rng[1]] = z[key]
+
+    out = dict(arrays)
+    for name, m in meta.items():
+        if m["kind"] == "dense":
+            full = buffers[name]
+            if "bfloat16" in m["dtype"]:
+                import jax.numpy as jnp
+
+                out[name] = jnp.asarray(full, dtype=jnp.bfloat16)
+            else:
+                out[name] = full
+        else:
+            if name not in out:
+                raise KeyError(
+                    "delta %s updates rows of %r, absent from the base"
+                    % (delta_dir, name)
+                )
+            ids = buffers[name + ROWS_KEY]
+            vals = buffers[name]
+            base = np.array(np.asarray(out[name]))  # copy-on-publish
+            if list(base.shape) != list(m["shape"]):
+                raise ValueError(
+                    "delta %s: table %r is %s on disk but %s live"
+                    % (delta_dir, name, m["shape"], list(base.shape))
+                )
+            base[ids] = vals.astype(base.dtype)
+            out[name] = base
+    return manifest["step"], out, manifest
+
+
+def load_with_deltas(root, upto_step=None):
+    """Full arrays at the newest (or `upto_step`-bounded) published version:
+    load the base eckpt, then replay its valid delta chain in order. Returns
+    (step, arrays, info) where info records the chain walked and the last
+    link's manifest stamp — None when no valid base exists."""
+    found = resolve_delta_chain(root, upto_step=upto_step)
+    if found is None:
+        return None
+    base_step, base_dir, chain = found
+    step, arrays, manifest = load_elastic(base_dir)
+    stamp = dict(manifest.get("stamp") or {})
+    cursor = dict(manifest.get("cursor") or {})
+    for _s, delta_dir in chain:
+        step, arrays, manifest = apply_delta(delta_dir, arrays)
+        stamp = dict(manifest.get("stamp") or stamp)
+        cursor = dict(manifest.get("cursor") or cursor)
+    info = {
+        "base_step": base_step,
+        "base_dir": base_dir,
+        "deltas": [s for s, _ in chain],
+        "stamp": stamp,
+        "cursor": cursor,
+    }
+    return step, arrays, info
+
+
+def gc_elastic_deltas(root, keep_base_step=None, before_step=None):
+    """Retire delta dirs: those rooted at a different base than
+    `keep_base_step` (stale chains after a compaction) and/or those at or
+    below `before_step`. Manifest-first, like base GC — a GC killed
+    mid-rmtree leaves a manifest-less dir the chain walk already skips.
+    Returns the number of dirs removed."""
+    removed = 0
+    for step, delta_dir in list_elastic_deltas(root):
+        stale = False
+        if before_step is not None and step <= before_step:
+            stale = True
+        if keep_base_step is not None and not stale:
+            try:
+                manifest = _read_manifest(delta_dir)
+                stale = manifest.get("base_step") != int(keep_base_step)
+            except (OSError, ValueError):
+                stale = True  # torn dir: nothing can chain through it
+        if not stale:
+            continue
+        try:
+            os.unlink(os.path.join(delta_dir, MANIFEST))
+        except OSError:
+            pass
+        shutil.rmtree(delta_dir, ignore_errors=True)
+        removed += 1
+    return removed
 
 
 # --------------------------------------------------------- async front-end

@@ -61,6 +61,9 @@ class ServingFuture:
         self._done = threading.Event()
         self._outputs = None
         self._error = None
+        # which hot-swapped parameter version served this request (set by
+        # the dispatcher before _set_result; None until then / on error)
+        self.model_version = None
 
     def _set_result(self, outputs):
         self._outputs = outputs
@@ -283,18 +286,18 @@ class ContinuousBatcher:
                 req.future._set_error(err)
             return
         done = time.perf_counter()
+        # which hot-swapped version the engine call above ran on: read on
+        # THIS (dispatcher) thread, where the engine recorded it
+        served = getattr(self.engine, "last_served_version", None)
+        version = served() if callable(served) else None
         if self._batches_dispatched % 32 == 0:
             # periodic telemetry snapshot (flag-gated inside stepstats):
             # serving has no training step to ride, so the batcher is the
             # interval clock that lands serving/* metrics in the JSONL
             # shards tools/monitor.py reads
-            try:
-                from ..observability import stepstats as _stepstats
+            from ..observability import stepstats as _stepstats
 
-                if _stepstats.active():
-                    _stepstats.collector().flush()
-            except Exception:
-                pass
+            _stepstats.maybe_flush()
         lo = 0
         total = sum(r.rows for r in live)
         for req in live:
@@ -307,6 +310,7 @@ class ContinuousBatcher:
             lo += req.rows
             self._m_latency_ms.observe((done - req.t_submit) * 1e3)
             self._m_requests.inc(outcome="ok")
+            req.future.model_version = version
             req.future._set_result(part)
 
     # ---- lifecycle --------------------------------------------------------

@@ -75,6 +75,13 @@ def variant_key(fingerprint, feed_avals, fetch_names, state_avals=None,
     without necessarily changing any feed shape, so leaving them out of the
     key would let a config flip replay a stale executable against a
     differently-shaped pool.
+
+    Parameter VALUES are deliberately absent: the key hashes the program
+    fingerprint and avals only. That asymmetry is the hot-swap contract
+    (docs/online.md) — ServingEngine.set_params replaces param values with
+    same-aval arrays, so every cached variant (and the in-process compiled
+    set) stays valid across an online-learning swap; only a geometry/program
+    change misses.
     """
     jax_v, jaxlib_v, platform = _versions()
     doc = {

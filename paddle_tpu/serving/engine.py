@@ -123,6 +123,16 @@ class ServingEngine:
                 program, self.feed_names, self.fetch_names, self.scope
             )
 
+        # hot-swap state (docs/online.md): set_params atomically replaces
+        # the _ro/_mut dict OBJECTS under _swap_lock; _run_bucket snapshots
+        # (ro, mut, version) under the same lock, so an in-flight call
+        # finishes on the params it started with and a swap never waits on
+        # device work. version 0 = as-loaded from disk.
+        self.model_version = 0
+        self.version_stamp = {}
+        self._swap_lock = threading.Lock()
+        self._served_tls = threading.local()
+
         buckets = batch_buckets or DEFAULT_BATCH_BUCKETS
         self.batch_buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not self.batch_buckets or self.batch_buckets[0] < 1:
@@ -161,6 +171,13 @@ class ServingEngine:
         self._m_variants = reg.gauge(
             p + "/variants", "compiled serving variants resident"
         )
+        self._m_version = reg.gauge(
+            p + "/model_version", "live hot-swapped parameter version"
+        )
+        self._m_swaps = reg.counter(
+            p + "/hot_swaps", "set_params hot swaps applied"
+        )
+        self._m_version.set(0.0)
 
     # ---- bucketing --------------------------------------------------------
     def bucket_batch(self, n):
@@ -299,6 +316,64 @@ class ServingEngine:
             self._variant(avals)
         return len(self._variants)
 
+    # ---- hot swap ---------------------------------------------------------
+    def param_names(self):
+        """Every live parameter/state name a hot swap may target."""
+        with self._swap_lock:
+            return sorted(set(self._ro) | set(self._mut))
+
+    def set_params(self, updates, version=None, stamp=None):
+        """Hot-swap parameter values WITHOUT recompiling or dropping
+        requests. `updates` maps name -> new full array; names the lowering
+        doesn't close over are ignored (a publisher may ship a superset).
+        Values are cast to the stored dtype; a shape mismatch raises — a
+        geometry change would invalidate every compiled variant, which is a
+        new model, not a swap (compile_cache.variant_key hashes avals, never
+        values, so same-aval swaps keep the cache and variants valid).
+
+        The swap is two dict replacements under _swap_lock — O(params)
+        host-side pointer updates, no device sync. Returns the number of
+        arrays applied."""
+        import jax.numpy as jnp
+
+        new_ro = dict(self._ro)
+        new_mut = dict(self._mut)
+        applied = 0
+        for name, val in updates.items():
+            tgt = new_ro if name in new_ro else (
+                new_mut if name in new_mut else None
+            )
+            if tgt is None:
+                continue
+            old = tgt[name]
+            arr = jnp.asarray(np.asarray(val), dtype=old.dtype)
+            if tuple(arr.shape) != tuple(np.shape(old)):
+                raise ValueError(
+                    "set_params(%r): shape %s != lowered aval %s — geometry "
+                    "changes need a model reload, not a hot swap"
+                    % (name, tuple(arr.shape), tuple(np.shape(old)))
+                )
+            tgt[name] = arr
+            self.scope.vars[name] = arr
+            applied += 1
+        with self._swap_lock:
+            self._ro = new_ro
+            self._mut = new_mut
+            self.model_version = (
+                int(version) if version is not None else self.model_version + 1
+            )
+            self.version_stamp = dict(stamp or {})
+            ver = self.model_version
+        self._m_version.set(float(ver))
+        self._m_swaps.inc()
+        return applied
+
+    def last_served_version(self):
+        """The model_version the CALLING thread's most recent engine call
+        executed against (the batcher's dispatcher reads this right after
+        run() to stamp each response)."""
+        return getattr(self._served_tls, "version", self.model_version)
+
     # ---- serving ----------------------------------------------------------
     def run(self, feed):
         """Serve one feed dict (or list zipped with feed_names): pad to the
@@ -367,8 +442,14 @@ class ServingEngine:
         bucket = next(iter(padded.values())).shape[0]
 
         fn = self._variant(avals)
+        # snapshot the param dicts + version together: a concurrent
+        # set_params replaces the dict objects, so this call runs entirely
+        # on one coherent version and reports it faithfully
+        with self._swap_lock:
+            ro, mut, ver = self._ro, self._mut, self.model_version
         t0 = time.perf_counter()
-        outs = fn(padded, self._ro, self._mut)
+        outs = fn(padded, ro, mut)
+        self._served_tls.version = ver
         outs = [np.asarray(o) for o in outs]
         self._m_device_ms.observe((time.perf_counter() - t0) * 1e3)
         self._m_rows.inc(n)
@@ -387,6 +468,7 @@ class ServingEngine:
             "traces": self.traces,
             "cache_hits": self.cache_hits,
             "trailing_pad": self.trailing_pad,
+            "model_version": self.model_version,
         }
         if self.cache is not None:
             out["cache"] = self.cache.stats()

@@ -267,6 +267,18 @@ class GenerationEngine:
         self._m_prefill_ms = reg.histogram(
             p + "/gen_prefill_ms", "one prefill call, wall ms"
         )
+        # hot-swap state (docs/online.md): each _Variant holds its own ro
+        # dict; set_params swaps them (and the scope) under _swap_lock.
+        self.model_version = 0
+        self.version_stamp = {}
+        self._swap_lock = threading.Lock()
+        self._m_version = reg.gauge(
+            p + "/model_version", "live hot-swapped parameter version"
+        )
+        self._m_swaps = reg.counter(
+            p + "/hot_swaps", "set_params hot swaps applied"
+        )
+        self._m_version.set(0.0)
 
     # ---- geometry / cache keys --------------------------------------------
     def geometry(self):
@@ -382,6 +394,49 @@ class GenerationEngine:
         for b in self.prefill_buckets:
             self._variant("prefill:%d" % b)
         return len(self._variants)
+
+    # ---- hot swap ---------------------------------------------------------
+    def set_params(self, updates, version=None, stamp=None):
+        """Hot-swap parameter values without recompiling or dropping
+        requests. KV-pool state names (self._state) never swap — a publisher
+        shipping them by accident must not clobber live caches. Each
+        variant's ro dict is replaced wholesale (one attribute store;
+        _call reads variant.ro exactly once per step, so an in-flight decode
+        step finishes coherently on the old params) and the scope is updated
+        so variants built later capture the new values. Returns the number
+        of arrays applied."""
+        import jax.numpy as jnp
+
+        with self._swap_lock:
+            conv = {}
+            for name, val in updates.items():
+                if name in self._state:
+                    continue
+                cur = self.scope.vars.get(name)
+                if cur is None:
+                    continue
+                arr = jnp.asarray(np.asarray(val), dtype=np.asarray(cur).dtype)
+                if tuple(arr.shape) != tuple(np.shape(cur)):
+                    raise ValueError(
+                        "set_params(%r): shape %s != live %s — geometry "
+                        "changes need a model reload, not a hot swap"
+                        % (name, tuple(arr.shape), tuple(np.shape(cur)))
+                    )
+                conv[name] = arr
+            for v in self._variants.values():
+                if any(n in v.ro for n in conv):
+                    nro = dict(v.ro)
+                    nro.update({n: a for n, a in conv.items() if n in v.ro})
+                    v.ro = nro
+            self.scope.vars.update(conv)
+            self.model_version = (
+                int(version) if version is not None else self.model_version + 1
+            )
+            self.version_stamp = dict(stamp or {})
+            ver = self.model_version
+        self._m_version.set(float(ver))
+        self._m_swaps.inc()
+        return len(conv)
 
     def _call(self, variant, np_feeds):
         feeds = {}
@@ -547,6 +602,7 @@ class GenerationEngine:
             "variants": len(self._variants),
             "traces": self.traces,
             "cache_hits": self.cache_hits,
+            "model_version": self.model_version,
             "tokens_generated": self.tokens_generated,
             "prefill_buckets": list(self.prefill_buckets),
             "geometry": self.geometry(),

@@ -21,6 +21,11 @@ Routes:
 - ``GET /healthz`` — 200 once every model's engine is constructed; body
   lists models and variant counts.
 - ``GET /v1/models`` — model metadata (feeds, fetches, buckets, stats).
+- ``GET /v1/models/<name>`` — one model's metadata plus its live hot-swap
+  state: ``model_version`` and the publisher's ``version_stamp`` (train
+  step + wall time). Predict/generate replies carry ``model_version`` too —
+  which hot-swapped version served THAT request (docs/online.md); the
+  serving_staleness gauges ride ``/metrics``.
 - ``GET /metrics`` — the PR 4 registry's Prometheus text exposition (same
   content observability/export.py writes to the scrape file).
 
@@ -140,6 +145,12 @@ class ModelServer:
                         self._reply_json(200, server._healthz())
                     elif self.path == "/v1/models":
                         self._reply_json(200, server._describe())
+                    elif (self.path.startswith(PREDICT_PREFIX)
+                          and ":" not in self.path):
+                        code, obj = server._describe_one(
+                            self.path[len(PREDICT_PREFIX):]
+                        )
+                        self._reply_json(code, obj)
                     elif self.path == "/metrics":
                         self._reply(
                             200,
@@ -203,23 +214,36 @@ class ModelServer:
         }
 
     def _describe(self):
-        out = {}
-        for name, h in self._models.items():
-            if h.kind == "generate":
-                out[name] = {
-                    "kind": "generate",
-                    "stats": h.engine.stats(),
-                    "scheduler": h.batcher.stats(),
-                }
-            else:
-                out[name] = {
-                    "feeds": h.engine.feed_names,
-                    "fetches": h.engine.fetch_names,
-                    "batch_buckets": list(h.engine.batch_buckets),
-                    "stats": h.engine.stats(),
-                    "batcher": h.batcher.stats(),
-                }
-        return out
+        return {name: self._describe_one(name)[1] for name in self._models}
+
+    def _describe_one(self, name):
+        """(status, body) for GET /v1/models/<name>: the model's metadata
+        plus its live hot-swap state — model_version and the publisher's
+        staleness stamp (train step + wall time of the serving version)."""
+        h = self._models.get(name)
+        if h is None:
+            return 404, {
+                "error": "unknown model %r (have %s)" % (name, self.models())
+            }
+        if h.kind == "generate":
+            out = {
+                "kind": "generate",
+                "stats": h.engine.stats(),
+                "scheduler": h.batcher.stats(),
+            }
+        else:
+            out = {
+                "feeds": h.engine.feed_names,
+                "fetches": h.engine.fetch_names,
+                "batch_buckets": list(h.engine.batch_buckets),
+                "stats": h.engine.stats(),
+                "batcher": h.batcher.stats(),
+            }
+        out["model_version"] = getattr(h.engine, "model_version", 0)
+        stamp = getattr(h.engine, "version_stamp", None)
+        if stamp:
+            out["version_stamp"] = dict(stamp)
+        return 200, out
 
     def _predict(self, path, content_type, body):
         """(status, reply bytes, content type) for one predict/generate
@@ -280,6 +304,9 @@ class ModelServer:
             return 500, json.dumps({"error": repr(e)}).encode(), \
                 "application/json"
         latency_ms = (time.perf_counter() - t0) * 1e3
+        version = getattr(future, "model_version", None)
+        if version is None:
+            version = getattr(hosted.engine, "model_version", 0)
 
         if as_npz:
             buf = _stdio.BytesIO()
@@ -301,6 +328,7 @@ class ModelServer:
                     else np.asarray(o).tolist()
                     for n, o in zip(hosted.engine.fetch_names, outs)
                 },
+                "model_version": version,
                 "latency_ms": latency_ms,
             }
         ).encode(), "application/json"
@@ -353,6 +381,9 @@ class ModelServer:
                 "tokens": list(res.tokens),
                 "finish_reason": res.finish_reason,
                 "prompt_len": res.prompt_len,
+                # the live version at completion time (token-level attribution
+                # across a mid-request swap is meaningless for AR decode)
+                "model_version": getattr(hosted.engine, "model_version", 0),
                 "latency_ms": (time.perf_counter() - t0) * 1e3,
             }
         ).encode(), "application/json"

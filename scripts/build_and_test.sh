@@ -635,6 +635,31 @@ print("fluidlint smoke ok: zoo clean, gate bit-transparent "
       "(%d verifications)" % verifies)
 PY
 
+echo "== online smoke (docs/online.md) =="
+# the full online-learning loop in one process: a DeepFM trainer streams
+# synthetic clickstream batches and publishes base+delta versions into a
+# model repository while a ModelServer serves the same model under
+# concurrent client load and a HotReloader hot-swaps each version in.
+# Asserts: zero 5xx across >= 3 swaps, served-version monotonicity,
+# staleness within the contract bound, and bit-parity between the served
+# prediction at version k and an offline engine restored from
+# base+deltas(<=k) (all asserted inside run_online_bench)
+JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+sys.path.insert(0, ".")
+from bench import run_online_bench
+rec = run_online_bench(smoke=True)
+assert rec["errors_5xx"] == 0, rec
+assert rec["hot_swaps"] >= 3, rec
+assert rec["max_staleness_steps_observed"] <= rec["max_staleness_steps"], rec
+assert rec["parity_bit_exact"], "served != offline base+delta replay"
+print("online smoke ok: %d swaps, %d requests, 0 5xx, staleness<=%g, "
+      "parity@%s bit-exact, %.0f rows/s while serving"
+      % (rec["hot_swaps"], rec["requests_total"],
+         rec["max_staleness_steps_observed"],
+         rec["parity_versions_checked"], rec["rows_per_sec"]))
+PY
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

@@ -1,0 +1,400 @@
+"""Online learning loop (paddle_tpu/online/, docs/online.md): delta
+checkpoint round-trip (incl. bf16 widening parity), chain resolution past
+torn deltas, compaction GC, touched-rows-only delta shards, hot-swap under
+concurrent HTTP clients with version increments, base+delta bit-parity
+against an uninterrupted trainer, a trainer killed mid-publish leaving a
+loadable chain, and the staleness throttle."""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import framework
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.embedding import engines_of
+from paddle_tpu.models.deepfm import deepfm
+from paddle_tpu.online import (
+    HotReloader,
+    ModelPublisher,
+    OnlineTrainer,
+    StalenessContract,
+    read_latest,
+    write_ack,
+)
+from paddle_tpu.resilience import async_ckpt as ac
+from paddle_tpu.resilience import faults, health
+from paddle_tpu.serving import ModelServer, ServingEngine
+
+
+def _arrays(seed, rows=12, dim=3):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": rng.rand(4, dim).astype(np.float32),
+        "tbl": rng.rand(rows, dim).astype(np.float32),
+    }
+
+
+# --------------------------------------------------------------------------
+# delta format
+# --------------------------------------------------------------------------
+
+
+def test_delta_roundtrip_compaction_and_bf16(tmp_path):
+    """Base + two chained deltas reassemble bit-exact; a bf16 dense param
+    survives the widen/narrow cycle losslessly; compaction GC retires the
+    chain manifest-first."""
+    import jax.numpy as jnp
+
+    root = str(tmp_path)
+    base = _arrays(0)
+    base["w"] = jnp.asarray(base["w"], jnp.bfloat16)
+    ac.write_elastic_checkpoint(root, base, 10)
+
+    # delta 12: rows 3, 5 of tbl + the bf16 dense param
+    t12 = np.array(np.asarray(base["tbl"]))
+    t12[[3, 5]] += 1
+    w12 = jnp.asarray(np.asarray(base["w"], np.float32) * 2, jnp.bfloat16)
+    ac.write_elastic_delta(
+        root, 12, 10, 10, {"w": w12},
+        {"tbl": (np.array([3, 5]), t12[[3, 5]], list(t12.shape))},
+    )
+    # delta 14: rows 5, 7 (5 overlaps — later delta wins)
+    t14 = t12.copy()
+    t14[[5, 7]] -= 2
+    ac.write_elastic_delta(
+        root, 14, 10, 12, {},
+        {"tbl": (np.array([5, 7]), t14[[5, 7]], list(t14.shape))},
+    )
+
+    step, arrays, info = ac.load_with_deltas(root)
+    assert (step, info["base_step"], info["deltas"]) == (14, 10, [12, 14])
+    assert str(np.asarray(arrays["w"]).dtype) == "bfloat16"
+    np.testing.assert_array_equal(
+        np.asarray(arrays["w"], np.float32), np.asarray(w12, np.float32)
+    )
+    np.testing.assert_array_equal(arrays["tbl"], t14)
+
+    # upto_step replays a prefix of the chain — the parity tool's view
+    step, arrays, _ = ac.load_with_deltas(root, upto_step=12)
+    assert step == 12
+    np.testing.assert_array_equal(arrays["tbl"], t12)
+
+    # compaction: a new base at 14 makes the old chain garbage
+    ac.write_elastic_checkpoint(root, dict(arrays, tbl=t14), 14)
+    removed = ac.gc_elastic_deltas(root, keep_base_step=14)
+    assert removed == 2
+    assert ac.resolve_delta_chain(root)[0] == 14
+    assert ac.load_with_deltas(root)[0] == 14
+
+
+def test_torn_delta_ends_chain_not_recovery(tmp_path):
+    """A manifest-less delta dir is skipped (chain ends at the previous
+    link) and never confuses base recovery; health counts the skip."""
+    root = str(tmp_path)
+    ac.write_elastic_checkpoint(root, _arrays(1), 5)
+    ac.write_elastic_delta(root, 7, 5, 5, {"w": _arrays(2)["w"]}, {})
+    faults.install("manifest_crash:step=1")
+    before = health.get("delta_skipped_invalid")
+    with pytest.raises(faults.InjectedFault):
+        ac.write_elastic_delta(root, 9, 5, 7, {"w": _arrays(3)["w"]}, {})
+    faults.install(None)
+    torn = os.path.join(root, "eckpt-delta-00000009")
+    assert os.path.isdir(torn) and not os.path.exists(
+        os.path.join(torn, ac.MANIFEST)
+    )
+    with pytest.warns(UserWarning, match="torn/manifest-less delta"):
+        base_step, _, chain = ac.resolve_delta_chain(root)
+    assert (base_step, [s for s, _ in chain]) == (5, [7])
+    assert health.get("delta_skipped_invalid") > before
+    # base recovery ignores delta dirs entirely
+    assert ac.latest_valid_elastic(root)[0] == 5
+    # a retried publish of step 9 rewrites the torn dir cleanly
+    ac.write_elastic_delta(root, 9, 5, 7, {"w": _arrays(3)["w"]}, {})
+    assert [s for s, _ in ac.resolve_delta_chain(root)[2]] == [7, 9]
+
+
+def test_untouched_rows_absent_from_delta_shard(tmp_path):
+    """The SelectedRows touched-rows bookkeeping keeps a delta's table shard
+    to exactly the rows the optimizer wrote — untouched row ids never appear
+    in the shard's id vector."""
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[2, 1], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+        loss, _, _ = deepfm(
+            ids, label, num_features=64, num_fields=2, embedding_size=4,
+            layer_sizes=(8,), is_sparse=True, use_distributed=True,
+        )
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    engines = engines_of(main)
+    assert engines, "sparse deepfm should register embedding engines"
+    emb = next(e for e in engines if e.table.name == "fm_emb")
+    rows_var = emb.touched_rows_var_name()
+    assert rows_var in main.global_block().vars
+
+    exe = fluid.Executor()
+    touched_ids = np.array([[3], [9]], np.int64)
+    feed = {
+        "ids": np.tile(touched_ids, (4, 1, 1)),
+        "label": np.ones((4, 1), np.float32),
+    }
+    with scope_guard(Scope(seed=0)):
+        exe.run(startup)
+        _, rows = exe.run(main, feed=feed, fetch_list=[loss.name, rows_var])
+        emb.note_touched(1, np.asarray(rows))
+        table = np.asarray(
+            fluid.executor.global_scope().find_var("fm_emb")
+        ).copy()
+    got = emb.touched_rows_since(0)
+    assert set(got.tolist()) == {3, 9}
+    assert emb.touched_rows_since(1).size == 0  # nothing after step 1
+
+    ac.write_elastic_delta(
+        str(tmp_path), 2, 1, 1, {},
+        {"fm_emb": (got, table[got], list(table.shape))},
+    )
+    d = os.path.join(str(tmp_path), "eckpt-delta-00000002")
+    manifest = json.load(open(os.path.join(d, ac.MANIFEST)))
+    assert manifest["arrays"]["fm_emb"]["rows"] == 2
+    shard = np.load(os.path.join(d, next(iter(manifest["files"]))))
+    stored = shard["fm_emb" + ac.ROWS_KEY]
+    assert set(stored.tolist()) == {3, 9}  # and nothing else
+
+
+# --------------------------------------------------------------------------
+# hot swap
+# --------------------------------------------------------------------------
+
+
+def _save_mlp(tmp_path, name, prefix):
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="%s_x" % prefix, shape=[6],
+                              dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        y = fluid.layers.fc(input=h, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    model_dir = str(tmp_path / name)
+    with scope_guard(Scope(seed=3)):
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            model_dir, ["%s_x" % prefix], [y], exe, main_program=main
+        )
+    return model_dir, "%s_x" % prefix
+
+
+def test_hot_swap_under_concurrent_clients_zero_errors(tmp_path):
+    """Clients hammer :predict while set_params swaps repeatedly: zero
+    failed requests, no hot-path recompiles, and the served model_version
+    strictly increases across swaps (each response names a real version)."""
+    model_dir, xname = _save_mlp(tmp_path, "hs", "hs")
+    srv = ModelServer(port=0)
+    eng = srv.add_model(
+        "hot", model_dir, batch_buckets=(1, 2, 4),
+        batcher_opts={"max_batch_delay_ms": 1.0},
+    )
+    port = srv.start()
+    base = "http://127.0.0.1:%d" % port
+    stop = threading.Event()
+    errors = []
+    per_client = [[] for _ in range(4)]
+
+    def client(i):
+        while not stop.is_set():
+            try:
+                req = urllib.request.Request(
+                    base + "/v1/models/hot:predict",
+                    data=json.dumps(
+                        {"inputs": {xname: np.ones((1 + i % 2, 6)).tolist()}}
+                    ).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                doc = json.load(urllib.request.urlopen(req, timeout=30))
+                per_client[i].append(int(doc["model_version"]))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        traces0 = eng.traces
+        params = {n: np.asarray(eng.scope.vars[n]) for n in eng.param_names()}
+        swaps = 10
+        for k in range(1, swaps + 1):
+            applied = eng.set_params(
+                {n: v * (1.0 + 0.01 * k) for n, v in params.items()},
+                version=k, stamp={"train_step": k},
+            )
+            assert applied == len(params)
+        deadline = 200
+        while sum(map(len, per_client)) < 50 and deadline:
+            stop.wait(0.05)
+            deadline -= 1
+        # the describe route exposes the same version (while still serving)
+        doc = json.load(urllib.request.urlopen(base + "/v1/models/hot"))
+        assert doc["model_version"] == swaps
+        assert doc["version_stamp"]["train_step"] == swaps
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30)
+        srv.stop(drain=True)
+    assert not errors, errors
+    assert eng.traces == traces0, "hot swap recompiled"
+    assert eng.model_version == swaps
+    versions = [v for vs in per_client for v in vs]
+    assert max(versions) == swaps  # clients observed the final version
+    for vs in per_client:  # each client's view only moves forward
+        assert vs == sorted(vs)
+
+
+def test_set_params_rejects_geometry_change(tmp_path):
+    model_dir, _ = _save_mlp(tmp_path, "gm", "gm")
+    eng = ServingEngine(model_dir, name="gm", batch_buckets=(1,))
+    name = eng.param_names()[0]
+    bad = np.zeros(np.asarray(eng.scope.vars[name]).shape + (1,), np.float32)
+    with pytest.raises(ValueError, match="hot swap"):
+        eng.set_params({name: bad})
+
+
+# --------------------------------------------------------------------------
+# trainer / publisher / reloader
+# --------------------------------------------------------------------------
+
+
+def _ctr_program(rows=64, fields=2):
+    main, startup = framework.Program(), framework.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[fields, 1], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+        loss, pred, _ = deepfm(
+            ids, label, num_features=rows, num_fields=fields,
+            embedding_size=4, layer_sizes=(8,), is_sparse=True,
+            use_distributed=True,
+        )
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss, pred
+
+
+def _ctr_stream(n, rows=64, fields=2, batch=8, seed=11):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        ids = rng.randint(0, rows, (batch, fields, 1)).astype(np.int64)
+        label = (rng.rand(batch, 1) < 0.5).astype(np.float32)
+        yield {"ids": ids, "label": label}
+
+
+def _serve_names(program):
+    from paddle_tpu.io import _is_persistable
+
+    return [
+        v.name for v in program.list_vars()
+        if _is_persistable(v) and "@" not in v.name
+        and not v.name.startswith("learning_rate")
+        and "_moment" not in v.name and "beta" not in v.name
+    ]
+
+
+def test_base_plus_deltas_match_uninterrupted_trainer(tmp_path):
+    """Replaying base+deltas(<=k) reproduces the uninterrupted trainer's
+    params at step k BIT-exactly — the offline-parity leg of the bench."""
+    steps, interval = 12, 4
+    main, startup, loss, _ = _ctr_program()
+    repo = str(tmp_path / "repo")
+    scope = Scope(seed=5)
+    with scope_guard(scope):
+        tr = OnlineTrainer(
+            fluid.Executor(), main, repo, _serve_names(main),
+            publish_interval=interval,
+        )
+        tr.resume(startup)
+        tr.run(_ctr_stream(steps), fetch_list=[loss.name])
+        assert tr.publisher.published == steps // interval
+        live = {
+            n: np.asarray(scope.find_var(n)).copy()
+            for n in tr.serve_names
+        }
+    # newest version == live params, bit-exact, dense AND table
+    step, arrays, _ = ac.load_with_deltas(repo)
+    assert step == steps
+    for n, v in live.items():
+        np.testing.assert_array_equal(np.asarray(arrays[n]), v, err_msg=n)
+    # an intermediate version also resolves (the parity-at-k property)
+    mid = read_latest(repo)["version"] - interval
+    assert ac.load_with_deltas(repo, upto_step=mid)[0] == mid
+
+
+def test_reloader_tracks_publisher_incrementally(tmp_path):
+    """HotReloader applies each published delta to a live ServingEngine and
+    the served outputs change accordingly; acks land in the repo."""
+    model_dir, xname = _save_mlp(tmp_path, "rl", "rl")
+    eng = ServingEngine(model_dir, name="rl", batch_buckets=(2,))
+    repo = str(tmp_path / "repo")
+    pub = ModelPublisher(repo)
+    reloader = HotReloader(repo, [eng], consumer="t")
+
+    params = {n: np.asarray(eng.scope.vars[n]).copy()
+              for n in eng.param_names()}
+    feed = {xname: np.ones((2, 6), np.float32)}
+    (out0,) = eng.run(feed)
+
+    pub.publish(params, 1)
+    assert reloader.check_once() == 1 and eng.model_version == 1
+    (out1,) = eng.run(feed)
+    np.testing.assert_array_equal(out0, out1)  # same values republished
+
+    pub.publish({n: v * 1.5 for n, v in params.items()}, 2)
+    assert reloader.check_once() == 1 and eng.model_version == 2
+    (out2,) = eng.run(feed)
+    assert not np.array_equal(np.asarray(out1), np.asarray(out2))
+    assert reloader.check_once() == 0  # idempotent when current
+    ack = json.load(open(os.path.join(repo, "ack-t.json")))
+    assert ack["version"] == 2
+
+
+def test_trainer_killed_mid_publish_leaves_loadable_chain(tmp_path):
+    """A publish torn before its manifest (the SIGKILL window) leaves the
+    previous version fully loadable and the pointer never names the torn
+    step; the retried publish commits cleanly."""
+    repo = str(tmp_path)
+    pub = ModelPublisher(repo)
+    a1 = _arrays(4)
+    pub.publish(a1, 1)
+    a2 = {n: v + 1 for n, v in a1.items()}
+    faults.install("manifest_crash:step=1")
+    with pytest.raises(faults.InjectedFault):
+        pub.publish(a2, 2, touched={"tbl": np.array([0, 1])})
+    faults.install(None)
+    assert read_latest(repo)["version"] == 1  # pointer untouched
+    step, arrays, _ = ac.load_with_deltas(repo)
+    assert step == 1
+    np.testing.assert_array_equal(arrays["w"], a1["w"])
+    # a fresh publisher (the restarted trainer) adopts and retries
+    pub2 = ModelPublisher(repo)
+    rec = pub2.publish(a2, 2, touched={"tbl": np.array([0, 1])})
+    assert rec["version"] == 2
+    assert ac.load_with_deltas(repo)[0] == 2
+
+
+def test_staleness_throttle_and_recovery(tmp_path):
+    """A consumer ack far behind the last published version throttles the
+    next publish; catching up releases it."""
+    repo = str(tmp_path)
+    contract = StalenessContract(max_staleness_steps=3)
+    pub = ModelPublisher(repo, contract=contract)
+    pub.publish(_arrays(6), 10)
+    write_ack(repo, "s", 10, {"train_step": 10})
+    assert pub.should_publish()  # caught up
+    pub.publish({n: v + 1 for n, v in _arrays(6).items()}, 20)
+    assert not pub.should_publish()  # 10 behind > 3
+    assert pub.throttled == 1
+    write_ack(repo, "s", 20, {"train_step": 20})
+    assert pub.should_publish()

@@ -302,6 +302,53 @@ def _resilience_summary(metrics):
     return out
 
 
+def _online_summary(metrics):
+    """Online-learning loop stats from a snapshot's metric dump: the
+    online/... namespace written by paddle_tpu.online (publisher cadence +
+    chain length on the trainer side, per-model serving version + staleness
+    gauges on the reloader side)."""
+    onl = {}
+    for name in metrics:
+        parts = name.split("/")
+        if len(parts) == 2 and parts[0] == "online":
+            onl[parts[1]] = metrics[name]
+    if not onl:
+        return {}
+
+    def scalar(rec):
+        if not rec or not rec.get("values"):
+            return None
+        vals = rec["values"]
+        return vals.get("", sum(vals.values()))
+
+    def by_label(rec, key):
+        out = {}
+        for label, v in ((rec or {}).get("values") or {}).items():
+            if label.startswith(key + "="):
+                out[label.split("=", 1)[1]] = v
+        return out
+
+    out = {
+        "published_version": scalar(onl.get("published_version")),
+        "delta_chain_len": scalar(onl.get("delta_chain_len")),
+        "publishes": by_label(onl.get("publishes"), "kind"),
+        "throttled": scalar(onl.get("publish_throttled")),
+        "skipped_clean": scalar(onl.get("publish_skipped_clean")),
+        "reloads": scalar(onl.get("reloads")),
+        "reload_errors": scalar(onl.get("reload_errors")),
+        "max_staleness_seconds": scalar(onl.get("max_staleness_seconds")),
+        "train_steps": scalar(onl.get("train_steps")),
+        "rows_trained": scalar(onl.get("rows_trained")),
+    }
+    models = {}
+    for key in ("serving_version", "serving_staleness_steps",
+                "serving_staleness_seconds"):
+        for model, v in by_label(onl.get(key), "model").items():
+            models.setdefault(model, {})[key] = v
+    out["models"] = models
+    return out
+
+
 def summarize(records, window=200):
     """Aggregate the record stream into the monitor's display fields.
 
@@ -337,6 +384,7 @@ def summarize(records, window=200):
         "embedding": {},
         "resilience": {},
         "passes": {},
+        "online": {},
     }
 
     if opprofs:
@@ -419,6 +467,7 @@ def summarize(records, window=200):
         summary["embedding"] = _embedding_summary(metrics)
         summary["resilience"] = _resilience_summary(metrics)
         summary["passes"] = _passes_summary(metrics)
+        summary["online"] = _online_summary(metrics)
         summary["health"] = dict(last.get("health", {}))
         memrec = last.get("mem", {})
         if memrec.get("mem_peak_bytes"):
@@ -614,6 +663,42 @@ def render(summary):
             _fmt(res.get("watchdog_stalls"), "{:.0f}", "0"),
         )
         rows.append(("resilience/events", events))
+    onl = summary.get("online") or {}
+    if onl:
+        kinds = onl.get("publishes") or {}
+        rows.append((
+            "online/publish",
+            "v%s live, chain %s deltas (%s bases + %s deltas cut, "
+            "%s throttled, %s clean-skips)" % (
+                _fmt(onl.get("published_version"), "{:.0f}"),
+                _fmt(onl.get("delta_chain_len"), "{:.0f}", "0"),
+                _fmt(kinds.get("base"), "{:.0f}", "0"),
+                _fmt(kinds.get("delta"), "{:.0f}", "0"),
+                _fmt(onl.get("throttled"), "{:.0f}", "0"),
+                _fmt(onl.get("skipped_clean"), "{:.0f}", "0"),
+            ),
+        ))
+        if onl.get("train_steps"):
+            rows.append((
+                "online/stream",
+                "%s steps, %s rows trained" % (
+                    _fmt(onl.get("train_steps"), "{:.0f}"),
+                    _fmt(onl.get("rows_trained"), "{:.0f}"),
+                ),
+            ))
+        for model, m in sorted((onl.get("models") or {}).items()):
+            rows.append((
+                "online/serve " + model,
+                "v%s live, staleness %s steps / %s s (budget %s s); "
+                "%s reloads, %s errors" % (
+                    _fmt(m.get("serving_version"), "{:.0f}"),
+                    _fmt(m.get("serving_staleness_steps"), "{:.0f}", "0"),
+                    _fmt(m.get("serving_staleness_seconds"), "{:.1f}", "0"),
+                    _fmt(onl.get("max_staleness_seconds"), "{:.0f}"),
+                    _fmt(onl.get("reloads"), "{:.0f}", "0"),
+                    _fmt(onl.get("reload_errors"), "{:.0f}", "0"),
+                ),
+            ))
     passes = summary.get("passes") or {}
     for pname, p in sorted((passes.get("passes") or {}).items()):
         before = p.get("ops_before")
