@@ -2043,6 +2043,300 @@ def run_online_bench(smoke=False):
     return record
 
 
+def run_fleet_bench(smoke=False):
+    """Fleet chaos soak (PR 16 -> FLEET.json; docs/fleet.md).
+
+    Three replica ModelServer SUBPROCESSES (predict MLP + a tiny GPTDecoder
+    :generate model, all replicas seeded identically) behind one Router,
+    under live mixed predict/generate client traffic. Mid-run, one replica
+    is SIGKILLed and later restarted; it may rejoin the routable pool only
+    after its HotReloader lands AND acks the repository's published model
+    version (the PR 15 staleness gate). Then two targeted chaos rounds —
+    PADDLE_TPU_FAULTS=conn_reset and slow_response armed on ONE replica —
+    must show that replica's circuit breaker opening and re-closing while
+    the router absorbs everything. Acceptance, asserted here:
+
+      - zero 5xx across the whole soak; served_fraction == 1.0;
+      - failover-window p99 <= 5x steady-state p99;
+      - the killed replica rejoins only at/after the acked target version;
+      - breaker opened >= 1x and re-closed in each targeted chaos round,
+        with zero client-visible errors.
+    """
+    import shutil
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import framework
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.fleet import CLOSED, ReplicaProcess, Router
+    from paddle_tpu.online import ModelPublisher, read_latest
+    from paddle_tpu.serving import ServingEngine
+
+    steady_s = 2.0 if smoke else 6.0
+    chaos_s = 2.0 if smoke else 5.0
+    n_predict_clients = 3
+    n_generate_clients = 2
+
+    work = tempfile.mkdtemp(prefix="fleet-bench-")
+    repo = os.path.join(work, "repo")
+    record = {
+        "metric": "fleet_chaos",
+        "mode": "smoke" if smoke else "full",
+        "replicas": 3,
+    }
+    gen_kw = dict(vocab_size=24, n_layer=2, n_head=2, d_model=16,
+                  d_inner=32, max_context=16)
+
+    def _save_mlp_inference(model_dir):
+        main_p, startup = framework.Program(), framework.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main_p, startup):
+            x = fluid.layers.data(name="fx", shape=[6], dtype="float32")
+            h = fluid.layers.fc(input=x, size=8, act="relu")
+            y = fluid.layers.fc(input=h, size=3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope(seed=3)):
+            exe.run(startup)
+            fluid.io.save_inference_model(
+                model_dir, ["fx"], [y], exe, main_program=main_p
+            )
+
+    def _spec(name):
+        return {
+            "name": name,
+            "request_timeout_ms": 10000.0,
+            "predict": {"model": "m", "model_dir": model_dir},
+            "generate": {"model": "g", "model_kw": gen_kw, "seed": 0,
+                         "max_slots": 3, "page_size": 4, "max_context": 16},
+            "repo": repo,
+            "poll_interval_s": 0.1,
+        }
+
+    p_doc = json.dumps({
+        "inputs": {"fx": np.random.RandomState(9).rand(2, 6).tolist()}
+    }).encode()
+    g_doc = json.dumps({
+        "prompt": [1, 2, 3], "max_new_tokens": 4, "eos_id": 999
+    }).encode()
+
+    def _post(url, body, timeout=30.0):
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+
+    try:
+        model_dir = os.path.join(work, "model")
+        _save_mlp_inference(model_dir)
+        # publish v1 into the repo: replicas must land+ack it to be routable
+        eng = ServingEngine(model_dir, name="m", batch_buckets=(1, 2, 4))
+        params = {n: np.asarray(eng.scope.vars[n]).copy()
+                  for n in eng.param_names()}
+        ModelPublisher(repo).publish(params, 1)
+        target_version = read_latest(repo)["version"]
+
+        reps = [ReplicaProcess(_spec("fr%d" % i), work) for i in range(3)]
+        router = Router(
+            port=0, hedge=True, hedge_delay_ms=80.0, probe_interval_s=0.2,
+            down_after=2, total_deadline_s=20.0, attempt_timeout_s=8.0,
+            repo=repo, repo_model="m", seed=0,
+        )
+        rport = router.start()
+        base = "http://127.0.0.1:%d" % rport
+        for r in reps:
+            r.start()
+        for r in reps:
+            r.wait_ready(timeout=300.0)
+            router.register(r.name, r.url)
+        router.probe_once()
+        assert len(router.stats()["routable"]) == 3, router.stats()
+
+        phase = ["steady"]
+        samples = []  # (phase, kind, latency_s, code)
+        errors_5xx, errors_other = [], []
+        gen_tokens = set()
+        stop = threading.Event()
+
+        def client(kind):
+            url = base + ("/v1/models/m:predict" if kind == "predict"
+                          else "/v1/models/g:generate")
+            body = p_doc if kind == "predict" else g_doc
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    code, doc = _post(url, body)
+                    samples.append(
+                        (phase[0], kind, time.perf_counter() - t0, code)
+                    )
+                    if kind == "generate":
+                        gen_tokens.add(tuple(doc["tokens"]))
+                except urllib.error.HTTPError as e:
+                    (errors_5xx if e.code >= 500 else errors_other).append(
+                        (phase[0], kind, e.code)
+                    )
+                except Exception as e:
+                    errors_5xx.append((phase[0], kind, repr(e)))
+
+        threads = [threading.Thread(target=client, args=("predict",),
+                                    daemon=True)
+                   for _ in range(n_predict_clients)]
+        threads += [threading.Thread(target=client, args=("generate",),
+                                     daemon=True)
+                    for _ in range(n_generate_clients)]
+        for t in threads:
+            t.start()
+
+        time.sleep(steady_s)
+        # ------------------------------------------------ SIGKILL + restart
+        phase[0] = "failover"
+        reps[0].kill()
+        t_kill = time.perf_counter()
+        time.sleep(chaos_s)
+        reps[0].restart()
+        reps[0].wait_ready(timeout=300.0)
+        router.register(reps[0].name, reps[0].url)  # fresh port
+        # the staleness gate: routable again only once the restarted
+        # process's HotReloader has ACKED the published version
+        rejoin_deadline = time.monotonic() + 120.0
+        rejoined_at_version = None
+        while time.monotonic() < rejoin_deadline:
+            router.probe_once()
+            if reps[0].name in router.stats()["routable"]:
+                rejoined_at_version = router.replicas()[
+                    reps[0].name
+                ].version_for_gate("m")
+                break
+            time.sleep(0.1)
+        assert rejoined_at_version is not None, "killed replica never rejoined"
+        assert rejoined_at_version >= target_version
+        phase[0] = "recovered"
+        time.sleep(steady_s / 2)
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+
+        total = len(samples) + len(errors_5xx) + len(errors_other)
+        served = len(samples)
+        lat = {ph: sorted(s[2] for s in samples if s[0] == ph)
+               for ph in ("steady", "failover", "recovered")}
+        p99 = {
+            ph: (xs[min(int(len(xs) * 0.99), len(xs) - 1)] * 1e3
+                 if xs else None)
+            for ph, xs in lat.items()
+        }
+        assert not errors_5xx, errors_5xx[:5]
+        assert served == total and total > 0
+        assert len(gen_tokens) == 1, (
+            "generate replicas disagreed: %s" % gen_tokens
+        )
+        failover_ratio = (
+            p99["failover"] / p99["steady"]
+            if p99["failover"] and p99["steady"] else None
+        )
+        assert failover_ratio is None or failover_ratio <= 5.0, (
+            "failover p99 %.1fms > 5x steady p99 %.1fms"
+            % (p99["failover"], p99["steady"])
+        )
+        record.update({
+            "requests_total": total,
+            "served_fraction": round(served / total, 4),
+            "errors_5xx": len(errors_5xx),
+            "errors_other": len(errors_other),
+            "steady_p99_ms": round(p99["steady"], 2) if p99["steady"] else None,
+            "failover_p99_ms": (
+                round(p99["failover"], 2) if p99["failover"] else None
+            ),
+            "failover_p99_over_steady": (
+                round(failover_ratio, 2) if failover_ratio else None
+            ),
+            "recovered_p99_ms": (
+                round(p99["recovered"], 2) if p99["recovered"] else None
+            ),
+            "target_model_version": target_version,
+            "rejoined_at_version": rejoined_at_version,
+            "kill_to_stop_s": round(time.perf_counter() - t_kill, 2),
+            "retries": router._m_retries.value(kind="predict")
+            + router._m_retries.value(kind="generate"),
+            "hedges_launched": router._m_hedges.value(event="launched"),
+            "hedges_won": router._m_hedges.value(event="won"),
+            "generate_parity": len(gen_tokens) == 1,
+        })
+        router.stop()
+        for r in reps:
+            r.terminate()
+
+        # ---------------------------------------- targeted breaker rounds
+        # one replica armed with a deterministic fault plan, one clean: the
+        # armed replica's breaker must open AND re-close while every client
+        # request still succeeds through failover
+        for fault_kind, fault_spec in (
+            ("conn_reset", "conn_reset:every=2"),
+            ("slow_response", "slow_response:every=2@ms=1200"),
+        ):
+            cr = [
+                ReplicaProcess(_spec("%s0" % fault_kind[:2]), work,
+                               faults=fault_spec),
+                ReplicaProcess(_spec("%s1" % fault_kind[:2]), work),
+            ]
+            crouter = Router(
+                port=0, hedge=False, probe_interval_s=0.2,
+                total_deadline_s=20.0, attempt_timeout_s=0.4,
+                repo=repo, repo_model="m", seed=1,
+                breaker_opts=dict(
+                    failure_threshold=3, error_rate_threshold=0.5,
+                    min_requests=4, open_for_s=0.3, success_threshold=1,
+                ),
+            )
+            cport = crouter.start()
+            armed = cr[0].spec["name"]
+            try:
+                for r in cr:
+                    r.start()
+                for r in cr:
+                    r.wait_ready(timeout=300.0)
+                    crouter.register(r.name, r.url)
+                crouter.probe_once()
+                url = "http://127.0.0.1:%d/v1/models/m:predict" % cport
+                codes = []
+                opened = closed_again = False
+                deadline = time.monotonic() + (20.0 if smoke else 40.0)
+                while time.monotonic() < deadline:
+                    codes.append(_post(url, p_doc)[0])
+                    br = crouter.replicas()[armed].breaker
+                    if br.stats()["opens"] >= 1:
+                        opened = True
+                        if br.state == CLOSED:
+                            closed_again = True
+                            break
+                    time.sleep(0.01)
+                assert codes and all(c == 200 for c in codes), (
+                    fault_kind, codes[-5:]
+                )
+                assert opened, "%s never tripped the breaker" % fault_kind
+                assert closed_again, (
+                    "%s breaker never re-closed" % fault_kind
+                )
+                record["%s_requests" % fault_kind] = len(codes)
+                record["%s_breaker_opens" % fault_kind] = (
+                    crouter.replicas()[armed].breaker.stats()["opens"]
+                )
+                record["%s_client_errors" % fault_kind] = 0
+                record["%s_breaker_reclosed" % fault_kind] = True
+            finally:
+                crouter.stop()
+                for r in cr:
+                    try:
+                        r.kill()
+                    except Exception:
+                        pass
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return record
+
+
 def run_recovery_bench(smoke=False):
     """Elastic-recovery evidence pass (ISSUE 9 -> RECOVERY.json).
 
@@ -2172,6 +2466,22 @@ def run_recovery_bench(smoke=False):
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        # fleet chaos soak (PR 16): 3 replica subprocesses behind the
+        # health-aware Router under mixed predict/generate load — SIGKILL +
+        # ack-gated rejoin mid-run, then conn_reset and slow_response rounds
+        # proving the breaker opens and re-closes with zero client-visible
+        # errors; writes FLEET.json next to this file ("smoke" shrinks the
+        # soak, skips the tracked file)
+        smoke = "smoke" in sys.argv[2:]
+        rec = run_fleet_bench(smoke=smoke)
+        if not smoke:
+            out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "FLEET.json")
+            with open(out, "w") as f:
+                json.dump(rec, f, indent=1)
+        print(json.dumps(rec, indent=1))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "recovery":
         # elastic-recovery evidence pass (ISSUE 9): async-checkpoint stall
         # vs synchronous save at equal state size (target <= 0.20),

@@ -28,6 +28,16 @@ Hook points currently wired (see docs/resilience.md for the full table):
                             preemption notice, injectable)
   hang                      resilience/elastic.py  sleeps spec.ms inside the
                             supervised step window (trips the watchdog)
+  replica_kill              serving/server.py      SIGKILLs the serving
+                            process before it answers (a replica dying
+                            mid-request; the fleet router's failover case)
+  conn_reset                serving/server.py      closes the client socket
+                            without replying (a half-open connection: the
+                            client sees a reset/empty response, the server
+                            never processed the request)
+  slow_response             serving/server.py      sleeps spec.ms before
+                            handling (a browned-out replica; trips the fleet
+                            router's attempt timeout + circuit breaker)
 
 Every decision is made from per-kind invocation counters plus a per-kind
 seeded RNG, so the same plan + the same call sequence replays the same
@@ -49,6 +59,7 @@ __all__ = [
     "fires",
     "hang",
     "install",
+    "kill_self",
     "preempt_self",
     "reset",
 ]
@@ -239,6 +250,20 @@ def preempt_self(kind="preempt"):
 
         os.kill(os.getpid(), _signal.SIGTERM)
         return True
+    return False
+
+
+def kill_self(kind="replica_kill"):
+    """Hard-death hook: deliver SIGKILL to this process when the plan says
+    so — no handlers, no drain, no atexit; the closest injectable stand-in
+    for an OOM kill or a host loss. Unlike preempt_self there is nothing to
+    observe afterwards in-process: the return value only matters when the
+    plan did NOT fire."""
+    if fires(kind):
+        import signal as _signal
+
+        os.kill(os.getpid(), _signal.SIGKILL)
+        return True  # pragma: no cover - unreachable after SIGKILL
     return False
 
 

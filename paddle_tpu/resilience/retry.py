@@ -32,7 +32,17 @@ class FatalError(Exception):
 class DeadlineExceeded(TimeoutError):
     """A connect/read deadline or an overall retry deadline expired.
     TimeoutError => also an OSError, so pre-existing `except OSError`
-    cleanup paths keep working."""
+    cleanup paths keep working.
+
+    When raised by RetryPolicy.call the instance carries ``attempts`` — a
+    list of ``(attempt_index, repr(error))`` pairs for every try made before
+    the budget ran out — so the caller's error report (and the fleet
+    router's 504 body) can show WHAT kept failing, not just that time ran
+    out."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.attempts = []
 
 
 class RetryPolicy:
@@ -75,9 +85,34 @@ class RetryPolicy:
         self.deadline = deadline
         self.retryable = tuple(retryable)
         self.fatal = tuple(fatal)
+        self._seed = seed
         self._rng = Random(seed)
         self._sleep = sleep
         self._prev = None  # decorrelated mode: last pause issued
+
+    def with_deadline(self, budget_s):
+        """A copy of this policy whose total time budget is `budget_s`
+        seconds — the caller's REMAINING deadline, not a fresh one. The copy
+        stops retrying the moment the next backoff pause would overrun the
+        budget, raising DeadlineExceeded with the attempt history attached
+        (``.attempts``). A zero/negative budget still allows exactly one
+        attempt: the budget gates retries, never the first try.
+
+        The copy has fresh jitter state (same seed), so handing one template
+        policy to many concurrent requests stays race-free — each request
+        derives its own."""
+        return RetryPolicy(
+            max_attempts=self.max_attempts,
+            base_delay=self.base_delay,
+            max_delay=self.max_delay,
+            multiplier=self.multiplier,
+            jitter=self.jitter,
+            deadline=max(float(budget_s), 0.0),
+            retryable=self.retryable,
+            fatal=self.fatal,
+            seed=self._seed,
+            sleep=self._sleep,
+        )
 
     def backoff(self, attempt):
         """Delay before retrying after 0-based `attempt` (jittered)."""
@@ -98,6 +133,7 @@ class RetryPolicy:
         is invoked before each backoff sleep (logging/metrics hook)."""
         start = time.monotonic()
         last = None
+        history = []
         for attempt in range(self.max_attempts):
             try:
                 return fn(*args, **kwargs)
@@ -105,16 +141,19 @@ class RetryPolicy:
                 raise
             except self.retryable as e:
                 last = e
+                history.append((attempt, repr(e)))
                 if attempt + 1 >= self.max_attempts:
                     break
                 pause = self.backoff(attempt)
                 if self.deadline is not None:
                     remaining = self.deadline - (time.monotonic() - start)
                     if remaining <= pause:
-                        raise DeadlineExceeded(
+                        err = DeadlineExceeded(
                             "retry deadline %.1fs exhausted after %d attempts"
                             % (self.deadline, attempt + 1)
-                        ) from e
+                        )
+                        err.attempts = history
+                        raise err from e
                 if on_retry is not None:
                     on_retry(attempt, e)
                 self._sleep(pause)

@@ -14,6 +14,13 @@ batcher's job is the time/row tradeoff and the failure modes:
 - **backpressure**: the queue is bounded in ROWS (not requests — a single
   512-row request is 512 rows of device debt). A full queue fast-fails
   submit() with QueueFullError, the HTTP front end's 503.
+- **deadline-aware admission**: beyond the row cap, submit() sheds work it
+  cannot finish inside the per-request timeout — once the measured drain
+  rate (EWMA rows/s over engine calls) says the rows already queued will
+  take longer than `timeout_ms` to clear, accepting more would only
+  manufacture future 504s, so the request is rejected NOW while the client
+  can still fail over. Both rejection flavors carry `retry_after_s`
+  (queued_rows / drain_rate) — the HTTP front end's Retry-After hint.
 - **per-request timeout**: a request that ages past `timeout_ms` before its
   batch executes fails with RequestTimeout (HTTP 504) instead of occupying
   a bucket slot.
@@ -42,11 +49,24 @@ __all__ = [
 
 
 class QueueFullError(RuntimeError):
-    """Bounded request queue is full — fast-fail admission (HTTP 503)."""
+    """Bounded request queue is full, or the measured drain rate says the
+    queue cannot clear inside the request deadline — fast-fail admission
+    (HTTP 503). `retry_after_s` estimates when the queue will have drained
+    (None when no drain rate is known yet)."""
+
+    def __init__(self, msg, retry_after_s=None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class RequestTimeout(RuntimeError):
-    """Request aged past its deadline before a batch executed (HTTP 504)."""
+    """Request aged past its deadline before a batch executed (HTTP 504).
+    `retry_after_s` carries the batcher's current drain estimate when the
+    dispatcher raised it (None from a bare result() wait)."""
+
+    def __init__(self, msg, retry_after_s=None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class ShutdownError(RuntimeError):
@@ -106,6 +126,11 @@ class ContinuousBatcher:
         self._queued_rows = 0
         self._alive = True
         self._draining = False
+        # measured service rate (rows/s, EWMA over engine calls): admission's
+        # can-this-finish-in-time estimate and the Retry-After hint's basis.
+        # None until the first engine call completes — a cold batcher must
+        # not shed load off a guess.
+        self._drain_rate = None
 
         from ..observability import registry as _registry
 
@@ -172,8 +197,25 @@ class ContinuousBatcher:
                 self._m_requests.inc(outcome="rejected")
                 raise QueueFullError(
                     "queue full (%d rows queued, limit %d)"
-                    % (self._queued_rows, self.max_queue_rows)
+                    % (self._queued_rows, self.max_queue_rows),
+                    retry_after_s=self._retry_after_locked(),
                 )
+            # deadline-aware admission: if the rows ahead of this request
+            # will (by the measured drain rate) take longer than the request
+            # timeout to clear, it is already doomed to a 504 — reject with
+            # the honest wait estimate instead of accepting work we cannot
+            # finish
+            if self._drain_rate:
+                est_wait = (self._queued_rows + n) / self._drain_rate
+                if est_wait > self.timeout:
+                    self._m_requests.inc(outcome="rejected")
+                    raise QueueFullError(
+                        "queue drain estimate %.0f ms exceeds request "
+                        "timeout %.0f ms (%d rows queued at %.0f rows/s)"
+                        % (est_wait * 1e3, self.timeout * 1e3,
+                           self._queued_rows, self._drain_rate),
+                        retry_after_s=self._retry_after_locked(),
+                    )
             self._queue.append(req)
             self._queued_rows += n
             self._m_depth.set(self._queued_rows)
@@ -185,6 +227,23 @@ class ContinuousBatcher:
         return self.submit(feed).result(
             self.timeout * 2 if timeout is None else timeout
         )
+
+    def _retry_after_locked(self):
+        """Seconds until the currently queued rows should have drained (the
+        Retry-After hint); None before any drain rate is measured."""
+        if not self._drain_rate:
+            return None
+        return max(self._queued_rows / self._drain_rate, 0.05)
+
+    def retry_after_hint(self):
+        """Thread-safe Retry-After estimate for the HTTP front end: how long
+        a rejected/timed-out client should wait before retrying THIS
+        replica. Clamped to [1, 30] whole seconds; 1 when unknown."""
+        with self._cond:
+            est = self._retry_after_locked()
+        if est is None:
+            return 1
+        return int(min(max(-(-est // 1), 1), 30))
 
     # ---- dispatcher -------------------------------------------------------
     def _admit_locked(self):
@@ -235,10 +294,13 @@ class ContinuousBatcher:
         for req in batch:
             if now - req.t_submit > self.timeout:
                 self._m_requests.inc(outcome="timeout")
+                with self._cond:
+                    hint = self._retry_after_locked()
                 req.future._set_error(
                     RequestTimeout(
                         "queued %.0f ms > timeout %.0f ms"
-                        % ((now - req.t_submit) * 1e3, self.timeout * 1e3)
+                        % ((now - req.t_submit) * 1e3, self.timeout * 1e3),
+                        retry_after_s=hint,
                     )
                 )
             else:
@@ -274,6 +336,7 @@ class ContinuousBatcher:
             for n in self.engine.feed_names
         }
         self._batches_dispatched += 1
+        t_run = time.perf_counter()
         try:
             outs = self.engine.run(packed)
         except Exception as e:
@@ -286,6 +349,13 @@ class ContinuousBatcher:
                 req.future._set_error(err)
             return
         done = time.perf_counter()
+        elapsed = max(done - t_run, 1e-6)
+        rate = sum(r.rows for r in live) / elapsed
+        with self._cond:
+            self._drain_rate = (
+                rate if self._drain_rate is None
+                else 0.7 * self._drain_rate + 0.3 * rate
+            )
         # which hot-swapped version the engine call above ran on: read on
         # THIS (dispatcher) thread, where the engine recorded it
         served = getattr(self.engine, "last_served_version", None)
@@ -336,5 +406,6 @@ class ContinuousBatcher:
             return {
                 "queued_rows": self._queued_rows,
                 "batches_dispatched": self._batches_dispatched,
+                "drain_rate_rows_per_s": self._drain_rate,
                 "alive": self._alive,
             }

@@ -18,8 +18,13 @@ Routes:
   "eos_id": id}`` (prompt required, rest optional; no temperature means
   greedy). Replies ``{"tokens": [...], "finish_reason": "eos"|"length",
   "latency_ms": float}``.
-- ``GET /healthz`` — 200 once every model's engine is constructed; body
-  lists models and variant counts.
+- ``GET /healthz`` — liveness AND per-model readiness: 200 with
+  ``{"status", "ready", "model_version", "models": {name: {"kind", "ready",
+  "model_version", "queue_depth", "queued_rows", "variants"}}}``. A model is
+  *ready* once its warmup precompiled every bucket — "up" (the process
+  answers) and "routable" (this model serves without tracing) are different
+  facts, and the fleet router + any external LB route on the second.
+  ``/healthz?verbose=0`` keeps the original liveness-only shape.
 - ``GET /v1/models`` — model metadata (feeds, fetches, buckets, stats).
 - ``GET /v1/models/<name>`` — one model's metadata plus its live hot-swap
   state: ``model_version`` and the publisher's ``version_stamp`` (train
@@ -29,8 +34,16 @@ Routes:
 - ``GET /metrics`` — the PR 4 registry's Prometheus text exposition (same
   content observability/export.py writes to the scrape file).
 
-Failure mapping: unknown model -> 404, malformed body -> 400, queue full
-(backpressure) -> 503 with Retry-After, request timeout -> 504.
+Failure mapping: unknown model -> 404, malformed body -> 400, queue full /
+deadline-shed admission -> 503, request timeout -> 504. 503/504 carry a
+``Retry-After`` header derived from the batcher's measured queue drain rate
+(rows queued / rows-per-second EWMA) instead of a constant.
+
+Fault hooks (PADDLE_TPU_FAULTS, docs/resilience.md): every POST consults
+``replica_kill`` (SIGKILL self — a replica dying mid-request),
+``conn_reset`` (close the socket without replying) and ``slow_response``
+(sleep spec.ms first) so the fleet router's failover, retry and breaker
+paths soak under the same deterministic fault plans as the trainer.
 """
 
 import io as _stdio
@@ -42,6 +55,7 @@ import numpy as np
 
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..resilience import faults as _faults
 from .batcher import ContinuousBatcher, QueueFullError, RequestTimeout
 from .engine import ServingEngine
 
@@ -51,12 +65,15 @@ PREDICT_PREFIX = "/v1/models/"
 
 
 class _Hosted:
-    __slots__ = ("engine", "batcher", "kind")
+    __slots__ = ("engine", "batcher", "kind", "warmed")
 
-    def __init__(self, engine, batcher, kind="predict"):
+    def __init__(self, engine, batcher, kind="predict", warmed=False):
         self.engine = engine
         self.batcher = batcher
         self.kind = kind
+        # readiness, not liveness: True once warmup precompiled every
+        # bucket, i.e. this model serves without tracing
+        self.warmed = warmed
 
 
 class ModelServer:
@@ -90,7 +107,7 @@ class ModelServer:
         if warmup:
             engine.warmup(example_feed=warmup_feed)
         batcher = ContinuousBatcher(engine, **(batcher_opts or {}))
-        self._models[name] = _Hosted(engine, batcher)
+        self._models[name] = _Hosted(engine, batcher, warmed=bool(warmup))
         return engine
 
     def add_generation_model(self, name, model=None, engine=None, warmup=True,
@@ -108,7 +125,9 @@ class ModelServer:
         if warmup:
             engine.warmup()
         scheduler = GenerationScheduler(engine, **(scheduler_opts or {}))
-        self._models[name] = _Hosted(engine, scheduler, kind="generate")
+        self._models[name] = _Hosted(
+            engine, scheduler, kind="generate", warmed=bool(warmup)
+        )
         return engine
 
     def models(self):
@@ -126,13 +145,16 @@ class ModelServer:
             def log_message(self, fmt, *args):  # quiet by default
                 pass
 
-            def _reply(self, code, body, content_type="application/json"):
+            def _reply(self, code, body, content_type="application/json",
+                       retry_after=None):
                 server._m_http.inc(code=str(code))
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
-                if code == 503:
-                    self.send_header("Retry-After", "1")
+                if retry_after is None and code == 503:
+                    retry_after = 1
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(int(retry_after)))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -141,8 +163,11 @@ class ModelServer:
 
             def do_GET(self):
                 try:
-                    if self.path == "/healthz":
-                        self._reply_json(200, server._healthz())
+                    if self.path == "/healthz" or self.path.startswith(
+                        "/healthz?"
+                    ):
+                        verbose = "verbose=0" not in self.path
+                        self._reply_json(200, server._healthz(verbose))
                     elif self.path == "/v1/models":
                         self._reply_json(200, server._describe())
                     elif (self.path.startswith(PREDICT_PREFIX)
@@ -164,14 +189,25 @@ class ModelServer:
 
             def do_POST(self):
                 try:
-                    code, body, ctype = server._predict(
+                    # serving-side fault hooks (docs/resilience.md): a
+                    # replica dying mid-request, a half-open connection, a
+                    # browned-out reply — the failure menu the fleet
+                    # router's failover/retry/breaker paths soak against
+                    _faults.kill_self("replica_kill")
+                    if _faults.fires("conn_reset"):
+                        self.close_connection = True
+                        self.connection.close()
+                        return
+                    _faults.delay("slow_response")
+                    code, body, ctype, retry_after = server._predict(
                         self.path,
                         self.headers.get("Content-Type", ""),
                         self.rfile.read(
                             int(self.headers.get("Content-Length", 0))
                         ),
                     )
-                    self._reply(code, body, content_type=ctype)
+                    self._reply(code, body, content_type=ctype,
+                                retry_after=retry_after)
                 except Exception as e:
                     self._reply_json(500, {"error": repr(e)})
 
@@ -204,13 +240,39 @@ class ModelServer:
         return ok
 
     # ---- request handling (thread-safe, called from handler threads) ------
-    def _healthz(self):
+    def _healthz(self, verbose=True):
+        """Liveness + (verbose) per-model readiness. The old liveness-only
+        shape survives under ``?verbose=0`` for pre-fleet scrapers."""
+        if not verbose:
+            return {
+                "status": "ok",
+                "models": {
+                    name: {"variants": h.engine.stats()["variants"]}
+                    for name, h in self._models.items()
+                },
+            }
+        models = {}
+        ready = bool(self._models)
+        for name, h in self._models.items():
+            bstats = h.batcher.stats()
+            models[name] = {
+                "kind": h.kind,
+                "ready": h.warmed,
+                "model_version": getattr(h.engine, "model_version", 0),
+                "queue_depth": len(h.batcher._queue),
+                "queued_rows": bstats.get("queued_rows", 0),
+                "variants": h.engine.stats()["variants"],
+            }
+            ready = ready and h.warmed
         return {
             "status": "ok",
-            "models": {
-                name: {"variants": h.engine.stats()["variants"]}
-                for name, h in self._models.items()
-            },
+            "ready": ready,
+            # the max over models: the fleet router gates one repo-backed
+            # model, and a replica serving several reports the newest
+            "model_version": max(
+                [m["model_version"] for m in models.values()] or [0]
+            ),
+            "models": models,
         }
 
     def _describe(self):
@@ -246,25 +308,26 @@ class ModelServer:
         return 200, out
 
     def _predict(self, path, content_type, body):
-        """(status, reply bytes, content type) for one predict/generate
-        POST."""
+        """(status, reply bytes, content type, retry-after hint) for one
+        predict/generate POST. retry_after is None except on 503/504, where
+        it is derived from the batcher's measured queue drain rate."""
         if path.startswith(PREDICT_PREFIX) and path.endswith(":generate"):
             return self._generate(
                 path[len(PREDICT_PREFIX):-len(":generate")], body
             )
         if not (path.startswith(PREDICT_PREFIX) and path.endswith(":predict")):
             return 404, json.dumps({"error": "no route %s" % path}).encode(), \
-                "application/json"
+                "application/json", None
         name = path[len(PREDICT_PREFIX):-len(":predict")]
         hosted = self._models.get(name)
         if hosted is None:
             return 404, json.dumps(
                 {"error": "unknown model %r (have %s)" % (name, self.models())}
-            ).encode(), "application/json"
+            ).encode(), "application/json", None
         if hosted.kind != "predict":
             return 400, json.dumps(
                 {"error": "model %r serves :generate, not :predict" % name}
-            ).encode(), "application/json"
+            ).encode(), "application/json", None
 
         as_npz = "npz" in content_type or content_type == "application/octet-stream"
         try:
@@ -284,25 +347,25 @@ class ModelServer:
                 }
         except Exception as e:
             return 400, json.dumps({"error": "bad payload: %r" % e}).encode(), \
-                "application/json"
+                "application/json", None
 
         t0 = time.perf_counter()
         try:
             future = hosted.batcher.submit(feed)
         except QueueFullError as e:
             return 503, json.dumps({"error": str(e)}).encode(), \
-                "application/json"
+                "application/json", self._retry_after(hosted, e)
         except ValueError as e:
             return 400, json.dumps({"error": str(e)}).encode(), \
-                "application/json"
+                "application/json", None
         try:
             outs = future.result(self.request_timeout)
         except RequestTimeout as e:
             return 504, json.dumps({"error": str(e)}).encode(), \
-                "application/json"
+                "application/json", self._retry_after(hosted, e)
         except Exception as e:
             return 500, json.dumps({"error": repr(e)}).encode(), \
-                "application/json"
+                "application/json", None
         latency_ms = (time.perf_counter() - t0) * 1e3
         version = getattr(future, "model_version", None)
         if version is None:
@@ -319,7 +382,7 @@ class ModelServer:
                     for n, o in zip(hosted.engine.fetch_names, outs)
                 },
             )
-            return 200, buf.getvalue(), "application/x-npz"
+            return 200, buf.getvalue(), "application/x-npz", None
         return 200, json.dumps(
             {
                 "outputs": {
@@ -331,19 +394,30 @@ class ModelServer:
                 "model_version": version,
                 "latency_ms": latency_ms,
             }
-        ).encode(), "application/json"
+        ).encode(), "application/json", None
+
+    @staticmethod
+    def _retry_after(hosted, err):
+        """Retry-After seconds for a 503/504: the exception's drain estimate
+        when the batcher attached one, else its live hint."""
+        est = getattr(err, "retry_after_s", None)
+        if est is not None:
+            return int(min(max(-(-est // 1), 1), 30))
+        hint = getattr(hosted.batcher, "retry_after_hint", None)
+        return hint() if callable(hint) else 1
 
     def _generate(self, name, body):
-        """(status, reply bytes, content type) for one :generate POST."""
+        """(status, reply bytes, content type, retry-after hint) for one
+        :generate POST."""
         hosted = self._models.get(name)
         if hosted is None:
             return 404, json.dumps(
                 {"error": "unknown model %r (have %s)" % (name, self.models())}
-            ).encode(), "application/json"
+            ).encode(), "application/json", None
         if hosted.kind != "generate":
             return 400, json.dumps(
                 {"error": "model %r serves :predict, not :generate" % name}
-            ).encode(), "application/json"
+            ).encode(), "application/json", None
         try:
             doc = json.loads(body.decode() or "{}")
             prompt = doc.get("prompt")
@@ -357,25 +431,25 @@ class ModelServer:
             }
         except (ValueError, json.JSONDecodeError) as e:
             return 400, json.dumps({"error": "bad payload: %r" % e}).encode(), \
-                "application/json"
+                "application/json", None
 
         t0 = time.perf_counter()
         try:
             future = hosted.batcher.submit(prompt, **kw)
         except QueueFullError as e:
             return 503, json.dumps({"error": str(e)}).encode(), \
-                "application/json"
+                "application/json", self._retry_after(hosted, e)
         except ValueError as e:
             return 400, json.dumps({"error": str(e)}).encode(), \
-                "application/json"
+                "application/json", None
         try:
             res = future.result(self.request_timeout)
         except RequestTimeout as e:
             return 504, json.dumps({"error": str(e)}).encode(), \
-                "application/json"
+                "application/json", self._retry_after(hosted, e)
         except Exception as e:
             return 500, json.dumps({"error": repr(e)}).encode(), \
-                "application/json"
+                "application/json", None
         return 200, json.dumps(
             {
                 "tokens": list(res.tokens),
@@ -386,4 +460,4 @@ class ModelServer:
                 "model_version": getattr(hosted.engine, "model_version", 0),
                 "latency_ms": (time.perf_counter() - t0) * 1e3,
             }
-        ).encode(), "application/json"
+        ).encode(), "application/json", None

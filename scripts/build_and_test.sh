@@ -660,6 +660,37 @@ print("online smoke ok: %d swaps, %d requests, 0 5xx, staleness<=%g, "
          rec["parity_versions_checked"], rec["rows_per_sec"]))
 PY
 
+echo "== fleet chaos smoke (docs/fleet.md) =="
+# the fault-tolerant serving fleet end to end: 3 replica ModelServer
+# subprocesses (predict MLP + tiny :generate decoder, shared model repo)
+# behind the health-aware Router under mixed client load. Mid-run one
+# replica is SIGKILLed and restarted — it rejoins only after its
+# HotReloader acks the published version — then conn_reset and
+# slow_response rounds must trip and re-close the armed replica's circuit
+# breaker. Asserts (inside run_fleet_bench + re-checked here): zero 5xx,
+# served_fraction 1.0, failover p99 <= 5x steady p99, breaker opened and
+# re-closed per fault round
+JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+sys.path.insert(0, ".")
+from bench import run_fleet_bench
+rec = run_fleet_bench(smoke=True)
+assert rec["errors_5xx"] == 0, rec
+assert rec["served_fraction"] == 1.0, rec
+assert rec["rejoined_at_version"] >= rec["target_model_version"], rec
+assert rec["conn_reset_breaker_opens"] >= 1, rec
+assert rec["slow_response_breaker_opens"] >= 1, rec
+assert rec["conn_reset_breaker_reclosed"], rec
+assert rec["slow_response_breaker_reclosed"], rec
+print("fleet smoke ok: %d requests, 0 5xx, served 100%%, failover p99 "
+      "%.1f ms (%.2fx steady), rejoined@v%d, breaker opens reset=%d "
+      "slow=%d (both re-closed)"
+      % (rec["requests_total"], rec["failover_p99_ms"] or 0.0,
+         rec["failover_p99_over_steady"] or 0.0,
+         rec["rejoined_at_version"], rec["conn_reset_breaker_opens"],
+         rec["slow_response_breaker_opens"]))
+PY
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

@@ -206,6 +206,75 @@ def test_retry_policy_deadline_exceeded():
     assert issubclass(DeadlineExceeded, OSError)
 
 
+def test_retry_with_deadline_zero_budget_still_one_attempt():
+    """Boundary: a zero (or negative) remaining budget gates RETRIES, never
+    the first try — the caller already decided to attempt once."""
+    calls = []
+
+    def fail():
+        calls.append(1)
+        raise ConnectionResetError("transient")
+
+    p = RetryPolicy(max_attempts=5, base_delay=0.01, sleep=lambda _s: None)
+    with pytest.raises(DeadlineExceeded) as ei:
+        p.with_deadline(0.0).call(fail)
+    assert len(calls) == 1
+    # the attempt history rode along: what failed, not just that time ran out
+    assert len(ei.value.attempts) == 1
+    assert "ConnectionResetError" in ei.value.attempts[0][1]
+
+    calls.clear()
+    with pytest.raises(DeadlineExceeded):
+        p.with_deadline(-3.0).call(fail)
+    assert len(calls) == 1
+
+
+def test_retry_with_deadline_budget_exactly_one_attempt():
+    """Boundary: a budget smaller than the first backoff pause = exactly one
+    attempt; a generous budget lets retries run to max_attempts."""
+    calls = []
+
+    def fail():
+        calls.append(1)
+        raise TimeoutError("slow peer")
+
+    # base_delay 10s >> 1ms budget: the first pause would overrun it
+    p = RetryPolicy(max_attempts=6, base_delay=10.0, sleep=lambda _s: None)
+    with pytest.raises(DeadlineExceeded):
+        p.with_deadline(0.001).call(fail)
+    assert len(calls) == 1
+
+    calls.clear()
+    generous = RetryPolicy(
+        max_attempts=3, base_delay=0.0, max_delay=0.0, sleep=lambda _s: None
+    ).with_deadline(60.0)
+    with pytest.raises(TimeoutError):
+        generous.call(fail)
+    assert len(calls) == 3  # budget never binds; attempts do
+
+
+def test_retry_with_deadline_is_an_independent_copy():
+    """with_deadline must not mutate the template (one template policy is
+    shared across concurrent fleet requests) and must keep the typed
+    retryable/fatal sets + decorrelated jitter config."""
+    tmpl = RetryPolicy(
+        max_attempts=7, base_delay=0.02, max_delay=1.5,
+        jitter="decorrelated", deadline=None, seed=11,
+        retryable=(ConnectionError,), fatal=(FatalError, KeyError),
+        sleep=lambda _s: None,
+    )
+    d = tmpl.with_deadline(2.5)
+    assert tmpl.deadline is None and d.deadline == 2.5
+    assert d is not tmpl
+    assert (d.max_attempts, d.base_delay, d.max_delay, d.jitter) == (
+        7, 0.02, 1.5, "decorrelated"
+    )
+    assert d.retryable == tmpl.retryable and d.fatal == tmpl.fatal
+    # fresh jitter state, same seed: both copies draw the same sequence
+    d2 = tmpl.with_deadline(2.5)
+    assert [d.backoff(i) for i in range(4)] == [d2.backoff(i) for i in range(4)]
+
+
 # ---------------------------------------------------------------------------
 # manifest checkpoints
 # ---------------------------------------------------------------------------

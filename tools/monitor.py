@@ -349,6 +349,84 @@ def _online_summary(metrics):
     return out
 
 
+def _fleet_summary(metrics):
+    """Serving-fleet router stats from a snapshot's metric dump: the
+    fleet/... namespace written by paddle_tpu.fleet.router — routed request
+    outcomes by kind+code, failover retries, hedge launches/wins, circuit
+    breaker flips, retry-budget denials, replica routability gauges, and
+    the end-to-end routed latency histogram."""
+    flt = {}
+    for name in metrics:
+        parts = name.split("/")
+        if len(parts) == 2 and parts[0] == "fleet":
+            flt[parts[1]] = metrics[name]
+    if not flt:
+        return {}
+
+    def scalar(rec):
+        if not rec or not rec.get("values"):
+            return None
+        vals = rec["values"]
+        return vals.get("", sum(vals.values()))
+
+    def labelled(rec):
+        return (rec or {}).get("values") or {}
+
+    def pairs(label):
+        out = {}
+        for p in label.split(","):
+            if "=" in p:
+                k, v = p.split("=", 1)
+                out[k] = v
+        return out
+
+    requests = labelled(flt.get("requests"))
+    total = ok = errors_5xx = 0
+    by_kind = {}
+    for label, v in requests.items():
+        lp = pairs(label)
+        code = lp.get("code", "")
+        kind = lp.get("kind", "?")
+        total += v
+        by_kind[kind] = by_kind.get(kind, 0) + v
+        if code.startswith("5"):
+            errors_5xx += v
+        elif code.startswith("2"):
+            ok += v
+
+    transitions = labelled(flt.get("breaker_transitions"))
+    opens = sum(
+        v for label, v in transitions.items()
+        if pairs(label).get("to") == "open"
+    )
+
+    hedges = labelled(flt.get("hedges"))
+    out = {
+        "requests": total,
+        "ok": ok,
+        "errors_5xx": errors_5xx,
+        "by_kind": by_kind,
+        "retries": scalar(flt.get("retries")),
+        "budget_denied": scalar(flt.get("retry_budget_denied")),
+        "hedges_launched": sum(
+            v for label, v in hedges.items()
+            if pairs(label).get("event") == "launched"
+        ),
+        "hedges_won": sum(
+            v for label, v in hedges.items()
+            if pairs(label).get("event") == "won"
+        ),
+        "breaker_opens": opens,
+        "replicas_routable": scalar(flt.get("replicas_routable")),
+        "replicas_total": scalar(flt.get("replicas_total")),
+    }
+    lat = flt.get("request_ms")
+    if lat and lat.get("count"):
+        out["p50_ms"] = _hist_percentile(lat, 50)
+        out["p99_ms"] = _hist_percentile(lat, 99)
+    return out
+
+
 def summarize(records, window=200):
     """Aggregate the record stream into the monitor's display fields.
 
@@ -385,6 +463,7 @@ def summarize(records, window=200):
         "resilience": {},
         "passes": {},
         "online": {},
+        "fleet": {},
     }
 
     if opprofs:
@@ -468,6 +547,7 @@ def summarize(records, window=200):
         summary["resilience"] = _resilience_summary(metrics)
         summary["passes"] = _passes_summary(metrics)
         summary["online"] = _online_summary(metrics)
+        summary["fleet"] = _fleet_summary(metrics)
         summary["health"] = dict(last.get("health", {}))
         memrec = last.get("mem", {})
         if memrec.get("mem_peak_bytes"):
@@ -699,6 +779,36 @@ def render(summary):
                     _fmt(onl.get("reload_errors"), "{:.0f}", "0"),
                 ),
             ))
+    flt = summary.get("fleet") or {}
+    if flt:
+        rows.append((
+            "fleet/traffic",
+            "%s routed (%s ok / %s 5xx), p50 %s ms p99 %s ms" % (
+                _fmt(flt.get("requests"), "{:.0f}", "0"),
+                _fmt(flt.get("ok"), "{:.0f}", "0"),
+                _fmt(flt.get("errors_5xx"), "{:.0f}", "0"),
+                _fmt(flt.get("p50_ms")),
+                _fmt(flt.get("p99_ms")),
+            ),
+        ))
+        rows.append((
+            "fleet/resilience",
+            "%s retries (%s budget-denied), hedges %s launched / %s won, "
+            "%s breaker opens" % (
+                _fmt(flt.get("retries"), "{:.0f}", "0"),
+                _fmt(flt.get("budget_denied"), "{:.0f}", "0"),
+                _fmt(flt.get("hedges_launched"), "{:.0f}", "0"),
+                _fmt(flt.get("hedges_won"), "{:.0f}", "0"),
+                _fmt(flt.get("breaker_opens"), "{:.0f}", "0"),
+            ),
+        ))
+        rows.append((
+            "fleet/replicas",
+            "%s routable of %s registered" % (
+                _fmt(flt.get("replicas_routable"), "{:.0f}"),
+                _fmt(flt.get("replicas_total"), "{:.0f}"),
+            ),
+        ))
     passes = summary.get("passes") or {}
     for pname, p in sorted((passes.get("passes") or {}).items()):
         before = p.get("ops_before")

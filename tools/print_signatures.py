@@ -43,6 +43,7 @@ MODULES = [
     "paddle_tpu.lod_tensor",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.fleet",
     "paddle_tpu.data",
     "paddle_tpu.embedding",
     "paddle_tpu.online",
