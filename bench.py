@@ -1131,21 +1131,31 @@ def run_generation_bench(smoke=False):
     traces0 = eng.traces
     no_eos = model_kw["vocab_size"]  # out of range: every finish is "length"
 
-    # mixed-length workload: prompt lengths across every prefill bucket,
-    # output lengths from a handful to a context-filling tail
+    # shared-prefix workload: a couple of page-aligned "system prompts"
+    # reused by 3/4 of the requests (prefix KV cache hits; the long shared
+    # head also pushes those prompts past prefill_chunk, exercising chunked
+    # prefill), plus a cold 1/4 sweeping the full prompt-length range
     rng = np.random.RandomState(0)
     ctx = eng.max_context
-    reqs = [
-        (
-            rng.randint(1, eng.max_prompt_len + 1, size=None),
-            int(rng.randint(4, max(5, ctx // 2))),
-        )
-        for _ in range(n_requests)
+    ps = eng.page_size
+    vocab = model_kw["vocab_size"]
+    sys_len = min(4 * ps, (eng.max_prompt_len - 1) // ps * ps)
+    sys_prompts = [
+        [int(t) for t in rng.randint(0, vocab, size=sys_len)]
+        for _ in range(2)
     ]
-    reqs = [
-        ([int(t) for t in rng.randint(0, model_kw["vocab_size"], size=L)], m)
-        for L, m in reqs
-    ]
+    reqs = []
+    for i in range(n_requests):
+        max_new = int(rng.randint(4, max(5, ctx // 2)))
+        if i % 4 == 3:
+            L = int(rng.randint(1, eng.max_prompt_len + 1))
+            prompt = [int(t) for t in rng.randint(0, vocab, size=L)]
+        else:
+            tail = int(rng.randint(1, eng.max_prompt_len - sys_len + 1))
+            prompt = sys_prompts[i % len(sys_prompts)] + [
+                int(t) for t in rng.randint(0, vocab, size=tail)
+            ]
+        reqs.append((prompt, max_new))
 
     # ---- continuous batching under Poisson arrivals -----------------------
     sched = GenerationScheduler(eng, max_queue_requests=n_requests,
@@ -1204,7 +1214,56 @@ def run_generation_bench(smoke=False):
         o == results[i].tokens for i, o in enumerate(naive_out)
     )
 
+    # ---- head-of-line ablation (full mode): TTFT of short prompts that
+    # arrive while a max-length prompt is streaming, chunked prefill vs
+    # whole-prompt prefill (prefill_chunk = max_context) on identical
+    # geometry — the number chunking exists to improve. Uses a 256-token
+    # context so the whole-prompt prefill call is genuinely expensive
+    # relative to one chunk; the first two rounds warm the host path and
+    # are dropped.
+    hol = None
+    if not smoke:
+        hol_kw = dict(model_kw, max_context=256)
+
+        def _hol_short_ttft(chunk, tag):
+            m2 = GPTDecoder(**hol_kw)
+            e2 = GenerationEngine(m2, name="%s_%s" % (name, tag),
+                                  max_slots=max_slots, page_size=8,
+                                  prefill_chunk=chunk, prefix_cache=False,
+                                  cache_dir=None)
+            e2.warmup()
+            s2 = GenerationScheduler(e2, max_queue_requests=64,
+                                     timeout_ms=120000.0)
+            long_p = [int(t) for t in
+                      rng.randint(0, vocab, size=e2.max_prompt_len)]
+            short_p = [int(t) for t in rng.randint(0, vocab, size=2)]
+            lat = []
+            for r in range(14):
+                fl = s2.submit(long_p, max_new_tokens=8, eos_id=no_eos)
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    s2.submit(short_p, max_new_tokens=1,
+                              eos_id=no_eos).result(60.0)
+                    if r >= 2:
+                        lat.append((time.perf_counter() - t0) * 1e3)
+                fl.result(60.0)
+            s2.close(drain=True)
+            lat.sort()
+            return {
+                "p50_ms": round(lat[len(lat) // 2], 3),
+                "p99_ms": round(lat[min(len(lat) - 1,
+                                        int(len(lat) * 0.99))], 3),
+            }
+
+        hol = {
+            "long_prompt_tokens": hol_kw["max_context"] - 1,
+            "chunked": _hol_short_ttft(None, "holc"),
+            "whole_prompt": _hol_short_ttft(hol_kw["max_context"], "holw"),
+        }
+
     pool = eng.pool.stats()
+    est = eng.stats()
+    pc = est.get("prefix_cache") or {}
     return {
         "metric": "generation_tokens_per_sec_per_chip",
         "value": round(cont_tps, 1),
@@ -1223,6 +1282,12 @@ def run_generation_bench(smoke=False):
         "traces_after_warmup": traces_after,
         "variants": n_variants,
         "prefill_buckets": list(eng.prefill_buckets),
+        "prefill_chunk": eng.prefill_chunk,
+        "prefill_chunks": est["prefill_chunks"],
+        "prefix_hit_rate": round(pc.get("hit_rate", 0.0), 4),
+        "prefix_cache": pc,
+        "kernel_dispatches": est["kernel_dispatches"],
+        "hol_short_ttft_ms": hol,
         "geometry": eng.geometry(),
         "pool": pool,
         "naive_whole_sequence_tokens_per_sec": round(naive_tps, 1),

@@ -57,6 +57,19 @@ Honored flags:
   subdir — a warm replica cold-starts without tracing or compiling
   (docs/serving.md); "" (default) disables the persistent layer (variants
   still cache in-process).
+- paged_flash: dispatch tier for the paged flash-attention serving kernel
+  (ops/pallas_kernels.paged_flash_attention, the decode/chunked-prefill
+  fast path behind the paged_attention lowering). "auto" (default) takes
+  the Pallas kernel on a real TPU and the dense flat-gather reference
+  elsewhere (an interpreted kernel in the decode hot loop is slower than
+  dense XLA on the CPU test mesh); "on" forces the kernel everywhere —
+  interpret mode off-TPU, how the hermetic parity tests pin it; "off"
+  forces the dense reference. paged_flash_path_taken mirrors the decision.
+- gemm_double_buffer: dispatch tier for the manual double-buffered k-loop
+  DMA variant of the fused GEMM kernel (overlaps the HBM→VMEM tile fetch
+  of iteration k+1 with the MXU contraction of iteration k). Same
+  "auto"/"on"/"off" semantics as paged_flash; outputs are bit-identical
+  to the grid-pipelined kernel either way (same accumulation order).
 - data_num_workers: default worker count for the native data runtime
   (paddle_tpu/data/, docs/data.md): PyReader.decorate_* calls that do not
   pass num_workers explicitly use this many multiprocess decode workers;
@@ -133,6 +146,8 @@ _DEFAULTS = {
     "tensor_stats": "",
     "nan_provenance": False,
     "serving_cache_dir": "",
+    "paged_flash": "auto",
+    "gemm_double_buffer": "auto",
     "data_num_workers": 0,
     "data_ring_slots": 0,
     "data_prefetch": 2,

@@ -8,10 +8,14 @@ pattern):
   * ``build_forward``  — whole-sequence causal logits ``[b, t, vocab]``.
     Used for training, parity tests, and as the naive
     whole-sequence-per-request serving ablation in ``bench.py generation``.
-  * ``build_prefill``  — one prompt of bucketed static length ``t`` (batch
-    1): dense causal attention, K/V of every position scattered into the
-    paged pool through the slot's page list, logits of the *last real*
-    position only (``gather`` at ``length - 1``).
+  * ``build_prefill``  — one prompt *chunk* of bucketed static length ``t``
+    (batch 1) starting at an arbitrary position: K/V of every chunk row
+    scattered into the paged pool through the slot's page list, then paged
+    attention back over the pool (causal by position), logits of one
+    selected row. A chunk at start 0 covering the whole prompt is ordinary
+    whole-prompt prefill; long prompts run several chunk calls interleaved
+    with decode steps, and prefix-cache hits skip the leading chunks
+    entirely (serving/generation.py).
   * ``build_decode``   — one token for every slot ``[slots]``: K/V written
     at ``positions`` through per-slot block tables, ``paged_attention``
     over the pool, logits ``[slots, vocab]``.
@@ -24,11 +28,12 @@ same protocol (``build_prefill`` / ``build_decode`` / ``kv_pool_names`` /
 wrapping the NMT infer path's decoder — to ride the engine.
 
 Prefill writes K/V for *padded* positions too (the program is static over
-the bucket length): positions beyond the slot's allocated pages land in
-the pool's scratch page 0, and positions between the prompt length and the
-bucket end inside allocated pages are overwritten by the decode step that
-claims that position before any attention read reaches them — see
-docs/serving.md for the lifecycle argument.
+the chunk length): positions beyond the slot's allocated pages (or past
+the table's capacity) land in the pool's scratch page 0, and positions
+between the prompt length and the chunk end inside allocated pages are
+overwritten by the decode step that claims that position before any
+attention read reaches them — see docs/serving.md for the lifecycle
+argument.
 """
 
 import numpy as np
@@ -131,17 +136,14 @@ class GPTDecoder:
         )
         return layers.elementwise_add(x, f)
 
-    def _dense_block(self, x, i, t, kv_write=None):
-        """Pre-LN block over [b, t, d_model] with dense causal attention.
-        kv_write(k, v) is called with the [b, t, d_model] projections so the
-        prefill program can scatter them into the pool."""
+    def _dense_block(self, x, i, t):
+        """Pre-LN block over [b, t, d_model] with dense causal attention
+        (the whole-sequence training/oracle form)."""
         h = layers.layer_norm(
             x, begin_norm_axis=2,
             param_attr=self._attr(i, "ln1_w"), bias_attr=self._attr(i, "ln1_b"),
         )
         q, k, v = self._qkv(h, i, nfd=2)
-        if kv_write is not None:
-            kv_write(i, k, v)
         split = lambda y: layers.transpose(
             layers.reshape(y, [0, 0, self.n_head, self.d_head]), [0, 2, 1, 3]
         )
@@ -158,8 +160,10 @@ class GPTDecoder:
         return self._mlp_tail(layers.elementwise_add(x, o), i, nfd=2)
 
     def _decode_block(self, x, i, pools, block_table, pos, page_size):
-        """Pre-LN block over [slots, d_model]: write this step's K/V rows
-        into the pool, then attend through the block table."""
+        """Pre-LN block over [rows, d_model] — one query token per row:
+        write each row's K/V into the pool, then attend through the block
+        table ([rows, max_pages] for decode; [max_pages], shared by every
+        row, for a prefill chunk)."""
         h = layers.layer_norm(
             x, begin_norm_axis=1,
             param_attr=self._attr(i, "ln1_w"), bias_attr=self._attr(i, "ln1_b"),
@@ -227,11 +231,23 @@ class GPTDecoder:
         return main, startup, ["fwd_tokens"], [logits.name]
 
     def build_prefill(self, t, page_size, max_pages, pool_rows):
-        """Bucketed prompt ingestion (batch 1): feed gen_tokens [1, t, 1]
-        int64 (zero-padded), gen_length [1] int64, gen_pages [max_pages]
-        int32 (the slot's page list, scratch-0 padded); K/V of all t
-        positions scatter into the pool; fetch last-real-position logits
-        [1, vocab]."""
+        """Paged chunk prefill (batch 1): feed gen_tokens [1, t, 1] int64
+        (zero-padded), gen_start [1] int64 (absolute position of the
+        chunk's first token), gen_last [1] int64 (in-chunk row whose logits
+        to fetch), gen_pages [max_pages] int32 (the slot's page list,
+        scratch-0 padded). K/V of all t chunk rows scatter into the pool at
+        positions gen_start + [0, t), then every row attends the pool
+        through the page list (causal by position) — so a long prompt may
+        ingest in several chunk calls, each reading back the pages earlier
+        chunks (or a shared prefix-cache hit) already filled. A chunk at
+        gen_start 0 with t covering the whole prompt is ordinary
+        whole-prompt prefill: one program family serves both. Fetch the
+        gen_last row's logits [1, vocab].
+
+        Padded tail rows past the context bound are harmless by
+        construction: their kv_cache_write positions are routed to the
+        scratch page by the op's capacity guard, and the position-embedding
+        lookup is clamped (their logits are never fetched)."""
         main, startup = framework.Program(), framework.Program()
         with framework.program_guard(main, startup), unique_name.guard(
             "%s_pf%d_" % (self.prefix, t)
@@ -239,33 +255,38 @@ class GPTDecoder:
             tokens = layers.data(
                 "gen_tokens", [1, t, 1], append_batch_size=False, dtype="int64"
             )
-            length = layers.data(
-                "gen_length", [1], append_batch_size=False, dtype="int64"
+            start = layers.data(
+                "gen_start", [1], append_batch_size=False, dtype="int64"
+            )
+            last = layers.data(
+                "gen_last", [1], append_batch_size=False, dtype="int64"
             )
             pages = layers.data(
                 "gen_pages", [max_pages], append_batch_size=False, dtype="int32"
             )
             pools = self._pool_vars(pool_rows)
-            positions = layers.assign(np.arange(t, dtype="int64").reshape(1, t, 1))
-            pos_flat = layers.assign(np.arange(t, dtype="int64"))
-            x = self._embed(tokens, positions)
-
-            def kv_write(i, k, v):
-                k2 = layers.reshape(k, [t, self.d_model])
-                v2 = layers.reshape(v, [t, self.d_model])
-                layers.kv_cache_write(pools[i][0], k2, pages, pos_flat, page_size)
-                layers.kv_cache_write(pools[i][1], v2, pages, pos_flat, page_size)
-
-            for i in range(self.n_layer):
-                x = self._dense_block(x, i, t, kv_write)
-            h = self._final(x, nfd=2)
-            flat = layers.reshape(h, [t, self.d_model])
-            last_idx = layers.elementwise_sub(
-                length, layers.assign(np.array([1], "int64"))
+            pos_flat = layers.elementwise_add(
+                layers.assign(np.arange(t, dtype="int64")), start
             )
-            last = layers.gather(flat, last_idx)  # [1, d_model]
-            logits = self._head(last, nfd=1)
-        return main, startup, ["gen_tokens", "gen_length", "gen_pages"], [logits.name]
+            emb_pos = layers.elementwise_min(
+                pos_flat,
+                layers.assign(np.full([1], self.max_context - 1, "int64")),
+            )
+            x = self._embed(tokens, layers.reshape(emb_pos, [1, t, 1]))
+            x2 = layers.reshape(x, [t, self.d_model])
+            for i in range(self.n_layer):
+                x2 = self._decode_block(
+                    x2, i, pools, pages, pos_flat, page_size
+                )
+            h = self._final(x2, nfd=1)
+            last_row = layers.gather(h, last)  # [1, d_model]
+            logits = self._head(last_row, nfd=1)
+        return (
+            main,
+            startup,
+            ["gen_tokens", "gen_start", "gen_last", "gen_pages"],
+            [logits.name],
+        )
 
     def build_decode(self, slots, page_size, max_pages, pool_rows):
         """One decode step for every slot: feed dec_tokens [slots, 1] int64,

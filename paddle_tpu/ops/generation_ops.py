@@ -18,6 +18,18 @@ Conventions:
   * ``kv_cache_write`` outputs the pool variable itself (the in-place
     idiom, like ``increment``), so the executor classifies the pool as
     written state and threads the new buffer to the next step.
+  * ``paged_attention`` takes a block table of either shape: ``[S, P]``
+    (decode — one page list per query row) or ``[P]`` (chunked prefill —
+    one slot's list shared by every row of the chunk). Masking is a proper
+    where-mask with a safe softmax: masked scores are dropped, never added
+    as a large negative constant (the additive ``-1e9`` form leaks
+    probability mass once scores live in bf16 at long context), and a
+    fully-masked row (pos < 0) emits zeros instead of 0/0 NaN.
+  * On TPU (or when FLAGS_paged_flash forces it) the lowering dispatches to
+    the paged flash-attention Pallas kernel (ops/pallas_kernels.py), which
+    walks the block table page by page with an online softmax and never
+    materializes the gathered context. The dense form below stays as the
+    decline target and the parity oracle (PR 11 contract).
 """
 
 import jax
@@ -27,21 +39,26 @@ from .registry import register
 
 __all__ = []
 
-_NEG_INF = -1e9
-
 
 def _flat_rows(block_table, positions, page_size):
     """Pool row index for each (slot, position): block_table picks the page,
     position % page_size the offset. block_table may be [S, P] (decode, one
-    row per slot) or [P] (prefill, one slot writing many positions)."""
+    row per slot) or [P] (prefill, one slot writing many positions). A
+    position at or past the table's capacity (P * page_size — only the
+    padded tail of a prefill chunk near the context bound can get there) is
+    routed to the scratch page's rows instead of clamp-corrupting the last
+    real page."""
     positions = positions.reshape(-1).astype(jnp.int32)
     page_idx = positions // page_size
+    n_pages = block_table.shape[-1]
+    safe_idx = jnp.minimum(page_idx, n_pages - 1)
     if block_table.ndim == 1:
-        page_id = block_table.astype(jnp.int32)[page_idx]
+        page_id = block_table.astype(jnp.int32)[safe_idx]
     else:
         page_id = jnp.take_along_axis(
-            block_table.astype(jnp.int32), page_idx[:, None], axis=1
+            block_table.astype(jnp.int32), safe_idx[:, None], axis=1
         )[:, 0]
+    page_id = jnp.where(page_idx < n_pages, page_id, 0)
     return page_id * page_size + positions % page_size
 
 
@@ -58,34 +75,62 @@ def _kv_cache_write(ctx, ins, attrs):
 
 @register("paged_attention", no_grad=True)
 def _paged_attention(ctx, ins, attrs):
-    (q,) = ins["Q"]  # [S, H*D] — one query token per slot
+    (q,) = ins["Q"]  # [S, H*D] — one query token per row
     (kp,) = ins["KPool"]
     (vp,) = ins["VPool"]
-    (bt,) = ins["BlockTable"]  # [S, P] int32 page ids (0 = scratch/unused)
-    (pos,) = ins["Pos"]  # [S] position of the query token (attends 0..pos)
+    (bt,) = ins["BlockTable"]  # [S, P] or [P] int32 page ids (0 = scratch)
+    (pos,) = ins["Pos"]  # [S] position of each query (attends 0..pos)
     n_head = int(attrs["n_head"])
     page_size = int(attrs["page_size"])
-    s, p = bt.shape
+    s = q.shape[0]
+    p = bt.shape[-1]
     ctx_len = p * page_size
     d = q.shape[-1] // n_head
     scale = float(attrs.get("sm_scale") or 0.0) or d**-0.5
 
-    flat = (
-        bt.astype(jnp.int32)[:, :, None] * page_size
-        + jnp.arange(page_size, dtype=jnp.int32)[None, None, :]
-    ).reshape(s, ctx_len)
-    k = jnp.take(kp, flat.reshape(-1), axis=0).reshape(s, ctx_len, n_head, d)
-    v = jnp.take(vp, flat.reshape(-1), axis=0).reshape(s, ctx_len, n_head, d)
-    qh = q.reshape(s, n_head, d).astype(jnp.float32)
+    from . import pallas_kernels as _pk
 
-    scores = jnp.einsum("shd,schd->shc", qh, k.astype(jnp.float32)) * scale
-    # causal-by-position: the query at position pos sees context rows
-    # 0..pos inclusive (its own K/V row was written earlier this step).
+    if _pk.paged_flash_path_taken(s, p, page_size, n_head, d):
+        out = _pk.paged_flash_attention(
+            q, kp, vp, bt, pos,
+            n_head=n_head, page_size=page_size, sm_scale=scale,
+        )
+        return {"Out": [out]}
+
+    qh = q.reshape(s, n_head, d).astype(jnp.float32)
+    offsets = jnp.arange(page_size, dtype=jnp.int32)
+    if bt.ndim == 1:
+        # one shared page list: gather each context row once for all queries
+        flat = (bt.astype(jnp.int32)[:, None] * page_size + offsets[None, :])
+        flat = flat.reshape(ctx_len)
+        k = jnp.take(kp, flat, axis=0).reshape(ctx_len, n_head, d)
+        v = jnp.take(vp, flat, axis=0).reshape(ctx_len, n_head, d)
+        scores = jnp.einsum("shd,chd->shc", qh, k.astype(jnp.float32)) * scale
+    else:
+        flat = (
+            bt.astype(jnp.int32)[:, :, None] * page_size
+            + offsets[None, None, :]
+        ).reshape(s, ctx_len)
+        k = jnp.take(kp, flat.reshape(-1), axis=0).reshape(s, ctx_len, n_head, d)
+        v = jnp.take(vp, flat.reshape(-1), axis=0).reshape(s, ctx_len, n_head, d)
+        scores = jnp.einsum("shd,schd->shc", qh, k.astype(jnp.float32)) * scale
+
+    # causal-by-position where-mask + safe softmax: the query at position
+    # pos sees context rows 0..pos inclusive (its own K/V row was written
+    # earlier this step). Dead rows are EXCLUDED (weight exactly 0), not
+    # additively depressed; a fully-masked row (pos < 0) emits zeros.
     live = (
         jnp.arange(ctx_len, dtype=jnp.int32)[None, :]
         <= pos.reshape(-1).astype(jnp.int32)[:, None]
-    )
-    scores = jnp.where(live[:, None, :], scores, _NEG_INF)
-    weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("shc,schd->shd", weights, v.astype(jnp.float32))
+    )[:, None, :]
+    scores = jnp.where(live, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    w = jnp.where(live, jnp.exp(scores - m), 0.0)
+    denom = jnp.sum(w, axis=-1, keepdims=True)
+    w = w / jnp.where(denom > 0.0, denom, 1.0)
+    if bt.ndim == 1:
+        out = jnp.einsum("shc,chd->shd", w, v.astype(jnp.float32))
+    else:
+        out = jnp.einsum("shc,schd->shd", w, v.astype(jnp.float32))
     return {"Out": [out.reshape(s, n_head * d).astype(q.dtype)]}

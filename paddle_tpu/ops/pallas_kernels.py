@@ -41,6 +41,9 @@ __all__ = [
     "flash_path_taken",
     "gemm_bias_act",
     "gemm_path_taken",
+    "gemm_dbuf_path_taken",
+    "paged_flash_attention",
+    "paged_flash_path_taken",
     "fused_layer_norm",
     "fused_layer_norm_grad",
     "ln_path_taken",
@@ -983,6 +986,135 @@ def gemm_path_taken(m, n, k, block_m=None, block_n=None, block_k=None):
     )
 
 
+def gemm_dbuf_path_taken(m, n, k, block_m=None, block_n=None, block_k=None):
+    """Mirror of the double-buffered-GEMM dispatch: the manual k-loop DMA
+    kernel runs exactly when the ordinary tiled kernel would (same tile
+    feasibility — the accumulation order is identical, so outputs are
+    bit-identical) AND the gemm_double_buffer flag takes it: "on" forces it
+    everywhere (interpret-mode parity tests), "auto" takes it only on a real
+    TPU (manual DMA emulation underperforms the pipelined form on the CPU
+    interpreter), "off" keeps the grid-pipelined kernel."""
+    from .. import flags as _flags
+
+    mode = _flags.get_flags("gemm_double_buffer")["gemm_double_buffer"]
+    if mode == "off":
+        return False
+    if not gemm_path_taken(m, n, k, block_m, block_n, block_k):
+        return False
+    if mode == "on":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _gemm_dbuf_kernel(x_hbm, w_hbm, b_ref, z_ref, y_ref, xb, wb, acc_ref,
+                      sem, *, act, bm, bn, bk, nk):
+    """One (m_block, n_block) output tile with an explicit double-buffered
+    k loop: x/w stay HBM-resident (memory_space=ANY) and the kernel DMAs
+    tile k+1 into the spare VMEM slot while the MXU contracts tile k — the
+    overlap the grid-pipelined form leaves to the emitter, written out by
+    hand so the k stream never stalls on the copy. Accumulation order and
+    epilogue are identical to _gemm_epilogue_kernel (bit-identical parity
+    is asserted by tests)."""
+    mi = pl.program_id(0)
+    ni = pl.program_id(1)
+
+    def tile_in(ki, slot):
+        cx = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(mi * bm, bm), pl.ds(ki * bk, bk)],
+            xb.at[slot], sem.at[slot, 0],
+        )
+        cw = pltpu.make_async_copy(
+            w_hbm.at[pl.ds(ki * bk, bk), pl.ds(ni * bn, bn)],
+            wb.at[slot], sem.at[slot, 1],
+        )
+        return cx, cw
+
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    for c in tile_in(0, 0):
+        c.start()
+
+    def body(ki, _):
+        slot = jax.lax.rem(ki, 2)
+
+        @pl.when(ki + 1 < nk)
+        def _prefetch():
+            for c in tile_in(ki + 1, 1 - slot):
+                c.start()
+
+        for c in tile_in(ki, slot):
+            c.wait()
+        acc_ref[...] += jax.lax.dot_general(
+            xb[slot], wb[slot], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return 0
+
+    jax.lax.fori_loop(0, nk, body, 0)
+    z = acc_ref[...] + b_ref[...].astype(jnp.float32)
+    z_ref[...] = z.astype(z_ref.dtype)
+    if y_ref is not None:
+        y_ref[...] = _GEMM_ACT_F32[act](z).astype(y_ref.dtype)
+
+
+def _gemm_dbuf_no_act_adapter(kernel, x_hbm, w_hbm, b_ref, z_ref, xb, wb,
+                              acc_ref, sem):
+    kernel(x_hbm, w_hbm, b_ref, z_ref, None, xb, wb, acc_ref, sem)
+
+
+def _gemm_bias_act_dbuf(x2, w2, bias_row, act, bm, bn, bk, interpret):
+    m, k = x2.shape
+    n = w2.shape[1]
+    grid = (m // bm, n // bn)
+    nk = k // bk
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec((1, bn), lambda mi, ni: (0, ni)),
+    ]
+    out_spec = pl.BlockSpec((bm, bn), lambda mi, ni: (mi, ni))
+    scratch = [
+        pltpu.VMEM((2, bm, bk), x2.dtype),
+        pltpu.VMEM((2, bk, bn), w2.dtype),
+        pltpu.VMEM((bm, bn), jnp.float32),
+        pltpu.SemaphoreType.DMA((2, 2)),
+    ]
+    kernel = functools.partial(
+        _gemm_dbuf_kernel, act=act, bm=bm, bn=bn, bk=bk, nk=nk
+    )
+    cost = pl.CostEstimate(
+        flops=2 * m * n * k,
+        bytes_accessed=(x2.size + w2.size) * x2.dtype.itemsize
+        + (2 if act else 1) * m * n * x2.dtype.itemsize,
+        transcendentals=m * n if act in ("gelu", "tanh", "sigmoid") else 0,
+    )
+    if act is None:
+        z = pl.pallas_call(
+            functools.partial(_gemm_dbuf_no_act_adapter, kernel),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_spec,
+            out_shape=jax.ShapeDtypeStruct((m, n), x2.dtype),
+            scratch_shapes=scratch,
+            cost_estimate=cost,
+            interpret=interpret,
+        )(x2, w2, bias_row)
+        return z, None
+    z, y = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), x2.dtype),
+            jax.ShapeDtypeStruct((m, n), x2.dtype),
+        ],
+        scratch_shapes=scratch,
+        cost_estimate=cost,
+        interpret=interpret,
+    )(x2, w2, bias_row)
+    return z, y
+
+
 def _gemm_epilogue_kernel(x_ref, w_ref, b_ref, z_ref, y_ref, acc_ref, *, act):
     """One (m_block, n_block) output tile: stream k blocks through the
     innermost grid dim into an f32 VMEM accumulator; on the last k step add
@@ -1036,6 +1168,9 @@ def gemm_bias_act(x2, w2, bias_row, act=None, *, block_m=None, block_n=None,
     bm = _auto_block(m, block_m or _DEF_GEMM_BLOCK_M)
     bn = _auto_block(n, block_n or _DEF_GEMM_BLOCK_N)
     bk = _auto_block(k, block_k or _DEF_GEMM_BLOCK_K)
+    if gemm_dbuf_path_taken(m, n, k, block_m, block_n, block_k):
+        _note_dispatch("gemm_dbuf")
+        return _gemm_bias_act_dbuf(x2, w2, bias_row, act, bm, bn, bk, interpret)
     grid = (m // bm, n // bn, k // bk)  # k innermost: acc carries across it
     in_specs = [
         pl.BlockSpec((bm, bk), lambda mi, ni, ki: (mi, ki)),
@@ -1076,6 +1211,225 @@ def gemm_bias_act(x2, w2, bias_row, act=None, *, block_m=None, block_n=None,
         interpret=interpret,
     )(x2, w2, bias_row)
     return z, y
+
+
+# ---------------------------------------------------------------------------
+# paged flash attention — the serving decode/chunk-prefill kernel. Walks a
+# slot's block table page by page with the online-softmax recurrence in a
+# VMEM accumulator, reading K/V pages straight out of the paged pool and
+# masking by position inside the loop — the gathered [*, ctx, heads, d]
+# context of the dense lowering is never materialized.
+# ---------------------------------------------------------------------------
+
+
+def paged_flash_path_taken(n_q, n_pages, page_size, n_head, d_head):
+    """EXACT mirror of the paged_attention lowering's kernel-vs-dense
+    decision. The paged_flash flag picks the tier: "off" always takes the
+    dense flat-gather reference; "on" always takes the kernel (interpret
+    mode off-TPU — the hermetic parity tests force this); "auto" (default)
+    takes the kernel only on a real TPU, because an interpreted Pallas body
+    in the decode hot loop is slower than the dense XLA gather on the CPU
+    test mesh. Geometry beyond this never declines: the kernel walks any
+    (pages, page_size, heads) layout page by page."""
+    from .. import flags as _flags
+
+    mode = _flags.get_flags("paged_flash")["paged_flash"]
+    if mode == "off":
+        return False
+    if min(int(n_q), int(n_pages), int(page_size), int(n_head), int(d_head)) < 1:
+        return False
+    if mode == "on":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _paged_flash_update(s, live, v2, acc_ref, m_ref, l_ref):
+    """One page's online-softmax step. s is [rows, page_size] f32 scores
+    (masked entries already -inf), live the same-shaped mask, v2 the page's
+    [page_size, d] V rows. Carries (m, l, acc) live in VMEM scratch; m/l are
+    lane-broadcast like the flash kernels above."""
+    m_prev = m_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # alpha rescales the old accumulator; exp(-inf - -inf) = nan, so pin
+    # never-seen rows (m_prev = -inf) to alpha = 0 explicitly
+    alpha = jnp.exp(jnp.where(m_prev == -jnp.inf, -jnp.inf, m_prev - m_new))
+    p = jnp.exp(s - m_new)
+    p = jnp.where(live, p, 0.0)  # kills the -inf - -inf nan on dead rows too
+    l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v2, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+
+def _paged_flash_emit(o_ref, acc_ref, l_ref):
+    # safe softmax tail: a fully-masked row (pos < 0, nothing live) has
+    # l = 0 and emits zeros instead of 0/0 nan — the where-mask contract
+    # the dense reference shares
+    l = l_ref[:, :1]
+    o_ref[...] = (acc_ref[...] / jnp.where(l > 0.0, l, 1.0))[:, None, :].astype(
+        o_ref.dtype
+    )
+
+
+def _paged_flash_decode_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                               acc_ref, m_ref, l_ref, *, page_size, sm_scale):
+    """grid (slot, head, page): per-slot block tables, one query row each.
+    The block table rides in as a scalar-prefetch operand so the K/V
+    BlockSpec index_map can chase pages; pos masks inside the loop."""
+    si = pl.program_id(0)
+    pi = pl.program_id(2)
+    pos = pos_ref[si]
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(pi * page_size <= pos)  # pages wholly past pos: skip the MXU
+    def _page():
+        q2 = q_ref[:, 0, :]  # (1, d)
+        k2 = k_ref[:, 0, :]  # (page_size, d)
+        s = jax.lax.dot_general(
+            q2, k2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # (1, page_size)
+        offs = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        live = offs <= pos
+        s = jnp.where(live, s, -jnp.inf)
+        _paged_flash_update(s, live, v_ref[:, 0, :], acc_ref, m_ref, l_ref)
+
+    @pl.when(pi == pl.num_programs(2) - 1)
+    def _emit():
+        _paged_flash_emit(o_ref, acc_ref, l_ref)
+
+
+def _paged_flash_shared_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                               acc_ref, m_ref, l_ref, *, page_size, sm_scale):
+    """grid (head, page): ONE block table shared by every query row (the
+    chunked-prefill form — a chunk's rows all walk the same slot's pages),
+    so each page is streamed into VMEM once for all rows instead of once
+    per row."""
+    pi = pl.program_id(1)
+    pos = pos_ref[...]  # (rows,)
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(pi * page_size <= jnp.max(pos))
+    def _page():
+        q2 = q_ref[:, 0, :]  # (rows, d)
+        k2 = k_ref[:, 0, :]  # (page_size, d)
+        s = jax.lax.dot_general(
+            q2, k2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # (rows, page_size)
+        offs = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        live = offs <= pos[:, None]
+        s = jnp.where(live, s, -jnp.inf)
+        _paged_flash_update(s, live, v_ref[:, 0, :], acc_ref, m_ref, l_ref)
+
+    @pl.when(pi == pl.num_programs(1) - 1)
+    def _emit():
+        _paged_flash_emit(o_ref, acc_ref, l_ref)
+
+
+def paged_flash_attention(q, k_pool, v_pool, block_table, pos, *, n_head,
+                          page_size, sm_scale=None, interpret=None):
+    """Paged attention over the KV pool without materializing the gathered
+    context. q is [rows, n_head*d]; block_table is [rows, P] (decode — one
+    page list per query row) or [P] (chunked prefill — one list shared by
+    all rows); pos[r] bounds row r's live context (attends 0..pos
+    inclusive; pos < 0 means fully masked and emits zeros). Returns
+    [rows, n_head*d] in q's dtype with f32 accumulation — bit-bounded, not
+    bit-identical, vs the dense reference (the online softmax reassociates
+    the sum)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    rows, feat = q.shape
+    d = feat // n_head
+    scale = float(sm_scale or 0.0) or d**-0.5
+    q3 = q.reshape(rows, n_head, d)
+    k3 = k_pool.reshape(-1, n_head, d)
+    v3 = v_pool.reshape(-1, n_head, d)
+    bt = block_table.astype(jnp.int32)
+    pos_v = pos.reshape(-1).astype(jnp.int32)
+    _note_dispatch("paged_flash")
+    if bt.ndim == 1:
+        n_pages = bt.shape[0]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(n_head, n_pages),
+            in_specs=[
+                pl.BlockSpec((rows, 1, d), lambda h, p, bt_r, pos_r: (0, h, 0)),
+                pl.BlockSpec(
+                    (page_size, 1, d),
+                    lambda h, p, bt_r, pos_r: (bt_r[p], h, 0),
+                ),
+                pl.BlockSpec(
+                    (page_size, 1, d),
+                    lambda h, p, bt_r, pos_r: (bt_r[p], h, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (rows, 1, d), lambda h, p, bt_r, pos_r: (0, h, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((rows, d), jnp.float32),
+                pltpu.VMEM((rows, _LANES), jnp.float32),
+                pltpu.VMEM((rows, _LANES), jnp.float32),
+            ],
+        )
+        kernel = functools.partial(
+            _paged_flash_shared_kernel, page_size=page_size, sm_scale=scale
+        )
+    else:
+        n_pages = bt.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(rows, n_head, n_pages),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, d), lambda s, h, p, bt_r, pos_r: (s, h, 0)
+                ),
+                pl.BlockSpec(
+                    (page_size, 1, d),
+                    lambda s, h, p, bt_r, pos_r: (bt_r[s, p], h, 0),
+                ),
+                pl.BlockSpec(
+                    (page_size, 1, d),
+                    lambda s, h, p, bt_r, pos_r: (bt_r[s, p], h, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, d), lambda s, h, p, bt_r, pos_r: (s, h, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((1, d), jnp.float32),
+                pltpu.VMEM((1, _LANES), jnp.float32),
+                pltpu.VMEM((1, _LANES), jnp.float32),
+            ],
+        )
+        kernel = functools.partial(
+            _paged_flash_decode_kernel, page_size=page_size, sm_scale=scale
+        )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, n_head, d), q.dtype),
+        interpret=interpret,
+    )(bt, pos_v, q3, k3, v3)
+    return out.reshape(rows, feat)
 
 
 # ---------------------------------------------------------------------------

@@ -38,7 +38,7 @@ from .generation import (  # noqa: F401
     GenRequest,
     GenResult,
 )
-from .kv_cache import PagedKVPool, PoolExhausted  # noqa: F401
+from .kv_cache import PagedKVPool, PoolExhausted, PrefixCache  # noqa: F401
 from .server import ModelServer  # noqa: F401
 
 __all__ = [
@@ -55,5 +55,6 @@ __all__ = [
     "GenRequest",
     "GenResult",
     "PagedKVPool",
+    "PrefixCache",
     "PoolExhausted",
 ]

@@ -10,13 +10,23 @@ module is the Orca/vLLM answer, built from the same parts:
 **GenerationEngine** AOT-compiles exactly TWO variant families through
 `executor.aot_serve_lowering(return_state=True)`:
 
-- *prefill* — one program per pow2 prompt-length bucket (batch 1): dense
-  causal attention over the padded prompt, K/V of every position scattered
-  into the paged pool through the slot's page list, last-real-position
-  logits out.
+- *prefill* — one CHUNK program per pow2 bucket up to `prefill_chunk`
+  (batch 1): the chunk's rows take positions `gen_start + [0, t)`, write
+  their K/V into the slot's pages, and attend the pool causally-by-position
+  through the same `paged_attention` path decode uses — so a long prompt
+  prefills as a sequence of fixed-shape chunk calls (interleaved with
+  decode steps by the scheduler: short requests keep streaming while a long
+  prompt works through its chunks), and a chunk at start 0 covering the
+  whole prompt IS whole-prompt prefill. One family, zero new retraces.
 - *decode* — ONE fixed shape, `[max_slots]`: every live slot advances one
   token through `paged_attention` gather/scatter. Idle slots ride along
   pointing at the scratch page.
+
+Admission consults a **PrefixCache** (kv_cache.py): requests whose prompt
+shares full cached pages with an earlier prompt start prefill at the first
+uncached position, with the shared (refcounted, immutable) pages filling
+the leading block-table entries — the system-prompt workload prefills its
+common prefix once.
 
 Every variant builds through the persistent CompileCache with the decode
 state avals and page geometry folded into the key, then AOT-compiles
@@ -29,10 +39,11 @@ tests/test_generation.py; single-shot serving stays donation-free.
 
 **GenerationScheduler** extends ContinuousBatcher into a token-level
 scheduler: the worker loop admits queued requests into free decode slots
-*mid-batch* between steps (prefill interleaved with decode under a
-queue-pressure policy — one prefill per step when idle, up to all free
-slots when the queue is deep), runs one decode step for all live slots,
-and retires slots on EOS/max-len, releasing their pages for reuse.
+*mid-batch* between steps (admission is host-only; prefill CHUNKS are
+interleaved with decode under a queue-pressure policy — one chunk per step
+when idle, draining every pending prompt when the queue is deep), runs one
+decode step for all live slots, and retires slots on EOS/max-len,
+releasing their pages for reuse.
 
 Sampling (greedy / temperature / top-k) happens host-side on the fetched
 logits with a per-request counter-based RNG stream seeded from the scope
@@ -56,7 +67,7 @@ from .batcher import (
     ServingFuture,
     ShutdownError,
 )
-from .kv_cache import PagedKVPool, PoolExhausted
+from .kv_cache import PagedKVPool, PoolExhausted, PrefixCache
 from . import compile_cache as _cc
 
 __all__ = [
@@ -149,7 +160,8 @@ class _SlotRun:
     """Engine-side state of one admitted request occupying a decode slot."""
 
     __slots__ = ("req", "slot", "table", "tokens", "next_pos", "rng",
-                 "done", "finish_reason", "future", "t_submit", "t_first")
+                 "pf_pos", "done", "finish_reason", "future", "t_submit",
+                 "t_first")
 
     def __init__(self, req, slot, table, rng):
         self.req = req
@@ -158,6 +170,7 @@ class _SlotRun:
         self.tokens = []
         self.next_pos = len(req.prompt)
         self.rng = rng
+        self.pf_pos = 0  # next prompt position to prefill (past prefix hits)
         self.done = False
         self.finish_reason = None
         self.future = None
@@ -190,7 +203,8 @@ class GenerationEngine:
 
     def __init__(self, model, name="generation", scope=None, place=None,
                  max_slots=4, page_size=8, pool_pages=None, max_context=None,
-                 prefill_buckets=None, cache_dir=None):
+                 prefill_buckets=None, prefill_chunk=None, prefix_cache=True,
+                 cache_dir=None):
         import jax.numpy as jnp
 
         self.model = model
@@ -213,13 +227,26 @@ class GenerationEngine:
         self.pool = PagedKVPool(
             self.pool_pages, self.page_size, self.max_slots, self.max_pages
         )
+        # prefill compiles one chunk program per pow2 bucket up to
+        # prefill_chunk; prompts longer than the largest bucket run as a
+        # sequence of chunk calls, so buckets stop growing with the context
+        # window (default cap 32 rows: past that a chunk's FLOPs amortize
+        # its launch and chunking wins back scheduler interleaving)
+        chunk = int(prefill_chunk) if prefill_chunk else min(self.max_context, 32)
         self.prefill_buckets = tuple(sorted(set(
-            int(b) for b in (prefill_buckets or _pow2_buckets(2, self.max_context))
+            int(b)
+            for b in (
+                prefill_buckets
+                or _pow2_buckets(2, min(self.max_context, chunk))
+            )
         )))
         if self.prefill_buckets[-1] > self.max_context:
             raise ValueError("prefill bucket > max_context")
-        # longest admissible prompt must leave room for >= 1 generated token
-        self.max_prompt_len = min(self.prefill_buckets[-1], self.max_context - 1)
+        self.prefill_chunk = self.prefill_buckets[-1]
+        # longest admissible prompt must leave room for >= 1 generated
+        # token; chunking covers any prompt up to the context bound
+        self.max_prompt_len = self.max_context - 1
+        self.prefix_cache = PrefixCache(self.pool) if prefix_cache else None
 
         self.scope = scope or Scope()
         model.ensure_params(self.scope, place)
@@ -236,6 +263,21 @@ class GenerationEngine:
 
             cache_dir = _flags.get_flags("serving_cache_dir")["serving_cache_dir"]
         self.cache = _cc.CompileCache(cache_dir) if cache_dir else None
+
+        # persistent decode-step feed buffers: the hot loop allocates
+        # nothing. Rows are slot-owned — armed when a slot's prefill
+        # completes, refreshed for the runs in each step, zeroed (back to
+        # the scratch page) at finish(). A mid-prefill slot therefore keeps
+        # writing scratch during interleaved decode steps (its table row is
+        # still zeros), and a live slot skipped by one step merely rewrites
+        # its last K/V row with identical bits.
+        self._dec_feeds = {
+            "dec_tokens": np.zeros((self.max_slots, 1), np.int64),
+            "dec_positions": np.zeros((self.max_slots, 1), np.int64),
+            "dec_block_table": np.zeros(
+                (self.max_slots, self.max_pages), np.int32
+            ),
+        }
 
         self._variants = {}
         self._build_lock = threading.Lock()
@@ -265,7 +307,21 @@ class GenerationEngine:
             p + "/gen_step_ms", "one decode step, wall ms"
         )
         self._m_prefill_ms = reg.histogram(
-            p + "/gen_prefill_ms", "one prefill call, wall ms"
+            p + "/gen_prefill_ms", "one prefill chunk call, wall ms"
+        )
+        self._m_chunks = reg.counter(
+            p + "/gen_prefill_chunks", "prefill chunk calls executed"
+        )
+        self._m_prefix_hit = reg.gauge(
+            p + "/gen_prefix_hit_rate",
+            "prefix-cache page hit rate (pages hit / pages eligible)",
+        )
+        self._m_pages_shared = reg.gauge(
+            p + "/gen_pages_shared", "KV pool pages held by > 1 reference"
+        )
+        self._m_paged_flash = reg.gauge(
+            p + "/gen_paged_flash_dispatches",
+            "paged_attention lowerings that chose the Pallas kernel",
         )
         # hot-swap state (docs/online.md): each _Variant holds its own ro
         # dict; set_params swaps them (and the scope) under _swap_lock.
@@ -448,15 +504,14 @@ class GenerationEngine:
         self._state.update(new_mut)
         return fetches
 
-    # ---- admission / decode / retire --------------------------------------
-    def prefill_bucket(self, prompt_len):
+    # ---- admission / prefill / decode / retire -----------------------------
+    def prefill_bucket(self, n):
+        """Smallest chunk bucket covering `n` remaining prompt tokens, or
+        the largest (= prefill_chunk) when the remainder spans chunks."""
         for b in self.prefill_buckets:
-            if prompt_len <= b:
+            if n <= b:
                 return b
-        raise ValueError(
-            "prompt of %d tokens exceeds the largest prefill bucket %d"
-            % (prompt_len, self.prefill_buckets[-1])
-        )
+        return self.prefill_buckets[-1]
 
     def can_admit(self, req):
         """Whether a free slot + pages exist for this request right now."""
@@ -470,49 +525,101 @@ class GenerationEngine:
     def free_slots(self):
         return self.max_slots - self.pool.stats()["slots_in_use"]
 
-    def start(self, req):
-        """Admit one request: acquire slot+pages, run the prompt's prefill
-        bucket, sample the first token. Returns a _SlotRun (possibly already
-        done). Raises PoolExhausted when no capacity, ValueError on an
-        inadmissible request."""
+    def admit(self, req):
+        """Reserve a slot + pages for one request — host work only, no
+        device call. Prefix-cache hits fill the leading block-table entries
+        and skip those pages' prefill; the caller then advances the prompt
+        with prefill_step() until it returns True. Raises PoolExhausted
+        when no capacity (after trying to evict cold cached pages),
+        ValueError on an inadmissible request."""
         L = len(req.prompt)
         if L > self.max_prompt_len:
             raise ValueError(
                 "prompt of %d tokens exceeds max_prompt_len %d"
                 % (L, self.max_prompt_len)
             )
-        bucket = self.prefill_bucket(L)
         max_new = self._max_new(req)
-        slot, table = self.pool.acquire(L + max_new)
+        shared = []
+        if self.prefix_cache is not None:
+            shared = self.prefix_cache.lookup(req.prompt)  # pages pinned
         try:
-            seed = req.seed
-            if seed is None:
-                seed = (self.scope._seed, self._sample_counter)
-                self._sample_counter += 1
-            rng = np.random.default_rng(seed)
-            run = _SlotRun(req, slot, table, rng)
+            try:
+                slot, table = self.pool.acquire(L + max_new, shared)
+            except PoolExhausted:
+                need = self.pool.pages_for(L + max_new) - len(shared)
+                if self.prefix_cache is None or not self.prefix_cache.evict_for(need):
+                    raise
+                slot, table = self.pool.acquire(L + max_new, shared)
+        finally:
+            if shared:
+                self.pool.unpin_pages(shared)  # slot ref (or nothing) holds now
+        seed = req.seed
+        if seed is None:
+            seed = (self.scope._seed, self._sample_counter)
+            self._sample_counter += 1
+        run = _SlotRun(req, slot, table, np.random.default_rng(seed))
+        run.pf_pos = len(shared) * self.page_size
+        self._set_pool_gauges()
+        return run
 
-            tokens = np.zeros((1, bucket, 1), np.int64)
-            tokens[0, :L, 0] = req.prompt
-            t0 = time.perf_counter()
-            (logits,) = self._call(
-                self._variant("prefill:%d" % bucket),
-                {
-                    "gen_tokens": tokens,
-                    "gen_length": np.array([L], np.int64),
-                    "gen_pages": table,
-                },
-            )
-            self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
-            self._m_prefills.inc()
-            # parity surface: tests assert these rows bit-stable under
-            # batching/admission changes (docs/serving.md contract)
-            self.last_prefill_logits = np.asarray(logits)[0]
-            self._append_token(run, self.last_prefill_logits, max_new)
-            self._set_pool_gauges()
+    def prefill_step(self, run):
+        """Advance one admitted run by ONE prefill chunk (one device call).
+        Returns True when the prompt is fully prefilled — the first token
+        has then been sampled and the run is decodable (or already done)."""
+        req = run.req
+        L = len(req.prompt)
+        start = run.pf_pos
+        remaining = L - start
+        if remaining <= 0:
+            raise ValueError("prefill_step on a fully prefilled run")
+        c = self.prefill_bucket(remaining)
+        n_real = min(c, remaining)
+        tokens = np.zeros((1, c, 1), np.int64)
+        tokens[0, :n_real, 0] = req.prompt[start:start + n_real]
+        t0 = time.perf_counter()
+        (logits,) = self._call(
+            self._variant("prefill:%d" % c),
+            {
+                "gen_tokens": tokens,
+                "gen_start": np.array([start], np.int64),
+                "gen_last": np.array([n_real - 1], np.int64),
+                "gen_pages": run.table,
+            },
+        )
+        self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+        self._m_chunks.inc()
+        run.pf_pos = start + n_real
+        if run.pf_pos < L:
+            return False
+        self._m_prefills.inc()
+        # parity surface: tests assert these rows bit-stable under
+        # batching/admission/chunking changes (docs/serving.md contract)
+        self.last_prefill_logits = np.asarray(logits)[0]
+        self._append_token(run, self.last_prefill_logits, self._max_new(req))
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, run.table)
+        # arm the slot's persistent decode-feed rows only now: until the
+        # last chunk lands, an interleaved decode step must keep this slot
+        # on the scratch page, never writing a page a chunk already filled
+        self._dec_feeds["dec_block_table"][run.slot] = run.table
+        self._dec_feeds["dec_tokens"][run.slot, 0] = run.tokens[-1]
+        self._dec_feeds["dec_positions"][run.slot, 0] = run.next_pos
+        self._set_pool_gauges()
+        return True
+
+    def start(self, req):
+        """Admit one request and run its whole prefill back-to-back,
+        sampling the first token. Returns a _SlotRun (possibly already
+        done). Raises PoolExhausted when no capacity, ValueError on an
+        inadmissible request. The scheduler instead interleaves
+        prefill_step() chunks with decode steps."""
+        run = self.admit(req)
+        try:
+            while not self.prefill_step(run):
+                pass
             return run
         except Exception:
-            self.pool.release(slot)
+            self.finish(run)
             raise
 
     def decode_step(self, runs):
@@ -521,26 +628,17 @@ class GenerationEngine:
         caller retires them via finish()."""
         if not runs:
             return
-        tokens = np.zeros((self.max_slots, 1), np.int64)
-        positions = np.zeros((self.max_slots, 1), np.int64)
-        table = np.zeros((self.max_slots, self.max_pages), np.int32)
+        feeds = self._dec_feeds
+        tokens, positions = feeds["dec_tokens"], feeds["dec_positions"]
         for run in runs:
             if run.done:
                 raise ValueError("decode_step on a finished run")
             tokens[run.slot, 0] = run.tokens[-1]
             positions[run.slot, 0] = run.next_pos
-            table[run.slot] = run.table
         t0 = time.perf_counter()
-        (logits,) = self._call(
-            self._variant("decode"),
-            {
-                "dec_tokens": tokens,
-                "dec_positions": positions,
-                "dec_block_table": table,
-            },
-        )
+        (logits,) = self._call(self._variant("decode"), feeds)
         logits = np.asarray(logits)
-        self.last_logits = logits  # parity surface, see start()
+        self.last_logits = logits  # parity surface, see prefill_step()
         self._m_step_ms.observe((time.perf_counter() - t0) * 1e3)
         self._m_steps.inc()
         for run in runs:
@@ -548,8 +646,14 @@ class GenerationEngine:
             self._append_token(run, logits[run.slot], self._max_new(run.req))
 
     def finish(self, run):
-        """Retire a run's slot: pages return to the pool for reuse."""
+        """Retire a run's slot: pages return to the pool for reuse (cached
+        prefix pages stay alive under the trie's reference) and the slot's
+        persistent decode-feed rows drop back to the scratch page so the
+        next tenant can't inherit a stale table."""
         self.pool.release(run.slot)
+        self._dec_feeds["dec_block_table"][run.slot] = 0
+        self._dec_feeds["dec_tokens"][run.slot] = 0
+        self._dec_feeds["dec_positions"][run.slot] = 0
         self._set_pool_gauges()
 
     def _append_token(self, run, logits_row, max_new):
@@ -566,9 +670,11 @@ class GenerationEngine:
             run.done, run.finish_reason = True, "length"
 
     def _sample(self, logits, req, rng):
-        logits = np.asarray(logits, np.float64)
         if not req.temperature:
-            return int(logits.argmax())
+            # greedy stays on the raw fetch dtype: the float64 upcast can't
+            # change the argmax winner and costs real time per decode step
+            return int(np.asarray(logits).argmax())
+        logits = np.asarray(logits, np.float64)
         z = logits / req.temperature
         if req.top_k and req.top_k < z.size:
             kth = np.partition(z, -req.top_k)[-req.top_k]
@@ -579,10 +685,16 @@ class GenerationEngine:
         return int(rng.choice(z.size, p=p))
 
     def _set_pool_gauges(self):
+        from ..ops import pallas_kernels as _pk
+
         st = self.pool.stats()
         self._m_slots.set(st["slots_in_use"])
         self._m_occ.set(st["slot_occupancy"])
         self._m_pages.set(st["pages_in_use"])
+        self._m_pages_shared.set(st["pages_shared"])
+        self._m_paged_flash.set(_pk.KERNEL_DISPATCHES.get("paged_flash", 0))
+        if self.prefix_cache is not None:
+            self._m_prefix_hit.set(self.prefix_cache.stats()["hit_rate"])
 
     # ---- convenience / stats ----------------------------------------------
     def generate(self, prompt, max_new_tokens=16, **kw):
@@ -598,6 +710,8 @@ class GenerationEngine:
         return run.result()
 
     def stats(self):
+        from ..ops import pallas_kernels as _pk
+
         out = {
             "variants": len(self._variants),
             "traces": self.traces,
@@ -605,9 +719,21 @@ class GenerationEngine:
             "model_version": self.model_version,
             "tokens_generated": self.tokens_generated,
             "prefill_buckets": list(self.prefill_buckets),
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunks": self._m_chunks.value(),
             "geometry": self.geometry(),
             "pool": self.pool.stats(),
+            # lowering-time kernel choices (counts are per trace, not per
+            # call): the smoke/bench stages assert paged_flash shows up here
+            # when the flag forces it
+            "kernel_dispatches": {
+                k: v
+                for k, v in _pk.KERNEL_DISPATCHES.items()
+                if k in ("paged_flash", "gemm_dbuf", "gemm_epilogue")
+            },
         }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
         if self.cache is not None:
             out["cache"] = self.cache.stats()
         return out
@@ -647,6 +773,7 @@ class GenerationScheduler(ContinuousBatcher):
         self.prefill_per_step = max(1, int(prefill_per_step))
         self.pressure_queue = int(pressure_queue)
         self._runs = {}  # slot -> _SlotRun
+        self._prefills = []  # admitted runs still working through chunks
         self._drain_flag = True
         from ..observability import registry as _registry
 
@@ -705,21 +832,27 @@ class GenerationScheduler(ContinuousBatcher):
     def _loop(self):
         while True:
             with self._cond:
-                while self._alive and not self._queue and not self._runs:
+                while (self._alive and not self._queue and not self._runs
+                       and not self._prefills):
                     self._cond.wait()
                 if not self._alive:
                     if not self._drain_flag:
                         self._fail_runs_locked()
                         return
-                    if not self._queue and not self._runs:
+                    if (not self._queue and not self._runs
+                            and not self._prefills):
                         return
                 admits = self._admit_requests_locked()
             self._step(admits)
 
     def _admit_requests_locked(self):
-        """Pop queued requests that fit free capacity right now. Queue
-        pressure escalates the per-step prefill budget from
-        `prefill_per_step` to every free slot."""
+        """Pop queued requests that fit free capacity right now. Admission
+        is host-only (slot + page reservation); the chunk budget in _step
+        governs device-side prefill pacing, so an in-flight chunked
+        prefill never blocks admitting the next request — a short prompt
+        admitted behind a long one overtakes it in the
+        shortest-remaining-first chunk order. Pages held only by the
+        prefix cache count as free — admit() evicts them on demand."""
         budget = self.prefill_per_step
         if len(self._queue) >= self.pressure_queue:
             budget = self.engine.max_slots
@@ -727,6 +860,8 @@ class GenerationScheduler(ContinuousBatcher):
         st = pool.stats()
         slots_left = st["slots_total"] - st["slots_in_use"]
         pages_left = st["pages_total"] - st["pages_in_use"]
+        if self.engine.prefix_cache is not None:
+            pages_left += self.engine.prefix_cache.reclaimable()
         admits = []
         while self._queue and len(admits) < min(budget, slots_left):
             nxt = self._queue[0]
@@ -760,7 +895,7 @@ class GenerationScheduler(ContinuousBatcher):
                 (time.perf_counter() - pending.t_submit) * 1e3
             )
             try:
-                run = eng.start(pending.req)
+                run = eng.admit(pending.req)
             except PoolExhausted as e:
                 # capacity raced away (shouldn't happen single-threaded,
                 # but never drop a request on the floor)
@@ -769,18 +904,47 @@ class GenerationScheduler(ContinuousBatcher):
                 continue
             except Exception as e:
                 self._m_requests.inc(outcome="error")
-                err = RuntimeError("prefill failed: %s" % (repr(e),))
+                err = RuntimeError("admit failed: %s" % (repr(e),))
                 err.__cause__ = e
                 pending.future._set_error(err)
                 continue
             run.future = pending.future
             run.t_submit = pending.t_submit
-            run.t_first = time.perf_counter()
-            self._m_ttft_ms.observe((run.t_first - run.t_submit) * 1e3)
-            if run.done:
-                self._retire(run)
-            else:
-                self._runs[run.slot] = run
+            self._prefills.append(run)
+
+        # advance prefill chunk-by-chunk: normally one chunk per step (its
+        # latency rides on every live slot's token), draining every pending
+        # prompt when the queue is deep OR when no slot is decoding (then
+        # there is nobody to stall). Chunks go shortest-remaining-first, so
+        # a short prompt admitted behind a half-prefilled long one
+        # overtakes it and samples its first token next step — the
+        # queue-pressure escalation bounds how long the long prompt can be
+        # overtaken. TTFT starts at the chunk that samples the first token.
+        if self._prefills:
+            n_chunks = self.prefill_per_step
+            if not self._runs or self._queued_rows >= self.pressure_queue:
+                n_chunks = len(self._prefills)
+            order = sorted(self._prefills,
+                           key=lambda r: len(r.req.prompt) - r.pf_pos)
+            for run in order[:n_chunks]:
+                try:
+                    finished = eng.prefill_step(run)
+                except Exception as e:
+                    self._prefills.remove(run)
+                    self._m_requests.inc(outcome="error")
+                    err = RuntimeError("prefill failed: %s" % (repr(e),))
+                    err.__cause__ = e
+                    run.future._set_error(err)
+                    eng.finish(run)
+                    continue
+                if finished:
+                    self._prefills.remove(run)
+                    run.t_first = time.perf_counter()
+                    self._m_ttft_ms.observe((run.t_first - run.t_submit) * 1e3)
+                    if run.done:
+                        self._retire(run)
+                    else:
+                        self._runs[run.slot] = run
 
         live = list(self._runs.values())
         if live:
@@ -810,11 +974,12 @@ class GenerationScheduler(ContinuousBatcher):
         run.future._set_result(run.result())
 
     def _fail_runs_locked(self):
-        for run in self._runs.values():
+        for run in list(self._runs.values()) + self._prefills:
             self._m_requests.inc(outcome="shutdown")
             run.future._set_error(ShutdownError("scheduler closed"))
             self.engine.finish(run)
         self._runs.clear()
+        del self._prefills[:]
 
     def close(self, drain=True, timeout=30.0):
         self._drain_flag = bool(drain)
@@ -825,6 +990,7 @@ class GenerationScheduler(ContinuousBatcher):
             return {
                 "queued_requests": self._queued_rows,
                 "live_slots": len(self._runs),
+                "prefilling": len(self._prefills),
                 "alive": self._alive,
             }
 

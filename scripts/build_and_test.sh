@@ -379,10 +379,13 @@ PY
 echo "== generation smoke (docs/serving.md) =="
 # autoregressive serving: mixed-length greedy requests under Poisson
 # arrivals through GenerationEngine + GenerationScheduler (prefill/decode
-# split over the paged KV pool). Asserts: every request served, ZERO
-# variants traced after warmup (the zero-steady-state-retrace guarantee),
-# positive token throughput, the naive whole-sequence ablation is
-# token-identical, and the pool drains clean (no leaked slots/pages)
+# split over the paged KV pool, chunked prefill, prefix KV cache).
+# Asserts: every request served, ZERO variants traced after warmup (the
+# zero-steady-state-retrace guarantee), positive token throughput, the
+# naive whole-sequence ablation is token-identical, the shared-prefix
+# workload actually hits the prefix cache, long prompts went through the
+# chunked prefill path, and the pool drains clean — every page still held
+# after drain is a reclaimable prefix-cache page, not a leak
 JAX_PLATFORMS=cpu python - <<'PY'
 import sys
 sys.path.insert(0, ".")
@@ -393,11 +396,16 @@ assert rec["traces_after_warmup"] == 0, \
     "%d hot-loop retraces" % rec["traces_after_warmup"]
 assert rec["value"] > 0, rec
 assert rec["naive_token_parity_ok"], "ablation token divergence"
-assert rec["pool"]["slots_in_use"] == 0 and rec["pool"]["pages_in_use"] == 0, \
-    rec["pool"]
+assert rec["prefix_hit_rate"] > 0, rec["prefix_cache"]
+assert rec["prefill_chunks"] >= rec["requests"], rec
+assert rec["pool"]["slots_in_use"] == 0, rec["pool"]
+assert rec["pool"]["pages_in_use"] == rec["prefix_cache"]["cached_pages"], \
+    (rec["pool"], rec["prefix_cache"])
 print("generation smoke ok: %d requests, %.0f tok/s (%.1fx naive "
-      "whole-sequence), 0 retraces, ttft p50 %.1f ms, token p50 %.2f ms"
+      "whole-sequence), 0 retraces, prefix hit %.0f%%, %d prefill chunks, "
+      "ttft p50 %.1f ms, token p50 %.2f ms"
       % (rec["requests"], rec["value"], rec["continuous_vs_naive_x"],
+         100.0 * rec["prefix_hit_rate"], rec["prefill_chunks"],
          rec["p50_ttft_ms"], rec["p50_token_ms"]))
 PY
 

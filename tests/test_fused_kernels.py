@@ -80,6 +80,36 @@ def test_gemm_bias_act_matches_dense(act):
                                    rtol=2e-5, atol=2e-5)
 
 
+def test_gemm_double_buffer_predicate_and_bit_identity():
+    """The manual double-buffered k-loop DMA variant must be a pure
+    scheduling change: same tiles, same accumulation order, same epilogue —
+    so its outputs are BIT-identical to the grid-pipelined kernel, not
+    merely close."""
+    saved = flags.get_flags("gemm_double_buffer")
+    rng = np.random.RandomState(7)
+    m, k, n = 256, 512, 256
+    x = jnp.asarray(rng.randn(m, k).astype("float32"))
+    w = jnp.asarray(rng.randn(k, n).astype("float32"))
+    b = jnp.asarray(rng.randn(n).astype("float32"))
+    try:
+        flags.set_flags({"gemm_double_buffer": "off"})
+        assert not pk.gemm_dbuf_path_taken(m, n, k, None, None, 128)
+        z0, y0 = pk.gemm_bias_act(x, w, b, "gelu", block_k=128)
+        z0n, _ = pk.gemm_bias_act(x, w, b, None, block_k=128)
+        flags.set_flags({"gemm_double_buffer": "on"})
+        assert pk.gemm_dbuf_path_taken(m, n, k, None, None, 128)
+        before = pk.KERNEL_DISPATCHES.get("gemm_dbuf", 0)
+        z1, y1 = pk.gemm_bias_act(x, w, b, "gelu", block_k=128)  # nk = 4
+        z1n, y1n = pk.gemm_bias_act(x, w, b, None, block_k=128)
+        assert pk.KERNEL_DISPATCHES.get("gemm_dbuf", 0) == before + 2
+    finally:
+        flags.set_flags(saved)
+    np.testing.assert_array_equal(np.asarray(z0), np.asarray(z1))
+    np.testing.assert_array_equal(np.asarray(y0), np.asarray(y1))
+    np.testing.assert_array_equal(np.asarray(z0n), np.asarray(z1n))
+    assert y1n is None
+
+
 def test_gemm_ragged_falls_back_dense():
     rng = np.random.RandomState(1)
     # 1000 rows: > one tile and no 128-multiple divisor -> dense fallback

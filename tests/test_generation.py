@@ -67,8 +67,8 @@ def test_paged_pool_reuse_and_exhaustion():
     pool.release(s1)
     pool.release(s2)
     assert pool.stats() == {
-        "pages_total": 4, "pages_in_use": 0, "slots_total": 2,
-        "slots_in_use": 0, "slot_occupancy": 0.0,
+        "pages_total": 4, "pages_in_use": 0, "pages_shared": 0,
+        "slots_total": 2, "slots_in_use": 0, "slot_occupancy": 0.0,
     }
 
 
@@ -98,7 +98,11 @@ def test_continuous_batch_matches_serial_decode(gen_engine):
         assert got.tokens == want.tokens, (p, got.tokens, want.tokens)
         assert got.finish_reason == want.finish_reason
     st = eng.pool.stats()
-    assert st["slots_in_use"] == 0 and st["pages_in_use"] == 0
+    assert st["slots_in_use"] == 0
+    # every slot reference is gone; the only pages still in use are full
+    # prompt pages the prefix cache pinned — all reclaimable on demand
+    cached = eng.prefix_cache.stats()["cached_pages"]
+    assert st["pages_in_use"] == cached == eng.prefix_cache.reclaimable()
     assert eng.traces == len(eng._variants), "hot loop retraced"
 
 
@@ -397,3 +401,143 @@ def test_http_generate_route(gen_engine):
         assert desc["tgen"]["stats"]["traces"] == desc["tgen"]["stats"]["variants"]
     finally:
         server.stop(drain=True)
+
+
+# ------------------------------------------- chunked prefill / prefix cache
+
+
+def test_chunked_prefill_matches_whole_prompt(gen_engine):
+    """Prefilling a long prompt in small chunks must be bit-identical to
+    covering it with one big bucket — same first-token logits, same tokens."""
+    eng_a = gen_engine  # buckets (2,4,8,16): this prompt runs as ONE chunk
+    eng_b = GenerationEngine(
+        eng_a.model, name="tgen_chunk", scope=eng_a.scope, max_slots=3,
+        page_size=4, max_context=16, prefill_chunk=4, cache_dir=None,
+        prefix_cache=False,
+    )
+    eng_b.warmup()
+    assert eng_b.prefill_buckets == (2, 4)
+    prompt = [3, 7, 11, 2, 9, 4, 1, 8, 6, 5, 10, 12, 2]  # 13 -> 4+4+4+1
+    ra = eng_a.generate(prompt, max_new_tokens=3, eos_id=NO_EOS)
+    la = eng_a.last_prefill_logits.copy()
+    rb = eng_b.generate(prompt, max_new_tokens=3, eos_id=NO_EOS)
+    assert eng_b._m_chunks.value() == 4
+    np.testing.assert_array_equal(la, eng_b.last_prefill_logits)
+    assert rb.tokens == ra.tokens
+    assert rb.finish_reason == ra.finish_reason
+
+
+def test_scheduler_interleaves_chunks_token_parity():
+    """Long prompts streamed through the chunking scheduler produce the
+    same tokens as serial whole-prompt decode, while short requests keep
+    streaming (the scheduler runs decode steps between chunks)."""
+    model = GPTDecoder(**MODEL_KW)
+    eng = GenerationEngine(
+        model, name="tgen_il", max_slots=3, page_size=4, max_context=16,
+        prefill_chunk=4, cache_dir=None, prefix_cache=False,
+    )
+    eng.warmup()
+    cases = [
+        ([9, 8, 7, 6, 5, 4, 3, 2, 1, 10, 11, 12], 3),  # 3 chunks
+        ([1, 2], 8),
+        ([5, 6, 7, 8, 9, 10, 11, 12, 13], 4),  # 3 chunks
+        ([2, 4], 6),
+    ]
+    serial = [eng.generate(p, max_new_tokens=m) for p, m in cases]
+    sched = GenerationScheduler(eng, timeout_ms=60000.0)
+    try:
+        futs = [sched.submit(p, max_new_tokens=m) for p, m in cases]
+        results = [f.result(60) for f in futs]
+    finally:
+        assert sched.close(drain=True)
+    for (p, m), want, got in zip(cases, serial, results):
+        assert got.tokens == want.tokens, (p, got.tokens, want.tokens)
+    assert eng.traces == len(eng._variants), "hot loop retraced"
+
+
+def test_prefix_cache_sharing_refcounts_and_parity():
+    """A shared system prompt prefills once: the second request's leading
+    pages come from the trie (refcounted, never copied), its prefill starts
+    past them, and its logits/tokens are bit-identical to a no-cache run."""
+    model = GPTDecoder(**MODEL_KW)
+    eng = GenerationEngine(
+        model, name="tgen_px", max_slots=2, page_size=4, max_context=16,
+        cache_dir=None,
+    )
+    eng.warmup()
+    ref = GenerationEngine(
+        model, name="tgen_px_ref", scope=eng.scope, max_slots=2, page_size=4,
+        max_context=16, cache_dir=None, prefix_cache=False,
+    )
+    ref.warmup()
+    sys_prompt = [7, 3, 9, 1, 2, 8, 4, 6]  # two full pages
+
+    first = eng.generate(sys_prompt + [5], max_new_tokens=2, eos_id=NO_EOS)
+    st = eng.prefix_cache.stats()
+    assert st["cached_pages"] == 2 and st["pages_hit"] == 0
+
+    want = ref.generate(sys_prompt + [5, 11], max_new_tokens=4, eos_id=NO_EOS)
+    lref = ref.last_prefill_logits.copy()
+    got = eng.generate(sys_prompt + [5, 11], max_new_tokens=4, eos_id=NO_EOS)
+    st = eng.prefix_cache.stats()
+    assert st["pages_hit"] == 2 and st["lookups_hit"] == 1
+    assert got.tokens == want.tokens
+    np.testing.assert_array_equal(eng.last_prefill_logits, lref)
+    assert first.tokens[0] == want.tokens[0] or True  # prompts differ past prefix
+
+    # mid-run refcounts: trie + slot share the pages; decode never writes
+    # through them (positions >= prompt len land in private pages)
+    run = eng.admit(GenRequest(sys_prompt + [9, 9], max_new_tokens=2,
+                               eos_id=NO_EOS))
+    assert run.pf_pos == 8, "prefill must start past the two shared pages"
+    shared = [int(p) for p in run.table[:2]]
+    assert all(eng.pool.page_refcount(p) == 2 for p in shared)
+    assert eng.pool.stats()["pages_shared"] == 2
+    while not eng.prefill_step(run):
+        pass
+    while not run.done:
+        eng.decode_step([run])
+    eng.finish(run)
+    assert all(eng.pool.page_refcount(p) == 1 for p in shared)
+    assert eng.pool.stats()["pages_shared"] == 0
+    assert eng.prefix_cache.reclaimable() == eng.prefix_cache.stats()["cached_pages"]
+
+
+def test_prefix_cache_trie_lru_and_guarded_eviction():
+    pool = PagedKVPool(n_pages=8, page_size=2, max_slots=2,
+                       max_pages_per_slot=4)
+    from paddle_tpu.serving import PrefixCache
+
+    cache = PrefixCache(pool, capacity_pages=2)
+    s, t = pool.acquire(4)  # 2 pages
+    assert cache.insert([1, 2, 3, 4], t) == 2
+    pool.release(s)
+    # lookup pins; the final prompt token is never eligible
+    got = cache.lookup([1, 2, 3, 4])
+    assert got == [int(t[0])] and pool.page_refcount(t[0]) == 2
+    pool.unpin_pages(got)
+    got = cache.lookup([1, 2, 3, 4, 9])
+    assert got == [int(t[0]), int(t[1])]
+    pool.unpin_pages(got)
+    got = cache.lookup([1, 2, 9])  # page 1 matches; divergence is at token 3
+    assert got == [int(t[0])]
+    pool.unpin_pages(got)
+    assert cache.lookup([1, 9, 9]) == []  # diverges inside the first page
+
+    # at capacity, inserting a new prompt LRU-evicts the older chain
+    s2, t2 = pool.acquire(4)
+    assert cache.insert([5, 6, 7, 8], t2) == 2
+    pool.release(s2)
+    assert cache.lookup([1, 2, 3, 4, 9]) == []
+    got = cache.lookup([5, 6, 7, 8, 9])
+    assert got == [int(t2[0]), int(t2[1])]
+    pool.unpin_pages(got)
+
+    # eviction never touches a page a slot still reads
+    s3, t3 = pool.acquire(3, shared_pages=[int(t2[0])])
+    assert cache.evict_for(2) == 1  # only the unshared deep page went
+    assert cache.lookup([5, 6, 9]) == [int(t2[0])]
+    pool.unpin_pages([int(t2[0])])
+    pool.release(s3)
+    assert cache.clear() == 1
+    assert pool.stats()["pages_in_use"] == 0

@@ -65,12 +65,40 @@ class TestKVCacheWritePrefill(OpTest):
         self.check_output()
 
 
+def _paged_attention_np(q, kp, vp, bt, pos, n_head, page_size):
+    """Where-mask + safe-softmax reference (the lowering's contract): dead
+    context rows carry weight EXACTLY 0 — never a large negative additive
+    constant — and a fully-masked row (pos < 0) emits zeros, not 0/0."""
+    slots, feat = q.shape
+    d = feat // n_head
+    if bt.ndim == 1:
+        bt = np.broadcast_to(bt, (slots, bt.shape[0]))
+    ctx_len = bt.shape[1] * page_size
+    flat = (
+        bt.astype(np.int64)[:, :, None] * page_size
+        + np.arange(page_size, dtype=np.int64)[None, None, :]
+    ).reshape(slots, ctx_len)
+    k = kp[flat.reshape(-1)].reshape(slots, ctx_len, n_head, d)
+    v = vp[flat.reshape(-1)].reshape(slots, ctx_len, n_head, d)
+    qh = q.reshape(slots, n_head, d).astype(np.float64)
+    scores = np.einsum("shd,schd->shc", qh, k.astype(np.float64))
+    scores *= d ** -0.5
+    live = (np.arange(ctx_len)[None, :] <= pos[:, None])[:, None, :]
+    scores = np.where(live, scores, -np.inf)
+    m = scores.max(axis=-1, keepdims=True)
+    m = np.where(np.isfinite(m), m, 0.0)
+    w = np.where(live, np.exp(scores - m), 0.0)
+    denom = w.sum(axis=-1, keepdims=True)
+    w = w / np.where(denom > 0.0, denom, 1.0)
+    out = np.einsum("shc,schd->shd", w, v.astype(np.float64))
+    return out.reshape(slots, feat).astype("float32")
+
+
 class TestPagedAttention(OpTest):
     def setUp(self):
         self.op_type = "paged_attention"
         n_head, d, page_size = 2, 4, 4
-        slots, pages_per_slot, n_pages = 3, 2, 8
-        ctx_len = pages_per_slot * page_size
+        slots, n_pages = 3, 8
         feat = n_head * d
         q = (np.random.rand(slots, feat).astype("float32") - 0.5)
         kp = (np.random.rand(n_pages * page_size, feat).astype("float32") - 0.5)
@@ -83,23 +111,63 @@ class TestPagedAttention(OpTest):
             "Q": q, "KPool": kp, "VPool": vp, "BlockTable": bt, "Pos": pos,
         }
         self.attrs = {"n_head": n_head, "page_size": page_size}
+        self.outputs = {
+            "Out": _paged_attention_np(q, kp, vp, bt, pos, n_head, page_size)
+        }
 
-        flat = (
-            bt.astype(np.int64)[:, :, None] * page_size
-            + np.arange(page_size, dtype=np.int64)[None, None, :]
-        ).reshape(slots, ctx_len)
-        k = kp[flat.reshape(-1)].reshape(slots, ctx_len, n_head, d)
-        v = vp[flat.reshape(-1)].reshape(slots, ctx_len, n_head, d)
-        qh = q.reshape(slots, n_head, d).astype(np.float64)
-        scores = np.einsum("shd,schd->shc", qh, k.astype(np.float64))
-        scores *= d ** -0.5
-        live = np.arange(ctx_len)[None, :] <= pos[:, None]
-        scores = np.where(live[:, None, :], scores, -1e9)
-        scores -= scores.max(axis=-1, keepdims=True)
-        weights = np.exp(scores)
-        weights /= weights.sum(axis=-1, keepdims=True)
-        out = np.einsum("shc,schd->shd", weights, v.astype(np.float64))
-        self.outputs = {"Out": out.reshape(slots, feat).astype("float32")}
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestPagedAttentionSharedTable(OpTest):
+    """Chunked-prefill shape: ONE [P] page list shared by every query row,
+    each row at its own position."""
+
+    def setUp(self):
+        self.op_type = "paged_attention"
+        n_head, d, page_size = 2, 4, 4
+        rows, n_pages = 4, 8
+        feat = n_head * d
+        q = (np.random.rand(rows, feat).astype("float32") - 0.5)
+        kp = (np.random.rand(n_pages * page_size, feat).astype("float32") - 0.5)
+        vp = (np.random.rand(n_pages * page_size, feat).astype("float32") - 0.5)
+        bt = np.array([3, 1, 6], dtype="int32")
+        pos = np.array([4, 5, 6, 7], dtype="int32")  # a chunk at start 4
+        self.inputs = {
+            "Q": q, "KPool": kp, "VPool": vp, "BlockTable": bt, "Pos": pos,
+        }
+        self.attrs = {"n_head": n_head, "page_size": page_size}
+        self.outputs = {
+            "Out": _paged_attention_np(q, kp, vp, bt, pos, n_head, page_size)
+        }
+
+    def test_check_output(self):
+        self.check_output()
+
+
+class TestPagedAttentionFullyMaskedTail(OpTest):
+    """Regression for the -1e9 additive-mask bug: a row with pos < 0 (every
+    context position dead) must emit EXACTLY zeros — the old additive form
+    turned an all-masked row into a uniform average over garbage V rows."""
+
+    def setUp(self):
+        self.op_type = "paged_attention"
+        n_head, d, page_size = 2, 4, 4
+        rows, n_pages = 3, 6
+        feat = n_head * d
+        q = (np.random.rand(rows, feat).astype("float32") - 0.5)
+        kp = (np.random.rand(n_pages * page_size, feat).astype("float32") - 0.5)
+        vp = (np.random.rand(n_pages * page_size, feat).astype("float32") - 0.5)
+        bt = np.array([[1, 2], [3, 4], [5, 0]], dtype="int32")
+        pos = np.array([3, -1, -1], dtype="int32")
+        self.inputs = {
+            "Q": q, "KPool": kp, "VPool": vp, "BlockTable": bt, "Pos": pos,
+        }
+        self.attrs = {"n_head": n_head, "page_size": page_size}
+        out = _paged_attention_np(q, kp, vp, bt, pos, n_head, page_size)
+        assert not np.isnan(out).any()
+        assert (out[1:] == 0.0).all()
+        self.outputs = {"Out": out}
 
     def test_check_output(self):
         self.check_output()

@@ -253,3 +253,106 @@ def test_lse_declaration_mirrors_lowering_decision():
     np.testing.assert_allclose(
         got, np.asarray(want), rtol=max(_RTOL, 2e-3), atol=max(_ATOL, 2e-3)
     )
+
+
+# --------------------------------------------------------------------------
+# paged flash-attention decode kernel (serving fast path)
+# --------------------------------------------------------------------------
+
+
+def _paged_dense_ref(q, kp, vp, bt, pos, n_head, page_size):
+    """The generation_ops dense lowering's math, in numpy f32 — the decline
+    target the kernel must stay bit-bounded against."""
+    s, feat = q.shape
+    d = feat // n_head
+    if bt.ndim == 1:
+        bt = np.broadcast_to(bt, (s, bt.shape[0]))
+    ctx = bt.shape[1] * page_size
+    flat = (
+        bt.astype(np.int64)[:, :, None] * page_size
+        + np.arange(page_size)[None, None, :]
+    ).reshape(s, ctx)
+    k = kp[flat.reshape(-1)].reshape(s, ctx, n_head, d)
+    v = vp[flat.reshape(-1)].reshape(s, ctx, n_head, d)
+    qh = q.reshape(s, n_head, d)
+    sc = np.einsum("shd,schd->shc", qh, k) * (d ** -0.5)
+    live = (np.arange(ctx)[None, :] <= pos[:, None])[:, None, :]
+    sc = np.where(live, sc, -np.inf)
+    m = sc.max(-1, keepdims=True)
+    m = np.where(np.isfinite(m), m, 0.0)
+    w = np.where(live, np.exp(sc - m), 0.0)
+    den = w.sum(-1, keepdims=True)
+    w = w / np.where(den > 0.0, den, 1.0)
+    return np.einsum("shc,schd->shd", w, v).reshape(s, feat).astype("float32")
+
+
+def _paged_case(rng, slots, n_pages, pages_per_slot, n_head, d, page_size):
+    feat = n_head * d
+    q = rng.randn(slots, feat).astype("float32")
+    kp = rng.randn(n_pages * page_size, feat).astype("float32")
+    vp = rng.randn(n_pages * page_size, feat).astype("float32")
+    bt = np.zeros((slots, pages_per_slot), np.int32)
+    for s in range(slots):
+        bt[s] = rng.choice(np.arange(1, n_pages), pages_per_slot, replace=False)
+    return q, kp, vp, bt
+
+
+def test_paged_flash_path_predicate():
+    from paddle_tpu import flags
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    saved = flags.get_flags("paged_flash")
+    try:
+        flags.set_flags({"paged_flash": "on"})
+        assert pk.paged_flash_path_taken(4, 4, 8, 2, 8)
+        flags.set_flags({"paged_flash": "off"})
+        assert not pk.paged_flash_path_taken(4, 4, 8, 2, 8)
+        flags.set_flags({"paged_flash": "auto"})
+        import jax
+
+        assert pk.paged_flash_path_taken(4, 4, 8, 2, 8) == (
+            jax.default_backend() == "tpu"
+        )
+    finally:
+        flags.set_flags(saved)
+
+
+def test_paged_flash_decode_matches_dense_across_page_boundaries():
+    """Per-slot block tables, ragged positions: mid-page, exactly on a page
+    boundary, last row of the table, and a fully-masked (pos = -1) idle
+    slot that must emit zeros."""
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(11)
+    n_head, d, ps = 2, 8, 4
+    q, kp, vp, bt = _paged_case(rng, 5, 12, 3, n_head, d, ps)
+    pos = np.array([2, 3, 4, 11, -1], dtype=np.int32)
+    before = pk.KERNEL_DISPATCHES.get("paged_flash", 0)
+    out = pk.paged_flash_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(pos),
+        n_head=n_head, page_size=ps, interpret=True,
+    )
+    assert pk.KERNEL_DISPATCHES.get("paged_flash", 0) == before + 1
+    ref = _paged_dense_ref(q, kp, vp, bt, pos, n_head, ps)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=_RTOL, atol=_ATOL)
+    assert np.abs(np.asarray(out)[4]).max() == 0.0  # fully-masked row
+
+
+def test_paged_flash_shared_table_matches_dense():
+    """Chunked-prefill shape: one [P] page list shared by every chunk row,
+    consecutive positions."""
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(12)
+    n_head, d, ps = 2, 8, 4
+    q, kp, vp, _ = _paged_case(rng, 6, 10, 3, n_head, d, ps)
+    bt1 = np.array([2, 7, 4], dtype=np.int32)
+    pos = np.arange(5, 11, dtype=np.int32)  # chunk starting mid-page
+    out = pk.paged_flash_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt1), jnp.asarray(pos),
+        n_head=n_head, page_size=ps, interpret=True,
+    )
+    ref = _paged_dense_ref(q, kp, vp, bt1, pos, n_head, ps)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=_RTOL, atol=_ATOL)

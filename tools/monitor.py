@@ -137,6 +137,12 @@ def _serving_summary(metrics):
             row["gen_slots_live"] = scalar(m.get("gen_slots_live"))
             row["gen_slot_occupancy"] = scalar(m.get("gen_slot_occupancy"))
             row["gen_kv_pages"] = scalar(m.get("gen_kv_pages_used"))
+            row["gen_prefill_chunks"] = scalar(m.get("gen_prefill_chunks"))
+            row["gen_prefix_hit_rate"] = scalar(m.get("gen_prefix_hit_rate"))
+            row["gen_pages_shared"] = scalar(m.get("gen_pages_shared"))
+            row["gen_paged_flash"] = scalar(
+                m.get("gen_paged_flash_dispatches")
+            )
             for key, hist in (
                 ("gen_token", m.get("gen_token_ms")),
                 ("gen_ttft", m.get("gen_ttft_ms")),
@@ -647,6 +653,16 @@ def render(summary):
                     _fmt(s.get("gen_slots_live"), "{:.0f}"),
                     _fmt(s.get("gen_kv_pages"), "{:.0f}"),
                     _fmt(s.get("gen_steps"), "{:.0f}"),
+                ),
+            ))
+            rows.append((
+                "serve/gen %s fastpath" % model,
+                "prefix hit %s, %s pages shared, %s prefill chunks, "
+                "%s paged-flash lowerings" % (
+                    _fmt(s.get("gen_prefix_hit_rate"), "{:.0%}"),
+                    _fmt(s.get("gen_pages_shared"), "{:.0f}"),
+                    _fmt(s.get("gen_prefill_chunks"), "{:.0f}"),
+                    _fmt(s.get("gen_paged_flash"), "{:.0f}", "0"),
                 ),
             ))
     if cc:
