@@ -70,6 +70,18 @@ Honored flags:
   of iteration k+1 with the MXU contraction of iteration k). Same
   "auto"/"on"/"off" semantics as paged_flash; outputs are bit-identical
   to the grid-pipelined kernel either way (same accumulation order).
+- quantized_gemm: dispatch tier for the quantized GEMM tile paths
+  (ops/pallas_kernels.quant_gemm_bias_act — int8×int8→i32 and
+  fp8(e4m3)×fp8→f32 with the dequantize multiply folded into the GEMM
+  epilogue). Same "auto"/"on"/"off" semantics as paged_flash; the dense
+  fallback keeps the same wide-accumulate/round-once numerics either way.
+  quant_gemm_path_taken mirrors the decision.
+- fp8_matmul: when True, the training matmul/mul lowerings cast floating
+  operands to float8_e4m3fn and contract with f32 accumulation
+  (ops/pallas_kernels.fp8_matmul) — the MXU runs e4m3 pairs at the int8
+  rate (2× bf16). A dtype policy for step-time experiments (the BENCH fp8
+  transformer entry), NOT numerics-preserving: off (default) keeps the
+  native-dtype matmul.
 - data_num_workers: default worker count for the native data runtime
   (paddle_tpu/data/, docs/data.md): PyReader.decorate_* calls that do not
   pass num_workers explicitly use this many multiprocess decode workers;
@@ -148,6 +160,8 @@ _DEFAULTS = {
     "serving_cache_dir": "",
     "paged_flash": "auto",
     "gemm_double_buffer": "auto",
+    "quantized_gemm": "auto",
+    "fp8_matmul": False,
     "data_num_workers": 0,
     "data_ring_slots": 0,
     "data_prefetch": 2,

@@ -1327,51 +1327,69 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, name=None):
     return out
 
 
-def kv_cache_write(pool, rows, block_table, pos, page_size, name=None):
+def kv_cache_write(pool, rows, block_table, pos, page_size, scales=None,
+                   name=None):
     """Scatter per-token K or V rows into a paged cache pool in place.
 
     ``pool`` is a persistable ``[n_pages * page_size, feat]`` tensor; each
     row of ``rows`` lands at ``block_table[pos // page_size] * page_size +
     pos % page_size``. The op's output IS the pool variable (the in-place
     idiom), so the serving lowering classifies the pool as written state
-    and can donate its buffer across decode steps."""
+    and can donate its buffer across decode steps.
+
+    ``scales`` (a persistable ``[n_pages * page_size]`` f32 tensor) turns
+    on the int8 storage mode: rows quantize symmetrically per row on the
+    scatter and the scale pool becomes a second in-place output, donated
+    alongside the level pool."""
     helper = LayerHelper("kv_cache_write", name=name)
+    inputs = {
+        "Pool": [pool.name],
+        "Rows": [rows.name],
+        "BlockTable": [block_table.name],
+        "Pos": [pos.name],
+    }
+    outputs = {"Out": [pool.name]}
+    if scales is not None:
+        inputs["Scales"] = [scales.name]
+        outputs["OutScales"] = [scales.name]
     helper.append_op(
         type="kv_cache_write",
-        inputs={
-            "Pool": [pool.name],
-            "Rows": [rows.name],
-            "BlockTable": [block_table.name],
-            "Pos": [pos.name],
-        },
-        outputs={"Out": [pool.name]},
+        inputs=inputs,
+        outputs=outputs,
         attrs={"page_size": int(page_size)},
     )
     return pool
 
 
-def paged_attention(q, k_pool, v_pool, block_table, pos, n_head, page_size, sm_scale=None, name=None):
+def paged_attention(q, k_pool, v_pool, block_table, pos, n_head, page_size,
+                    sm_scale=None, k_scales=None, v_scales=None, name=None):
     """One-query-per-slot attention over a paged KV pool.
 
     ``q`` is ``[slots, n_head * d_head]`` (one decode token per slot),
     ``block_table`` ``[slots, pages_per_slot]`` int32, ``pos`` the query
     token's position; each slot attends to context positions 0..pos through
     its block table. Unused table entries point at the scratch page and are
-    masked by the position bound."""
+    masked by the position bound. ``k_scales``/``v_scales`` (both or
+    neither) read int8 pools: per-row f32 scales dequantize the gathered
+    levels inline (see ops/generation_ops.py int8 pool mode)."""
     helper = LayerHelper("paged_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     attrs = {"n_head": int(n_head), "page_size": int(page_size)}
     if sm_scale is not None:
         attrs["sm_scale"] = float(sm_scale)
+    inputs = {
+        "Q": [q.name],
+        "KPool": [k_pool.name],
+        "VPool": [v_pool.name],
+        "BlockTable": [block_table.name],
+        "Pos": [pos.name],
+    }
+    if k_scales is not None:
+        inputs["KScales"] = [k_scales.name]
+        inputs["VScales"] = [v_scales.name]
     helper.append_op(
         type="paged_attention",
-        inputs={
-            "Q": [q.name],
-            "KPool": [k_pool.name],
-            "VPool": [v_pool.name],
-            "BlockTable": [block_table.name],
-            "Pos": [pos.name],
-        },
+        inputs=inputs,
         outputs={"Out": [out.name]},
         attrs=attrs,
     )

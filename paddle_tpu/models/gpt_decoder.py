@@ -57,9 +57,12 @@ class GPTDecoder:
         max_context=64,
         eos_id=1,
         prefix="gptd",
+        kv_dtype="float32",
     ):
         if d_model % n_head:
             raise ValueError("d_model must divide into n_head heads")
+        if kv_dtype not in ("float32", "int8"):
+            raise ValueError("kv_dtype must be 'float32' or 'int8'")
         self.vocab_size = int(vocab_size)
         self.n_layer = int(n_layer)
         self.n_head = int(n_head)
@@ -69,6 +72,11 @@ class GPTDecoder:
         self.max_context = int(max_context)
         self.eos_id = int(eos_id)
         self.prefix = prefix
+        # "int8": K/V pools store symmetric per-row int8 levels plus a
+        # [pool_rows] f32 scale pool each (kv_scale_names) — half the HBM
+        # per cached token, so the same pool budget holds ~2x the slots
+        # (ops/generation_ops.py int8 pool mode)
+        self.kv_dtype = kv_dtype
 
     # ---------------------------------------------------------------- names
 
@@ -91,6 +99,17 @@ class GPTDecoder:
         features for one cached token."""
         return [
             (self._p("l%d" % i, "kv_k"), self._p("l%d" % i, "kv_v"))
+            for i in range(self.n_layer)
+        ]
+
+    def kv_scale_names(self):
+        """[(k_scales, v_scales)] per layer in int8 mode (each a
+        [pool_rows] f32 per-row scale pool, written-state siblings of the
+        level pools); [] in float32 mode."""
+        if self.kv_dtype != "int8":
+            return []
+        return [
+            (self._p("l%d" % i, "kv_ks"), self._p("l%d" % i, "kv_vs"))
             for i in range(self.n_layer)
         ]
 
@@ -159,22 +178,25 @@ class GPTDecoder:
         )
         return self._mlp_tail(layers.elementwise_add(x, o), i, nfd=2)
 
-    def _decode_block(self, x, i, pools, block_table, pos, page_size):
+    def _decode_block(self, x, i, pools, scales, block_table, pos, page_size):
         """Pre-LN block over [rows, d_model] — one query token per row:
         write each row's K/V into the pool, then attend through the block
         table ([rows, max_pages] for decode; [max_pages], shared by every
-        row, for a prefill chunk)."""
+        row, for a prefill chunk). `scales` is the per-layer scale-pool pair
+        list in int8 mode, None in float32 mode."""
         h = layers.layer_norm(
             x, begin_norm_axis=1,
             param_attr=self._attr(i, "ln1_w"), bias_attr=self._attr(i, "ln1_b"),
         )
         q, k, v = self._qkv(h, i, nfd=1)
         k_pool, v_pool = pools[i]
-        layers.kv_cache_write(k_pool, k, block_table, pos, page_size)
-        layers.kv_cache_write(v_pool, v, block_table, pos, page_size)
+        k_sc, v_sc = scales[i] if scales else (None, None)
+        layers.kv_cache_write(k_pool, k, block_table, pos, page_size, k_sc)
+        layers.kv_cache_write(v_pool, v, block_table, pos, page_size, v_sc)
         att = layers.paged_attention(
             q, k_pool, v_pool, block_table, pos,
             n_head=self.n_head, page_size=page_size,
+            k_scales=k_sc, v_scales=v_sc,
         )
         o = layers.fc(
             att, size=self.d_model, num_flatten_dims=1,
@@ -198,16 +220,27 @@ class GPTDecoder:
 
     def _pool_vars(self, pool_rows):
         block = framework.default_main_program().global_block()
-        return [
+        pools = [
             tuple(
                 block.create_var(
                     name=n, shape=[pool_rows, self.d_model],
-                    dtype="float32", persistable=True,
+                    dtype=self.kv_dtype, persistable=True,
                 )
                 for n in pair
             )
             for pair in self.kv_pool_names()
         ]
+        scales = [
+            tuple(
+                block.create_var(
+                    name=n, shape=[pool_rows], dtype="float32",
+                    persistable=True,
+                )
+                for n in pair
+            )
+            for pair in self.kv_scale_names()
+        ]
+        return pools, scales or None
 
     # -------------------------------------------------------------- programs
 
@@ -264,7 +297,7 @@ class GPTDecoder:
             pages = layers.data(
                 "gen_pages", [max_pages], append_batch_size=False, dtype="int32"
             )
-            pools = self._pool_vars(pool_rows)
+            pools, scales = self._pool_vars(pool_rows)
             pos_flat = layers.elementwise_add(
                 layers.assign(np.arange(t, dtype="int64")), start
             )
@@ -276,7 +309,7 @@ class GPTDecoder:
             x2 = layers.reshape(x, [t, self.d_model])
             for i in range(self.n_layer):
                 x2 = self._decode_block(
-                    x2, i, pools, pages, pos_flat, page_size
+                    x2, i, pools, scales, pages, pos_flat, page_size
                 )
             h = self._final(x2, nfd=1)
             last_row = layers.gather(h, last)  # [1, d_model]
@@ -308,11 +341,11 @@ class GPTDecoder:
                 "dec_block_table", [slots, max_pages],
                 append_batch_size=False, dtype="int32",
             )
-            pools = self._pool_vars(pool_rows)
+            pools, scales = self._pool_vars(pool_rows)
             x = self._embed(tokens, positions)
             for i in range(self.n_layer):
                 x = self._decode_block(
-                    x, i, pools, block_table, positions, page_size
+                    x, i, pools, scales, block_table, positions, page_size
                 )
             logits = self._head(self._final(x, nfd=1), nfd=1)
         return (

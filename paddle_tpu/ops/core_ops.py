@@ -145,6 +145,20 @@ def _shape(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
+def _fp8_matmul_taken(x, y):
+    """FLAGS_fp8_matmul dtype policy for the dense matmul lowerings: floating
+    operands contract as float8_e4m3fn with f32 accumulation
+    (pallas_kernels.fp8_matmul). Integer/bool operands keep the native path
+    regardless of the flag."""
+    from .. import flags as _flags
+
+    if not _flags.get_flags("fp8_matmul")["fp8_matmul"]:
+        return False
+    return jnp.issubdtype(x.dtype, jnp.floating) and jnp.issubdtype(
+        y.dtype, jnp.floating
+    )
+
+
 @register("mul")
 def _mul(ctx, ins, attrs):
     (x,) = ins["X"]
@@ -153,7 +167,12 @@ def _mul(ctx, ins, attrs):
     ync = int(attrs.get("y_num_col_dims", 1))
     x2 = x.reshape((int(np.prod(x.shape[:xnc])), -1))
     y2 = y.reshape((int(np.prod(y.shape[:ync])), -1))
-    out = x2 @ y2
+    if _fp8_matmul_taken(x2, y2):
+        from .pallas_kernels import fp8_matmul
+
+        out = fp8_matmul(x2, y2)
+    else:
+        out = x2 @ y2
     out_shape = tuple(x.shape[:xnc]) + tuple(y.shape[ync:])
     return {"Out": [out.reshape(out_shape)]}
 
@@ -172,7 +191,12 @@ def _matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y)
+    if _fp8_matmul_taken(x, y):
+        from .pallas_kernels import fp8_matmul
+
+        out = fp8_matmul(x, y)
+    else:
+        out = jnp.matmul(x, y)
     if alpha != 1.0:
         out = out * jnp.asarray(alpha, out.dtype)
     return {"Out": [out]}

@@ -25,6 +25,15 @@ Conventions:
     as a large negative constant (the additive ``-1e9`` form leaks
     probability mass once scores live in bf16 at long context), and a
     fully-masked row (pos < 0) emits zeros instead of 0/0 NaN.
+  * **int8 pool mode** — when ``kv_cache_write`` is given a ``Scales``
+    input, the pool holds int8 levels (symmetric per-row absmax/127
+    quantization on the scatter) and a ``[n_pages * page_size]`` f32 scale
+    pool rides along as a second piece of written state. ``paged_attention``
+    takes the matching ``KScales``/``VScales`` and dequantizes inline — in
+    the dense path on the gathered rows, in the Pallas kernel on the
+    block-table page walk (the f32 rows exist only in VMEM). One HBM pool
+    at ~¼ the bytes per row (int8 + one f32 scale per row) holds ≥2× the
+    generation slots.
   * On TPU (or when FLAGS_paged_flash forces it) the lowering dispatches to
     the paged flash-attention Pallas kernel (ops/pallas_kernels.py), which
     walks the block table page by page with an online softmax and never
@@ -62,15 +71,37 @@ def _flat_rows(block_table, positions, page_size):
     return page_id * page_size + positions % page_size
 
 
+KV_QUANT_LEVELS = 127.0  # symmetric int8: round(x / scale), scale = absmax/127
+
+
 @register("kv_cache_write", no_grad=True)
 def _kv_cache_write(ctx, ins, attrs):
+    """Scatter K/V rows into the pool. With a Scales input the pool holds
+    int8 levels: each row quantizes symmetrically on the way in (scale =
+    absmax/127 per row — a page's scale vector fills incrementally as its
+    rows are written, so earlier rows are never re-scaled) and the f32
+    per-row scale lands in the scale pool at the same flat index. Both the
+    pool and the scale pool come back as written state (the in-place
+    idiom), so decode steps donate both buffers."""
     (pool,) = ins["Pool"]
     (rows,) = ins["Rows"]
     (bt,) = ins["BlockTable"]
     (pos,) = ins["Pos"]
     page_size = int(attrs["page_size"])
     flat = _flat_rows(bt, pos, page_size)
-    return {"Out": [pool.at[flat].set(rows.astype(pool.dtype))]}
+    scales = ins.get("Scales", [None])[0]
+    if scales is None:
+        return {"Out": [pool.at[flat].set(rows.astype(pool.dtype))]}
+    r32 = rows.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(r32), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / KV_QUANT_LEVELS
+    q = jnp.clip(
+        jnp.round(r32 / scale[:, None]), -KV_QUANT_LEVELS, KV_QUANT_LEVELS
+    ).astype(pool.dtype)
+    return {
+        "Out": [pool.at[flat].set(q)],
+        "OutScales": [scales.at[flat].set(scale.astype(scales.dtype))],
+    }
 
 
 @register("paged_attention", no_grad=True)
@@ -87,6 +118,8 @@ def _paged_attention(ctx, ins, attrs):
     ctx_len = p * page_size
     d = q.shape[-1] // n_head
     scale = float(attrs.get("sm_scale") or 0.0) or d**-0.5
+    ks = ins.get("KScales", [None])[0]
+    vs = ins.get("VScales", [None])[0]
 
     from . import pallas_kernels as _pk
 
@@ -94,8 +127,18 @@ def _paged_attention(ctx, ins, attrs):
         out = _pk.paged_flash_attention(
             q, kp, vp, bt, pos,
             n_head=n_head, page_size=page_size, sm_scale=scale,
+            k_scales=ks, v_scales=vs,
         )
         return {"Out": [out]}
+
+    def _deq(levels, row_scales, flat_idx):
+        # int8-pool dequant in the dense decline path: per-row scales gather
+        # through the same flat indices as their rows
+        x = levels.astype(jnp.float32)
+        if row_scales is None:
+            return x
+        sc = jnp.take(row_scales.reshape(-1), flat_idx.reshape(-1), axis=0)
+        return x * sc.astype(jnp.float32).reshape(flat_idx.shape + (1, 1))
 
     qh = q.reshape(s, n_head, d).astype(jnp.float32)
     offsets = jnp.arange(page_size, dtype=jnp.int32)
@@ -105,6 +148,8 @@ def _paged_attention(ctx, ins, attrs):
         flat = flat.reshape(ctx_len)
         k = jnp.take(kp, flat, axis=0).reshape(ctx_len, n_head, d)
         v = jnp.take(vp, flat, axis=0).reshape(ctx_len, n_head, d)
+        k = _deq(k, ks, flat)
+        v = _deq(v, vs, flat)
         scores = jnp.einsum("shd,chd->shc", qh, k.astype(jnp.float32)) * scale
     else:
         flat = (
@@ -113,6 +158,8 @@ def _paged_attention(ctx, ins, attrs):
         ).reshape(s, ctx_len)
         k = jnp.take(kp, flat.reshape(-1), axis=0).reshape(s, ctx_len, n_head, d)
         v = jnp.take(vp, flat.reshape(-1), axis=0).reshape(s, ctx_len, n_head, d)
+        k = _deq(k, ks, flat)
+        v = _deq(v, vs, flat)
         scores = jnp.einsum("shd,schd->shc", qh, k.astype(jnp.float32)) * scale
 
     # causal-by-position where-mask + safe softmax: the query at position

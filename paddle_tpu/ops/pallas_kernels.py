@@ -42,6 +42,9 @@ __all__ = [
     "gemm_bias_act",
     "gemm_path_taken",
     "gemm_dbuf_path_taken",
+    "quant_gemm_bias_act",
+    "quant_gemm_path_taken",
+    "fp8_matmul",
     "paged_flash_attention",
     "paged_flash_path_taken",
     "fused_layer_norm",
@@ -1214,6 +1217,196 @@ def gemm_bias_act(x2, w2, bias_row, act=None, *, block_m=None, block_n=None,
 
 
 # ---------------------------------------------------------------------------
+# quantized GEMM tier — int8×int8→i32 and fp8(e4m3)×fp8→f32 tile paths over
+# the same (m, n, k) grid as gemm_bias_act. The MXU contracts the low-
+# precision operands natively (v5e: 383 int8 TOPS vs 192 bf16 TF/s — 2×) and
+# the dequantize multiply rides the existing epilogue: acc → ·scale → +bias
+# → act, rounding ONCE at the store exactly like the f32 path. Uncovered
+# shapes/dtypes decline to a dense XLA form with the same
+# low-precision-multiply / wide-accumulate / round-once numerics, so the
+# dispatch decision never changes results (the PR 11 contract). int8's i32
+# accumulation is exact regardless of tiling; kernel-vs-fallback parity is
+# within one f32 ulp of the dequant epilogue (the compiler may or may not
+# fuse ·scale+bias into an fma). fp8's f32 accumulation is tiled, so parity
+# is bit-bounded like flash.
+# ---------------------------------------------------------------------------
+
+# int8/fp8 sublane minimum is 32 (vs 8 for f32, 16 for bf16) — a (32, 128)
+# tile floor. _auto_block's 128 floor already clears it; the 512 target from
+# the r06 f32 sweep carries over (the accumulate tile, not the operand dtype,
+# is what the MXU wants large).
+_QUANT_GEMM_ACC = {
+    jnp.dtype(jnp.int8): jnp.int32,
+    jnp.dtype(jnp.float8_e4m3fn): jnp.float32,
+}
+
+
+def quant_gemm_path_taken(m, n, k, dtype, block_m=None, block_n=None,
+                          block_k=None):
+    """EXACT mirror of quant_gemm_bias_act's pallas-vs-dense decision. The
+    quantized_gemm flag picks the tier with the paged_flash semantics: "off"
+    always dense, "on" forces the kernel (interpret mode off-TPU — parity
+    tests), "auto" takes the kernel only on a real TPU (an interpreted
+    int8 kernel is slower than the dense XLA dot on the CPU test mesh).
+    dtype must be int8 or float8_e4m3fn and the f32-GEMM tile feasibility
+    applies unchanged."""
+    from .. import flags as _flags
+
+    mode = _flags.get_flags("quantized_gemm")["quantized_gemm"]
+    if mode == "off":
+        return False
+    if jnp.dtype(dtype) not in _QUANT_GEMM_ACC:
+        return False
+    if not gemm_path_taken(m, n, k, block_m, block_n, block_k):
+        return False
+    # low-precision Mosaic granule is (32, 128) — stricter than the f32
+    # tier, which accepts a single whole ragged tile
+    bm = _auto_block(m, block_m or _DEF_GEMM_BLOCK_M)
+    bn = _auto_block(n, block_n or _DEF_GEMM_BLOCK_N)
+    bk = _auto_block(k, block_k or _DEF_GEMM_BLOCK_K)
+    if bm % 32 or bn % _LANES or bk % _LANES:
+        return False
+    if mode == "on":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _quant_gemm_kernel(s_ref, x_ref, w_ref, b_ref, z_ref, y_ref, acc_ref, *,
+                       act):
+    """One (m_block, n_block) tile: low-precision operands stream through the
+    MXU into a wide VMEM accumulator (i32 for int8, f32 for fp8 — native-
+    dtype operands with preferred_element_type, never upcast first); the last
+    k step dequantizes with the combined per-tensor scale, adds bias, applies
+    the activation, and rounds once to the output dtype. The scale rides in
+    SMEM as a (1, 1) scalar."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=acc_ref.dtype,
+    )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        z = acc_ref[...].astype(jnp.float32) * s_ref[0, 0] + b_ref[
+            ...
+        ].astype(jnp.float32)
+        z_ref[...] = z.astype(z_ref.dtype)
+        if y_ref is not None:
+            y_ref[...] = _GEMM_ACT_F32[act](z).astype(y_ref.dtype)
+
+
+def _quant_gemm_no_act_adapter(kernel, s_ref, x_ref, w_ref, b_ref, z_ref,
+                               acc_ref):
+    kernel(s_ref, x_ref, w_ref, b_ref, z_ref, None, acc_ref)
+
+
+def quant_gemm_bias_act(x2, w2, scale, bias_row=None, act=None, *,
+                        out_dtype=jnp.float32, block_m=None, block_n=None,
+                        block_k=None, interpret=None):
+    """act((x2 @ w2) * scale + bias) where x2/w2 are int8 levels or fp8
+    values and scale is the combined per-tensor dequantize factor
+    (x_scale * w_scale, a scalar). Accumulation is i32 (int8) or f32 (fp8);
+    dequant/bias/act happen on the wide value with ONE rounding to out_dtype.
+    Returns (z, y) like gemm_bias_act: z post-bias pre-activation, y = act(z)
+    (None when act is None). Shapes/dtypes the kernel declines
+    (quant_gemm_path_taken False) fall back to a dense XLA form with the
+    same wide-accumulate/round-once numerics."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, k = x2.shape
+    n = w2.shape[1]
+    acc_dtype = _QUANT_GEMM_ACC.get(jnp.dtype(x2.dtype))
+    scale = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+    if bias_row is None:
+        bias_row = jnp.zeros((1, n), jnp.float32)
+    bias_row = jnp.broadcast_to(bias_row.reshape(1, -1), (1, n))
+    if acc_dtype is None or not quant_gemm_path_taken(
+        m, n, k, x2.dtype, block_m, block_n, block_k
+    ):
+        wide = jax.lax.dot_general(
+            x2, w2, (((1,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype or jnp.float32,
+        )
+        z32 = wide.astype(jnp.float32) * scale[0, 0] + bias_row.astype(
+            jnp.float32
+        )
+        z = z32.astype(out_dtype)
+        y = _GEMM_ACT_F32[act](z32).astype(out_dtype) if act else None
+        return z, y
+    family = "gemm_int8" if acc_dtype == jnp.int32 else "gemm_fp8"
+    _note_dispatch(family)
+    bm = _auto_block(m, block_m or _DEF_GEMM_BLOCK_M)
+    bn = _auto_block(n, block_n or _DEF_GEMM_BLOCK_N)
+    bk = _auto_block(k, block_k or _DEF_GEMM_BLOCK_K)
+    grid = (m // bm, n // bn, k // bk)  # k innermost: acc carries across it
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1), lambda mi, ni, ki: (0, 0), memory_space=pltpu.SMEM
+        ),
+        pl.BlockSpec((bm, bk), lambda mi, ni, ki: (mi, ki)),
+        pl.BlockSpec((bk, bn), lambda mi, ni, ki: (ki, ni)),
+        pl.BlockSpec((1, bn), lambda mi, ni, ki: (0, ni)),
+    ]
+    out_spec = pl.BlockSpec((bm, bn), lambda mi, ni, ki: (mi, ni))
+    kernel = functools.partial(_quant_gemm_kernel, act=act)
+    cost = pl.CostEstimate(
+        flops=2 * m * n * k,
+        bytes_accessed=(x2.size + w2.size) * x2.dtype.itemsize
+        + (2 if act else 1) * m * n * jnp.dtype(out_dtype).itemsize,
+        transcendentals=m * n if act in ("gelu", "tanh", "sigmoid") else 0,
+    )
+    scratch = [pltpu.VMEM((bm, bn), acc_dtype)]
+    if act is None:
+        z = pl.pallas_call(
+            functools.partial(_quant_gemm_no_act_adapter, kernel),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_spec,
+            out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+            scratch_shapes=scratch,
+            cost_estimate=cost,
+            interpret=interpret,
+        )(scale, x2, w2, bias_row)
+        return z, None
+    z, y = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), out_dtype),
+            jax.ShapeDtypeStruct((m, n), out_dtype),
+        ],
+        scratch_shapes=scratch,
+        cost_estimate=cost,
+        interpret=interpret,
+    )(scale, x2, w2, bias_row)
+    return z, y
+
+
+def fp8_matmul(x, y):
+    """Training-matmul fp8 tier (FLAGS_fp8_matmul): cast both operands to
+    float8_e4m3fn, contract on the MXU with f32 accumulation, and return in
+    the input dtype. One rounding per operand plus the output cast — the
+    delayed-scaling recipes keep amax history per tensor; this is the
+    simpler static cast form, enough for the BENCH step-time entry (the MXU
+    runs e4m3×e4m3 at the int8 rate). Shapes are unrestricted: this is a
+    dtype policy, not a kernel, so XLA owns the tiling."""
+    _note_dispatch("matmul_fp8")
+    f8 = jnp.float8_e4m3fn
+    out = jnp.matmul(
+        x.astype(f8), y.astype(f8), preferred_element_type=jnp.float32
+    )
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
 # paged flash attention — the serving decode/chunk-prefill kernel. Walks a
 # slot's block table page by page with the online-softmax recurrence in a
 # VMEM accumulator, reading K/V pages straight out of the paged pool and
@@ -1344,8 +1537,83 @@ def _paged_flash_shared_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         _paged_flash_emit(o_ref, acc_ref, l_ref)
 
 
+def _paged_flash_decode_quant_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref,
+                                     ks_ref, vs_ref, o_ref, acc_ref, m_ref,
+                                     l_ref, *, page_size, sm_scale):
+    """int8-pool twin of _paged_flash_decode_kernel: K/V pages arrive as
+    int8 levels plus a per-row f32 scale vector per page (chasing the same
+    block table), and the dequantize multiply happens in VMEM on the page
+    walk — the f32 rows never exist in HBM, which is the whole point (the
+    pool at half the bytes holds twice the slots)."""
+    si = pl.program_id(0)
+    pi = pl.program_id(2)
+    pos = pos_ref[si]
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(pi * page_size <= pos)
+    def _page():
+        q2 = q_ref[:, 0, :].astype(jnp.float32)  # (1, d)
+        k2 = k_ref[:, 0, :].astype(jnp.float32) * ks_ref[0, :][:, None]
+        v2 = v_ref[:, 0, :].astype(jnp.float32) * vs_ref[0, :][:, None]
+        s = jax.lax.dot_general(
+            q2, k2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        offs = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        live = offs <= pos
+        s = jnp.where(live, s, -jnp.inf)
+        _paged_flash_update(s, live, v2, acc_ref, m_ref, l_ref)
+
+    @pl.when(pi == pl.num_programs(2) - 1)
+    def _emit():
+        _paged_flash_emit(o_ref, acc_ref, l_ref)
+
+
+def _paged_flash_shared_quant_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref,
+                                     ks_ref, vs_ref, o_ref, acc_ref, m_ref,
+                                     l_ref, *, page_size, sm_scale):
+    """int8-pool twin of _paged_flash_shared_kernel (chunked prefill — one
+    block table, one scale vector per page shared by every row)."""
+    pi = pl.program_id(1)
+    pos = pos_ref[...]  # (rows,)
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(pi * page_size <= jnp.max(pos))
+    def _page():
+        q2 = q_ref[:, 0, :].astype(jnp.float32)  # (rows, d)
+        k2 = k_ref[:, 0, :].astype(jnp.float32) * ks_ref[0, :][:, None]
+        v2 = v_ref[:, 0, :].astype(jnp.float32) * vs_ref[0, :][:, None]
+        s = jax.lax.dot_general(
+            q2, k2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        offs = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        live = offs <= pos[:, None]
+        s = jnp.where(live, s, -jnp.inf)
+        _paged_flash_update(s, live, v2, acc_ref, m_ref, l_ref)
+
+    @pl.when(pi == pl.num_programs(1) - 1)
+    def _emit():
+        _paged_flash_emit(o_ref, acc_ref, l_ref)
+
+
 def paged_flash_attention(q, k_pool, v_pool, block_table, pos, *, n_head,
-                          page_size, sm_scale=None, interpret=None):
+                          page_size, sm_scale=None, k_scales=None,
+                          v_scales=None, interpret=None):
     """Paged attention over the KV pool without materializing the gathered
     context. q is [rows, n_head*d]; block_table is [rows, P] (decode — one
     page list per query row) or [P] (chunked prefill — one list shared by
@@ -1353,7 +1621,13 @@ def paged_flash_attention(q, k_pool, v_pool, block_table, pos, *, n_head,
     inclusive; pos < 0 means fully masked and emits zeros). Returns
     [rows, n_head*d] in q's dtype with f32 accumulation — bit-bounded, not
     bit-identical, vs the dense reference (the online softmax reassociates
-    the sum)."""
+    the sum).
+
+    k_scales/v_scales (both or neither): the pools hold int8 levels and
+    [pool_rows] f32 per-row scales ride along; the kernel dequantizes
+    inline on the block-table walk (each page's scale vector chases the
+    same table entry as its K/V rows), so dequantized f32 rows exist only
+    in VMEM."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     rows, feat = q.shape
@@ -1364,23 +1638,39 @@ def paged_flash_attention(q, k_pool, v_pool, block_table, pos, *, n_head,
     v3 = v_pool.reshape(-1, n_head, d)
     bt = block_table.astype(jnp.int32)
     pos_v = pos.reshape(-1).astype(jnp.int32)
-    _note_dispatch("paged_flash")
+    quant = k_scales is not None
+    operands = [bt, pos_v, q3, k3, v3]
+    if quant:
+        # one f32 scale per pool row, page-structured so a (1, page_size)
+        # block can chase the block table like the K/V pages do
+        operands += [
+            k_scales.reshape(-1, page_size).astype(jnp.float32),
+            v_scales.reshape(-1, page_size).astype(jnp.float32),
+        ]
+    _note_dispatch("paged_flash_int8" if quant else "paged_flash")
     if bt.ndim == 1:
         n_pages = bt.shape[0]
+        in_specs = [
+            pl.BlockSpec((rows, 1, d), lambda h, p, bt_r, pos_r: (0, h, 0)),
+            pl.BlockSpec(
+                (page_size, 1, d),
+                lambda h, p, bt_r, pos_r: (bt_r[p], h, 0),
+            ),
+            pl.BlockSpec(
+                (page_size, 1, d),
+                lambda h, p, bt_r, pos_r: (bt_r[p], h, 0),
+            ),
+        ]
+        if quant:
+            in_specs += [
+                pl.BlockSpec(
+                    (1, page_size), lambda h, p, bt_r, pos_r: (bt_r[p], 0)
+                ),
+            ] * 2
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(n_head, n_pages),
-            in_specs=[
-                pl.BlockSpec((rows, 1, d), lambda h, p, bt_r, pos_r: (0, h, 0)),
-                pl.BlockSpec(
-                    (page_size, 1, d),
-                    lambda h, p, bt_r, pos_r: (bt_r[p], h, 0),
-                ),
-                pl.BlockSpec(
-                    (page_size, 1, d),
-                    lambda h, p, bt_r, pos_r: (bt_r[p], h, 0),
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (rows, 1, d), lambda h, p, bt_r, pos_r: (0, h, 0)
             ),
@@ -1391,26 +1681,36 @@ def paged_flash_attention(q, k_pool, v_pool, block_table, pos, *, n_head,
             ],
         )
         kernel = functools.partial(
-            _paged_flash_shared_kernel, page_size=page_size, sm_scale=scale
+            _paged_flash_shared_quant_kernel if quant
+            else _paged_flash_shared_kernel,
+            page_size=page_size, sm_scale=scale,
         )
     else:
         n_pages = bt.shape[1]
+        in_specs = [
+            pl.BlockSpec(
+                (1, 1, d), lambda s, h, p, bt_r, pos_r: (s, h, 0)
+            ),
+            pl.BlockSpec(
+                (page_size, 1, d),
+                lambda s, h, p, bt_r, pos_r: (bt_r[s, p], h, 0),
+            ),
+            pl.BlockSpec(
+                (page_size, 1, d),
+                lambda s, h, p, bt_r, pos_r: (bt_r[s, p], h, 0),
+            ),
+        ]
+        if quant:
+            in_specs += [
+                pl.BlockSpec(
+                    (1, page_size),
+                    lambda s, h, p, bt_r, pos_r: (bt_r[s, p], 0),
+                ),
+            ] * 2
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(rows, n_head, n_pages),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, d), lambda s, h, p, bt_r, pos_r: (s, h, 0)
-                ),
-                pl.BlockSpec(
-                    (page_size, 1, d),
-                    lambda s, h, p, bt_r, pos_r: (bt_r[s, p], h, 0),
-                ),
-                pl.BlockSpec(
-                    (page_size, 1, d),
-                    lambda s, h, p, bt_r, pos_r: (bt_r[s, p], h, 0),
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, d), lambda s, h, p, bt_r, pos_r: (s, h, 0)
             ),
@@ -1421,14 +1721,16 @@ def paged_flash_attention(q, k_pool, v_pool, block_table, pos, *, n_head,
             ],
         )
         kernel = functools.partial(
-            _paged_flash_decode_kernel, page_size=page_size, sm_scale=scale
+            _paged_flash_decode_quant_kernel if quant
+            else _paged_flash_decode_kernel,
+            page_size=page_size, sm_scale=scale,
         )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rows, n_head, d), q.dtype),
         interpret=interpret,
-    )(bt, pos_v, q3, k3, v3)
+    )(*operands)
     return out.reshape(rows, feat)
 
 
@@ -1809,7 +2111,7 @@ def _rules_sharded(ctx, ops):
 def _gemm_chain_views(prod, x, w):
     """2-D (m,k)/(k,n) views of the producer's operands plus the full output
     shape, or None when the op form is outside the kernel's contract."""
-    if prod.type == "mul":
+    if prod.type in ("mul", "int8_mul"):
         xnc = int(prod.attrs.get("x_num_col_dims", 1))
         ync = int(prod.attrs.get("y_num_col_dims", 1))
         m = int(np.prod(x.shape[:xnc], dtype=np.int64)) if xnc else 1
@@ -1889,6 +2191,86 @@ def _fused_gemm_epilogue(ctx, ops, env):
     if act_op is not None:
         env[act_op.output("Out")[0]] = y2.reshape(out_shape)
     _note_dispatch("gemm_epilogue")
+    return True
+
+
+@register_fused("gemm_int8")
+def _fused_quant_gemm(ctx, ops, env):
+    """int8_mul -> fake_dequantize ×2 [-> elementwise_add [-> act]] through
+    quant_gemm_bias_act: the two chained per-tensor dequant multiplies
+    collapse into ONE combined scale applied to the i32 accumulator, and the
+    bias/activation ride the same epilogue — the whole calibrated-int8 dense
+    layer is one kernel with one rounding. Intermediate env entries are
+    rebuilt algebraically from z (exact inverses of the epilogue, f32) so
+    out-of-run consumers stay correct; XLA DCEs them when unused."""
+    if len(ops) not in (3, 4, 5) or ops[0].type != "int8_mul":
+        return False
+    if _rules_sharded(ctx, ops):
+        return False
+    prod, d1, d2 = ops[0], ops[1], ops[2]
+    if (
+        d1.type != "fake_dequantize_max_abs"
+        or d2.type != "fake_dequantize_max_abs"
+        or d1.input("X") != [prod.output("Out")[0]]
+        or d2.input("X") != [d1.output("Out")[0]]
+    ):
+        return False
+    add_op = act_op = None
+    if len(ops) >= 4:
+        add_op = ops[3]
+        if add_op.type != "elementwise_add" or add_op.input("X") != [
+            d2.output("Out")[0]
+        ]:
+            return False
+    if len(ops) == 5:
+        act_op = ops[4]
+        if act_op.type not in _GEMM_ACT_F32 or act_op.input("X") != [
+            add_op.output("Out")[0]
+        ]:
+            return False
+    x = env.get(prod.input("X")[0])
+    w = env.get(prod.input("Y")[0])
+    s1 = env.get(d1.input("Scale")[0])
+    s2 = env.get(d2.input("Scale")[0])
+    if x is None or w is None or s1 is None or s2 is None:
+        return False
+    if x.dtype != jnp.int8 or w.dtype != jnp.int8:
+        return False
+    views = _gemm_chain_views(prod, x, w)
+    if views is None:
+        return False
+    m, n, k, out_shape, split = views
+    if not quant_gemm_path_taken(m, n, k, x.dtype):
+        return False
+    r1 = float(d1.attrs.get("max_range", 127.0))
+    r2 = float(d2.attrs.get("max_range", 127.0))
+    combined = (jnp.reshape(s1, ()) / r1) * (jnp.reshape(s2, ()) / r2)
+    brow = None
+    if add_op is not None:
+        bias = env.get(add_op.input("Y")[0])
+        if bias is None:
+            return False
+        bview = bcast_y(_Shape2(out_shape), bias, int(add_op.attrs.get("axis", -1)))
+        if any(d != 1 for d in bview.shape[:split]):
+            return False
+        brow = jnp.broadcast_to(
+            bview, (1,) * split + tuple(out_shape[split:])
+        ).reshape(1, n)
+    z2, y2 = quant_gemm_bias_act(
+        x.reshape(m, k), w.reshape(k, n), combined, brow,
+        act=act_op.type if act_op is not None else None,
+    )
+    z32 = z2.astype(jnp.float32)
+    pre = z32 if brow is None else z32 - brow.astype(jnp.float32)
+    env[prod.output("Out")[0]] = (pre / combined).reshape(out_shape)
+    env[d1.output("Out")[0]] = (
+        pre / jnp.maximum(jnp.reshape(s2, ()) / r2, 1e-30)
+    ).reshape(out_shape)
+    env[d2.output("Out")[0]] = pre.astype(z2.dtype).reshape(out_shape)
+    if add_op is not None:
+        env[add_op.output("Out")[0]] = z2.reshape(out_shape)
+    if act_op is not None:
+        env[act_op.output("Out")[0]] = y2.reshape(out_shape)
     return True
 
 

@@ -105,6 +105,23 @@ def _quantize_abs_max(ctx, ins, attrs):
     return {"Out": [q], "OutScale": [jnp.reshape(scale, (1,))]}
 
 
+@register("quantize_static", no_grad=True)
+def _quantize_static(ctx, ins, attrs):
+    """Calibrated activation quantization: int8 levels from a FROZEN scale
+    (a persistable const the calibrate pass baked — the absmax observed over
+    representative feeds). Unlike quantize_abs_max there is no reduction on
+    the hot path and no OutScale: the scale is already program state, so the
+    downstream dequantize reads the same const. Out-of-range activations
+    saturate at ±levels — the calibrated-range contract."""
+    (x,) = ins["X"]
+    (scale,) = ins["Scale"]
+    s = _quant_levels(attrs.get("bit_length", 8))
+    sc = jnp.reshape(scale, ())
+    sc = jnp.where(sc == 0, jnp.ones_like(sc), sc)
+    q = jnp.clip(jnp.round(x / sc * s), -s, s).astype(jnp.int8)
+    return {"Out": [q]}
+
+
 @register("int8_mul", no_grad=True)
 def _int8_mul(ctx, ins, attrs):
     """mul over int8 levels: int8×int8→int32 on the MXU, emitted as f32

@@ -32,6 +32,14 @@ aot_serve_lowering):
   per-op XLA; fused-vs-unfused parity is within bf16 rounding (one
   rounding per fused chain instead of one per op), bit-identical where
   the chain's math was already f32 (the multi-tensor Adam update).
+- inference_int8: the calibrated-int8 serving pipeline (passes/quant.py) —
+  calibrate records activation ranges from representative feeds riding
+  ctx.attrs["calibrate"], quantize_serving freezes weights to int8 and
+  bakes static activation scales (like fold_batch_norm it mutates scope
+  values, hence opt-in: ServingEngine(precision="int8") is the caller),
+  and fuse_quant_gemm tags the int8 chains for the one-kernel Pallas
+  lowering. int8 and native variants of the same model coexist in one
+  persistent compile cache (variant_key takes a precision geometry).
 """
 
 import difflib
@@ -69,6 +77,14 @@ PRESETS = {
         "fuse_layer_norm",
         "fuse_optimizer",
         "inplace_donation_plan",
+    ),
+    "inference_int8": (
+        "constant_fold",
+        "dead_op_eliminate",
+        "calibrate",
+        "quantize_serving",
+        "fuse_quant_gemm",
+        "fuse_elemwise_act",
     ),
 }
 

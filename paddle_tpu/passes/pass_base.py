@@ -89,4 +89,4 @@ def registered_passes():
 def _ensure_builtin():
     # the built-in battery self-registers on import; lazy so `import
     # paddle_tpu.passes.pass_base` alone never drags jax-heavy modules in
-    from . import builtin, ports  # noqa: F401
+    from . import builtin, ports, quant  # noqa: F401

@@ -1,0 +1,337 @@
+"""Calibrated int8 serving as a pass pipeline (the "inference_int8" preset).
+
+Three stages close the loop the QuantizeTranspiler port (ports.py
+quantize_training) leaves open for serving:
+
+- ``calibrate`` — an analysis client: runs representative feeds through the
+  program CONCRETELY (registry.lower_ops, the same machinery the executors
+  jit) and records each float tensor's observed absmax (or a percentile of
+  |x|) across all feeds. The static facts from analysis/dataflow.py gate
+  what gets recorded — only vars the analyzer proves to be floating-point
+  tensors carry a range, so opaque/control-flow/host values never acquire
+  bogus scales. Feeds ride ``ctx.attrs["calibrate"]``.
+- ``quantize_serving`` — bakes the ranges in: weights freeze to int8 levels
+  in the scope with a ``.scale.frozen`` const (the QuantizeTranspiler freeze
+  idiom), calibrated activations gain a static-scale ``quantize_static`` op
+  (no hot-path reduction — the whole point of calibration), ``mul`` swaps to
+  ``int8_mul`` (int8×int8→i32 on the MXU), and the chained
+  ``fake_dequantize_max_abs`` pair restores f32 with per-tensor scales.
+- ``fuse_quant_gemm`` — tags the resulting int8_mul → dequant ×2
+  [→ add [→ act]] chains for the fused Pallas lowering
+  (ops/pallas_kernels.py ``gemm_int8``): the dequant multiplies collapse
+  into the kernel's epilogue scale, so the calibrated layer runs as one
+  kernel with one rounding. Tag-only, decline-safe (the PR 11 contract).
+
+Per the measured deployment guidance (ops/quant_ops.py): int8 pays on
+matmul-dominated serving, NOT on bandwidth-bound CNNs — so only ``mul``
+(the fc producer) quantizes here; conv stays f32.
+"""
+
+import numpy as np
+
+from ..framework import Operator, OpRole
+from .pass_base import Pass, register_pass
+
+__all__ = ["CalibratePass", "QuantizeServingPass", "FuseQuantGemmPass"]
+
+
+@register_pass("calibrate")
+class CalibratePass(Pass):
+    """Record per-var activation ranges from representative feeds.
+
+    ctx.attrs["calibrate"] = {
+        "feeds": [ {feed name: array}, ... ],   # required to do anything
+        "percentile": 99.9,                     # optional; default absmax
+    }
+
+    The result — {"ranges": {var name: float}, "feeds_run": n, "skipped":
+    [...]} — lands in ctx.results["calibrate"] (consumed by
+    quantize_serving later in the same pipeline) and is stamped onto the
+    program as ``_calibration_ranges`` for callers. Degrades to a no-op
+    without feeds or a scope (the PassContext contract)."""
+
+    def apply(self, graph, ctx):
+        import jax.numpy as jnp
+
+        from ..ops import registry
+
+        result = {"ranges": {}, "feeds_run": 0, "skipped": []}
+        ctx.results[self.name] = result
+        spec = dict(ctx.attrs.get("calibrate") or {})
+        feeds = spec.get("feeds") or ()
+        scope = ctx.scope
+        if not feeds or scope is None:
+            return
+
+        # static facts gate the recording: only vars the dataflow analyzer
+        # proves are floating tensors get a range (an int id feed, an opaque
+        # control-flow value, a host-op output never acquire a scale)
+        from ..analysis import analyze_program
+
+        report = analyze_program(
+            graph, feed_names=ctx.feed_names, fetch_names=ctx.fetch_names,
+            scope=scope, mode="inference",
+        )
+        floaty = set()
+        for name, fact in report.facts.items():
+            if fact.kind != "tensor" or fact.dtype is None:
+                continue
+            if jnp.issubdtype(jnp.dtype(fact.dtype), jnp.floating):
+                floaty.add(name)
+
+        pct = spec.get("percentile")
+        block = graph.program.global_block()
+        ranges = {}
+        import jax
+
+        for feed in feeds:
+            env = {n: jnp.asarray(v) for n, v in dict(feed).items()}
+            lower_ctx = registry.LowerCtx(jax.random.key(0), is_test=True)
+            for op in block.ops:
+                opdef = (
+                    registry.get(op.type)
+                    if registry.is_registered(op.type)
+                    else None
+                )
+                if opdef is None or opdef.skip_exec or opdef.is_host:
+                    continue
+                ready = True
+                for n in op.input_arg_names:
+                    if n == registry.EMPTY_VAR_NAME or n in env:
+                        continue
+                    val = scope.find_var(n)
+                    if val is None:
+                        ready = False
+                        break
+                    env[n] = jnp.asarray(val)
+                if not ready:
+                    result["skipped"].append(op.type)
+                    continue
+                try:
+                    registry.lower_ops(lower_ctx, [op], env)
+                except Exception:
+                    result["skipped"].append(op.type)
+                    continue
+            for name, val in env.items():
+                if name not in floaty or not hasattr(val, "dtype"):
+                    continue
+                a = jnp.abs(val.astype(jnp.float32))
+                obs = (
+                    jnp.percentile(a.ravel(), float(pct))
+                    if pct is not None
+                    else jnp.max(a)
+                )
+                obs = float(obs)
+                if obs > ranges.get(name, 0.0):
+                    ranges[name] = obs
+            result["feeds_run"] += 1
+        result["ranges"] = ranges
+        result["skipped"] = sorted(set(result["skipped"]))
+        graph.program._calibration_ranges = dict(ranges)
+
+
+@register_pass("quantize_serving")
+class QuantizeServingPass(Pass):
+    """Bake calibrated scales into an int8 serving program (the static-scale
+    sibling of ports.py quantize_training, fused with the transpiler's
+    freeze/convert stages): per mul op whose weight lives in the scope and
+    whose activation carries a calibrated range —
+
+        x -> quantize_static(x, x.calib.scale) -> int8_mul(xq, Wq)
+          -> fake_dequantize(s_act) -> fake_dequantize(W.scale.frozen) -> out
+
+    The weight is re-typed int8 IN THE SCOPE (like fold_batch_norm this pass
+    mutates parameter values, so it is preset-only-by-opt-in via
+    inference_int8, never a default training pipeline member). Ranges come
+    from ctx.results["calibrate"] (same pipeline) or
+    ctx.attrs["quant_ranges"]. No scope / no ranges -> no-op."""
+
+    def apply(self, graph, ctx):
+        import jax.numpy as jnp
+
+        from ..ops.quant_ops import _quant_levels
+
+        result = {"quantized": 0, "weights_frozen": []}
+        ctx.results[self.name] = result
+        scope = ctx.scope
+        ranges = dict(
+            (ctx.results.get("calibrate") or {}).get("ranges")
+            or ctx.attrs.get("quant_ranges")
+            or {}
+        )
+        if scope is None or not ranges:
+            return
+        bits = int(
+            dict(ctx.attrs.get("quantize") or {}).get("activation_bits", 8)
+        )
+        levels = _quant_levels(bits)
+        block = graph.program.global_block()
+        frozen = {}  # weight name -> scale const name
+        quantized_acts = {}  # activation name -> (q var, scale const name)
+        new_ops = []
+        for op in block.ops:
+            if op.type != "mul" or not op.output("Out"):
+                new_ops.append(op)
+                continue
+            x_name = op.input("X")[0]
+            w_name = op.input("Y")[0]
+            w_val = scope.find_var(w_name)
+            x_range = ranges.get(x_name)
+            wv = block.vars.get(w_name)
+            if (
+                w_val is None
+                or not x_range
+                or wv is None
+                or not wv.persistable
+                or str(wv.dtype) not in ("float32", "float64", "bfloat16")
+            ):
+                new_ops.append(op)
+                continue
+            if w_name not in frozen:
+                w = np.asarray(w_val, dtype=np.float32)
+                w_scale = float(np.max(np.abs(w))) or 1.0
+                qw = np.clip(
+                    np.round(w / w_scale * levels), -levels, levels
+                ).astype(np.int8)
+                scope.set_var(w_name, jnp.asarray(qw))
+                wv.dtype = "int8"
+                sname = w_name + ".scale.frozen"
+                block.create_var(
+                    name=sname, shape=(1,), dtype="float32", persistable=True
+                )
+                scope.set_var(sname, jnp.asarray([w_scale], jnp.float32))
+                frozen[w_name] = sname
+                result["weights_frozen"].append(w_name)
+            if x_name not in quantized_acts:
+                a_sname = x_name + ".calib.scale"
+                block.create_var(
+                    name=a_sname, shape=(1,), dtype="float32",
+                    persistable=True,
+                )
+                scope.set_var(
+                    a_sname, jnp.asarray([float(x_range) or 1.0], jnp.float32)
+                )
+                xv = block._var_recursive(x_name)
+                q = block.create_var(
+                    name=x_name + ".q", shape=xv.shape, dtype="int8"
+                )
+                new_ops.append(
+                    Operator(
+                        block,
+                        "quantize_static",
+                        inputs={"X": [x_name], "Scale": [a_sname]},
+                        outputs={"Out": [q.name]},
+                        attrs={
+                            "bit_length": bits,
+                            OpRole.OP_ROLE_KEY: OpRole.Forward,
+                        },
+                    )
+                )
+                quantized_acts[x_name] = (q.name, a_sname)
+            q_name, a_sname = quantized_acts[x_name]
+            op.type = "int8_mul"
+            op.inputs["X"] = [q_name]
+            out = op.output("Out")[0]
+            lvl = block.create_var(
+                name=out + ".lvl", shape=block._var_recursive(out).shape,
+                dtype="float32",
+            )
+            op.outputs["Out"] = [lvl.name]
+            new_ops.append(op)
+            # chained per-tensor dequant, the QuantizeTranspiler idiom:
+            # out = lvl * (s_act/levels) * (s_w/levels)
+            src = lvl.name
+            for i, s in enumerate((a_sname, frozen[w_name])):
+                dst = out if i == 1 else block.create_var(
+                    name="%s.deq0" % out,
+                    shape=block._var_recursive(out).shape,
+                    dtype="float32",
+                ).name
+                new_ops.append(
+                    Operator(
+                        block,
+                        "fake_dequantize_max_abs",
+                        inputs={"X": [src], "Scale": [s]},
+                        outputs={"Out": [dst]},
+                        attrs={
+                            "max_range": levels,
+                            OpRole.OP_ROLE_KEY: OpRole.Forward,
+                        },
+                    )
+                )
+                src = dst
+            result["quantized"] += 1
+        if result["quantized"]:
+            block.ops = new_ops
+            graph.program._bump_version()
+            graph.refresh()
+
+
+@register_pass("fuse_quant_gemm")
+class FuseQuantGemmPass(Pass):
+    """Tag int8_mul → fake_dequantize ×2 [→ elementwise_add [→ act]] chains
+    for the fused Pallas quant GEMM (ops/pallas_kernels.py ``gemm_int8``):
+    dequant collapses into the kernel epilogue's combined scale. Strict slot
+    equality like fuse_gemm_epilogue — the lowering replaces math, not just
+    scoping — and every shape/dtype decision re-validates at trace time
+    (decline falls back per-op)."""
+
+    def apply(self, graph, ctx):
+        from .builtin import _pallas_free, _tag_run
+
+        ops = graph.program.global_block().ops
+        groups = 0
+        tagged = 0
+        i = 0
+        while i < len(ops):
+            op = ops[i]
+            if op.type != "int8_mul" or not _pallas_free(op):
+                i += 1
+                continue
+            chain = self._chain_at(ops, i)
+            if chain is None:
+                i += 1
+                continue
+            _tag_run(chain, "qgemm%d" % groups, "gemm_int8")
+            tagged += len(chain)
+            groups += 1
+            i += len(chain)
+        ctx.results[self.name] = {"groups": groups, "ops_tagged": tagged}
+        if groups:
+            graph.program._bump_version()
+
+    @staticmethod
+    def _chain_at(ops, i):
+        from .builtin import _PALLAS_GEMM_ACTS, _pallas_free
+
+        prod = ops[i]
+        if i + 2 >= len(ops) or not prod.output_arg_names:
+            return None
+        d1, d2 = ops[i + 1], ops[i + 2]
+        if (
+            d1.type != "fake_dequantize_max_abs"
+            or d2.type != "fake_dequantize_max_abs"
+            or not _pallas_free(d1)
+            or not _pallas_free(d2)
+            or d1.input("X") != [prod.output("Out")[0]]
+            or d2.input("X") != [d1.output("Out")[0]]
+        ):
+            return None
+        chain = [prod, d1, d2]
+        if i + 3 < len(ops):
+            add = ops[i + 3]
+            if (
+                add.type == "elementwise_add"
+                and _pallas_free(add)
+                and add.input("X") == [d2.output("Out")[0]]
+            ):
+                chain.append(add)
+                if i + 4 < len(ops):
+                    act = ops[i + 4]
+                    if (
+                        act.type in _PALLAS_GEMM_ACTS
+                        and _pallas_free(act)
+                        and act.input("X") == [add.output("Out")[0]]
+                    ):
+                        chain.append(act)
+        return chain

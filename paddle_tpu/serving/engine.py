@@ -74,7 +74,8 @@ class ServingEngine:
     """Shape-bucketed, donation-free forward executor for one saved model."""
 
     def __init__(self, model_dir, name=None, place=None, params_filename=None,
-                 batch_buckets=None, cache_dir=None, trailing_pad="exact"):
+                 batch_buckets=None, cache_dir=None, trailing_pad="exact",
+                 precision="native", calibration_feeds=None):
         import jax
 
         if trailing_pad not in ("exact", "pow2"):
@@ -82,6 +83,16 @@ class ServingEngine:
                 "trailing_pad must be 'exact' or 'pow2', got %r" % (trailing_pad,)
             )
         self.trailing_pad = trailing_pad
+        if precision not in ("native", "int8"):
+            raise ValueError(
+                "precision must be 'native' or 'int8', got %r" % (precision,)
+            )
+        if precision == "int8" and not calibration_feeds:
+            raise ValueError(
+                "precision='int8' needs calibration_feeds (a list of "
+                "representative feed dicts) to set activation scales"
+            )
+        self.precision = precision
 
         self.name = name or model_dir.rstrip("/").rsplit("/", 1)[-1]
         self.scope = Scope()
@@ -118,9 +129,42 @@ class ServingEngine:
             program, self.feed_names, self.fetch_names, scope=self.scope,
             mode="serving", where="serving:%s" % self.name,
         )
+        self.quant_results = None
+        if precision == "int8":
+            # calibrated-int8 pipeline (passes/quant.py): calibrate with the
+            # representative feeds, freeze weights + bake static scales into
+            # this engine's PRIVATE scope, tag the int8 chains — then lower
+            # the rewritten program verbatim (the pipeline already ran, so
+            # aot_serve_lowering must not re-apply "inference" on top)
+            from ..passes.manager import PassManager
+
+            program = PassManager("inference_int8").apply(
+                program, scope=self.scope, feed_names=self.feed_names,
+                fetch_names=self.fetch_names,
+                attrs={"calibrate": {"feeds": list(calibration_feeds)}},
+            )
+            self.program = program
+            self.quant_results = {
+                k: program._pass_results.get(k)
+                for k in ("calibrate", "quantize_serving", "fuse_quant_gemm")
+            }
+            if not (self.quant_results["quantize_serving"] or {}).get(
+                "quantized"
+            ):
+                raise ValueError(
+                    "precision='int8': no mul op quantized — the model has "
+                    "no fc/mul layers with scope weights and calibrated "
+                    "inputs (ranges recorded: %d)"
+                    % len(
+                        (self.quant_results["calibrate"] or {}).get(
+                            "ranges", {}
+                        )
+                    )
+                )
         with scope_guard(self.scope):
             self._serve, self._ro, self._mut = aot_serve_lowering(
-                program, self.feed_names, self.fetch_names, self.scope
+                program, self.feed_names, self.fetch_names, self.scope,
+                pass_pipeline="off" if precision == "int8" else "inference",
             )
 
         # hot-swap state (docs/online.md): set_params atomically replaces
@@ -178,6 +222,11 @@ class ServingEngine:
             p + "/hot_swaps", "set_params hot swaps applied"
         )
         self._m_version.set(0.0)
+        self._m_precision = reg.gauge(
+            p + "/precision",
+            "serving numeric tier (0 = native float, 1 = calibrated int8)",
+        )
+        self._m_precision.set(1.0 if self.precision == "int8" else 0.0)
 
     # ---- bucketing --------------------------------------------------------
     def bucket_batch(self, n):
@@ -247,10 +296,19 @@ class ServingEngine:
                 )
 
             if self.cache is not None:
+                # int8 variants key on a precision geometry: the rewritten
+                # program shares the model dir's fingerprint with the native
+                # lowering, so without it an int8 boot could replay a native
+                # executable (and vice versa). Native keys stay unchanged.
                 ck = _cc.variant_key(
                     self.fingerprint,
                     {n: (s.shape, s.dtype) for n, s in avals.items()},
                     self.fetch_names,
+                    geometry=(
+                        {"precision": self.precision}
+                        if self.precision != "native"
+                        else None
+                    ),
                 )
                 exported, hit = self.cache.get_or_build(
                     ck, build,
@@ -469,7 +527,21 @@ class ServingEngine:
             "cache_hits": self.cache_hits,
             "trailing_pad": self.trailing_pad,
             "model_version": self.model_version,
+            "precision": self.precision,
         }
+        if self.quant_results is not None:
+            qs = self.quant_results.get("quantize_serving") or {}
+            fq = self.quant_results.get("fuse_quant_gemm") or {}
+            out["quant"] = {
+                "quantized_muls": qs.get("quantized", 0),
+                "weights_frozen": len(qs.get("weights_frozen", ())),
+                "fused_groups": fq.get("groups", 0),
+                "calibrated_ranges": len(
+                    (self.quant_results.get("calibrate") or {}).get(
+                        "ranges", {}
+                    )
+                ),
+            }
         if self.cache is not None:
             out["cache"] = self.cache.stats()
         return out

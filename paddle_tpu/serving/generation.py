@@ -225,7 +225,8 @@ class GenerationEngine:
             pool_pages = self.max_slots * self.max_pages + 1
         self.pool_pages = int(pool_pages)
         self.pool = PagedKVPool(
-            self.pool_pages, self.page_size, self.max_slots, self.max_pages
+            self.pool_pages, self.page_size, self.max_slots, self.max_pages,
+            storage_dtype=getattr(model, "kv_dtype", "float32"),
         )
         # prefill compiles one chunk program per pow2 bucket up to
         # prefill_chunk; prompts longer than the largest bucket run as a
@@ -251,12 +252,30 @@ class GenerationEngine:
         self.scope = scope or Scope()
         model.ensure_params(self.scope, place)
         pool_rows = self.pool_pages * self.page_size
+        # int8 pool mode (model.kv_dtype == "int8"): level pools are int8
+        # and each gains a [pool_rows] f32 per-row scale pool sibling
+        # (model.kv_scale_names) — ~1/4 the f32 bytes per cached token
+        self.kv_dtype = getattr(model, "kv_dtype", "float32")
         self._state = {}
         for pair in model.kv_pool_names():
             for n in pair:
-                arr = jnp.zeros((pool_rows, model.d_model), jnp.float32)
+                arr = jnp.zeros(
+                    (pool_rows, model.d_model), jnp.dtype(self.kv_dtype)
+                )
                 self.scope.vars[n] = arr
                 self._state[n] = arr
+        for pair in getattr(model, "kv_scale_names", lambda: [])():
+            for n in pair:
+                # scale 1.0 everywhere: scratch-page reads dequantize to
+                # in-range garbage instead of inf/nan before being masked
+                arr = jnp.ones((pool_rows,), jnp.float32)
+                self.scope.vars[n] = arr
+                self._state[n] = arr
+        self.kv_state_bytes = sum(
+            int(np.prod(a.shape)) * jnp.dtype(a.dtype).itemsize
+            for a in self._state.values()
+        )
+        self.pool.row_bytes = self.kv_state_bytes // pool_rows
 
         if cache_dir is None:
             from .. import flags as _flags
@@ -297,6 +316,10 @@ class GenerationEngine:
             p + "/traces", "generation variants traced (compile-cache misses)"
         )
         self._m_slots = reg.gauge(p + "/gen_slots_live", "live decode slots")
+        self._m_slots_total = reg.gauge(
+            p + "/gen_slots_total", "decode slot capacity of the KV pool"
+        )
+        self._m_slots_total.set(float(self.max_slots))
         self._m_occ = reg.gauge(
             p + "/gen_slot_occupancy", "live slots / max_slots"
         )
@@ -323,6 +346,18 @@ class GenerationEngine:
             p + "/gen_paged_flash_dispatches",
             "paged_attention lowerings that chose the Pallas kernel",
         )
+        self._m_kv_bytes = reg.gauge(
+            p + "/gen_kv_bytes",
+            "resident KV state bytes (level pools + scale pools)",
+        )
+        self._m_kv_bytes.set(float(self.kv_state_bytes))
+        # precision label for the monitor's serve rows: 0 = fp32 pools,
+        # 1 = int8 pools (tools/monitor.py maps it back to a string)
+        self._m_precision = reg.gauge(
+            p + "/precision",
+            "KV storage precision (0 = fp32, 1 = int8)",
+        )
+        self._m_precision.set(1.0 if self.kv_dtype == "int8" else 0.0)
         # hot-swap state (docs/online.md): each _Variant holds its own ro
         # dict; set_params swaps them (and the scope) under _swap_lock.
         self.model_version = 0
@@ -344,6 +379,7 @@ class GenerationEngine:
             "max_slots": self.max_slots,
             "max_pages": self.max_pages,
             "max_context": self.max_context,
+            "kv_dtype": self.kv_dtype,
         }
 
     def _canon_dtype(self, dtype):
@@ -729,7 +765,12 @@ class GenerationEngine:
             "kernel_dispatches": {
                 k: v
                 for k, v in _pk.KERNEL_DISPATCHES.items()
-                if k in ("paged_flash", "gemm_dbuf", "gemm_epilogue")
+                if k in ("paged_flash", "paged_flash_int8", "gemm_dbuf",
+                         "gemm_epilogue", "gemm_int8", "gemm_fp8")
+            },
+            "kv": {
+                "dtype": self.kv_dtype,
+                "resident_bytes": self.kv_state_bytes,
             },
         }
         if self.prefix_cache is not None:

@@ -58,7 +58,8 @@ class PoolExhausted(RuntimeError):
 
 
 class PagedKVPool:
-    def __init__(self, n_pages, page_size, max_slots, max_pages_per_slot):
+    def __init__(self, n_pages, page_size, max_slots, max_pages_per_slot,
+                 storage_dtype="float32", row_bytes=0):
         if n_pages < 2:
             raise ValueError("need >= 2 pages (page 0 is scratch)")
         if page_size < 1 or max_slots < 1 or max_pages_per_slot < 1:
@@ -67,6 +68,14 @@ class PagedKVPool:
         self.page_size = int(page_size)
         self.max_slots = int(max_slots)
         self.max_pages_per_slot = int(max_pages_per_slot)
+        # storage mode is bookkeeping only (the device arrays live with the
+        # engine): "int8" pools store per-row levels + f32 per-page scale
+        # vectors at ~1/4 the f32 bytes per token, so the same HBM budget
+        # funds >= 2x the pages/slots. row_bytes is the caller-computed
+        # device bytes per pooled token row across all layers (levels +
+        # scales), surfaced through stats() for the monitor's kv-pool row.
+        self.storage_dtype = str(storage_dtype)
+        self.row_bytes = int(row_bytes)
         self._lock = threading.Lock()
         # LIFO free lists: hottest pages get reused first (best for any
         # future device-side page cache locality)
@@ -186,6 +195,8 @@ class PagedKVPool:
                 "slots_total": self.max_slots,
                 "slots_in_use": slots,
                 "slot_occupancy": slots / float(self.max_slots),
+                "storage_dtype": self.storage_dtype,
+                "resident_bytes": self.row_bytes * self.n_pages * self.page_size,
             }
 
 

@@ -598,6 +598,36 @@ print("pallas smoke ok: dispatched %s, fused/unfused rel loss delta %.2g"
       % (dict(pk.KERNEL_DISPATCHES), delta))
 PY
 
+echo "== quant smoke (docs/passes.md) =="
+# calibrated-int8 serving end to end (ISSUE 18): zoo classifiers fit on
+# synthetic clusters must hold int8 top-1 within 0.5% of the fp32 oracle,
+# every fc mul must quantize and fuse, the kv-int8 GenerationEngine must
+# hold 2x max_slots in fewer pool bytes with the last-step logit drift
+# bounded, and the FLAGS_fp8_matmul path must actually dispatch
+JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+sys.path.insert(0, ".")
+from bench import run_quant_bench
+rec = run_quant_bench(smoke=True)
+assert rec["top1_delta_max"] <= 0.005, rec["zoo"]
+for name, z in rec["zoo"].items():
+    assert z["quantized_muls"] > 0 and z["fused_groups"] > 0, (name, z)
+    assert z["agreement"] >= 0.98, (name, z)
+kv = rec["kv_int8"]
+assert kv["max_slots_x"] >= 2.0, kv
+assert kv["pool_bytes_x"] < 0.75, kv
+assert kv["max_rel_logit_drift"] < 0.05, kv
+assert kv["token_agreement"] >= 0.95, kv
+assert kv["requests_ok"] == kv["requests"], kv
+assert rec["fp8_transformer"]["matmul_fp8_dispatches_per_step"] > 0, rec
+print("quant smoke ok: top-1 delta %.3f (zoo: %s), kv-int8 %dx slots at "
+      "%.2fx bytes, drift %.3f, token agreement %.3f, fp8 %d matmuls/step"
+      % (rec["top1_delta_max"], ",".join(sorted(rec["zoo"])),
+         int(kv["max_slots_x"]), kv["pool_bytes_x"],
+         kv["max_rel_logit_drift"], kv["token_agreement"],
+         rec["fp8_transformer"]["matmul_fp8_dispatches_per_step"]))
+PY
+
 echo "== fluidlint smoke (docs/static_analysis.md) =="
 # the whole model zoo — incl. the NMT beam-search while-loop and the gpt
 # prefill/decode serving programs — must lint at zero findings under

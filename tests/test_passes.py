@@ -198,10 +198,14 @@ def test_registered_pass_battery():
     assert len(names) >= 5
     assert set(passes.PRESETS) == {
         "training_default", "inference", "training_fused",
+        "inference_int8",
     }
     for pname in ("fuse_gemm_epilogue", "fuse_layer_norm", "fuse_optimizer"):
         assert pname in names
         assert pname in passes.PRESETS["training_fused"]
+    for pname in ("calibrate", "quantize_serving", "fuse_quant_gemm"):
+        assert pname in names
+        assert pname in passes.PRESETS["inference_int8"]
 
 
 # --------------------------------------------------------------------------

@@ -123,6 +123,7 @@ def _serving_summary(metrics):
             "padded_rows": scalar(m.get("padded_rows")),
             "traces": scalar(m.get("traces")),
             "variants": scalar(m.get("variants")),
+            "precision": scalar(m.get("precision")),
             "ok": scalar(m.get("requests"), "outcome=ok"),
             "rejected": scalar(m.get("requests"), "outcome=rejected"),
             "timeout": scalar(m.get("requests"), "outcome=timeout"),
@@ -143,6 +144,8 @@ def _serving_summary(metrics):
             row["gen_paged_flash"] = scalar(
                 m.get("gen_paged_flash_dispatches")
             )
+            row["gen_kv_bytes"] = scalar(m.get("gen_kv_bytes"))
+            row["gen_slots_total"] = scalar(m.get("gen_slots_total"))
             for key, hist in (
                 ("gen_token", m.get("gen_token_ms")),
                 ("gen_ttft", m.get("gen_ttft_ms")),
@@ -612,8 +615,14 @@ def render(summary):
             _fmt(s.get("rejected"), "{:.0f}", "0"),
             _fmt(s.get("timeout"), "{:.0f}", "0"),
         )
+        # precision gauge: 0 = native float variants, 1 = calibrated int8
+        # (engine) / int8 KV pools (generation)
+        prec = {0.0: "native", 1.0: "int8"}.get(s.get("precision"))
+        label = "serve/" + model
+        if prec is not None:
+            label += " [%s]" % prec
         rows.append((
-            "serve/" + model,
+            label,
             "p50 %s ms p99 %s ms (queue %s + device %s) | %s" % (
                 _fmt(s.get("p50_ms")),
                 _fmt(s.get("p99_ms")),
@@ -665,6 +674,18 @@ def render(summary):
                     _fmt(s.get("gen_paged_flash"), "{:.0f}", "0"),
                 ),
             ))
+            if s.get("gen_kv_bytes") is not None:
+                storage = {0.0: "fp32", 1.0: "int8"}.get(
+                    s.get("precision"), "?"
+                )
+                rows.append((
+                    "serve/gen %s kv-pool" % model,
+                    "%s storage, %s resident, %s slots" % (
+                        storage,
+                        _fmt_bytes(s.get("gen_kv_bytes")),
+                        _fmt(s.get("gen_slots_total"), "{:.0f}"),
+                    ),
+                ))
     if cc:
         rows.append((
             "serve/compile cache",
