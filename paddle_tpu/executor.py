@@ -1881,9 +1881,12 @@ class Executor:
             if _flags.get_flags("resilience_nan_guard")["resilience_nan_guard"]:
                 # host copies taken BEFORE the step: the donated in-place
                 # update invalidates the old device buffers, so these copies
-                # are the only way back when the step turns out poisoned
+                # are the only way back when the step turns out poisoned.
+                # np.array on top of the __array__ view — on the CPU backend
+                # np.asarray of a jax array is zero-copy, so the donated
+                # update would rewrite the "snapshot" underneath us
                 guard_snapshot = {
-                    n: np.asarray(scope.vars[n])
+                    n: np.array(np.asarray(scope.vars[n]))
                     for n in mut_names
                     if scope.vars.get(n) is not None
                 }
@@ -1910,6 +1913,9 @@ class Executor:
                 scope.vars[n] for n in mut_names if scope.vars.get(n) is not None
             ]
             if not _all_finite(watched):
+                from .observability import flightrec as _flightrec
+
+                _flightrec.trigger("nan_guard", step=self._run_seq)
                 if _opf["nan_provenance"]:
                     # localize BEFORE the rollback erases the poisoned state;
                     # the replay itself runs against the pre-step snapshot

@@ -50,6 +50,29 @@ Honored flags:
   record (op type/name, input stats, attrs, step) to the telemetry dir plus
   a health/nan_provenance counter. Off (default): failures name only the
   variable, as before.
+- trace_dir: when set, the distributed request tracer
+  (observability/tracing.py) exports kept trace segments as per-process
+  rotation-safe JSONL shards ``trace-host<k>-p<pid>.jsonl`` into this
+  directory — the fleet's per-request causality record (router attempt/
+  hedge spans, replica server spans, batcher/scheduler lifecycles, engine
+  execute spans). "" (default) disables shard export; span creation stays
+  on only if flightrec_dir needs the ring. With both unset the hot path
+  allocates nothing (NULL_SPAN).
+- trace_sample: fraction of OK traces kept by tail sampling, decided by a
+  deterministic hash of the trace id so every process keeps the same
+  traces. Error, slow and hedged traces are ALWAYS kept. 1.0 (default)
+  keeps everything.
+- trace_slow_ms: a trace segment containing any span at least this slow is
+  exempt from sampling (always kept).
+- trace_ring: per-process ring capacity (ended spans, sampled or not) —
+  the flight recorder's lookback window.
+- flightrec_dir: when set, anomaly triggers (replica 5xx, breaker
+  transition, NaN-guard trip, watchdog stall, staleness throttle) dump an
+  atomic flight-recorder bundle directory (spans.jsonl + metrics.json +
+  event.json + env.json) here — observability/flightrec.py,
+  docs/observability.md. "" (default) disables; trigger() is then a no-op.
+- flightrec_max_bundles: newest bundles kept on disk (oldest pruned).
+- flightrec_min_interval_s: per-reason rate limit between bundles.
 - serving_cache_dir: default persistent compile-cache directory for the
   serving runtime (serving/compile_cache.py): ServingEngine instances built
   without an explicit cache_dir store/load serialized jax.export artifacts
@@ -157,6 +180,13 @@ _DEFAULTS = {
     "telemetry_log_every": 0,
     "tensor_stats": "",
     "nan_provenance": False,
+    "trace_dir": "",
+    "trace_sample": 1.0,
+    "trace_slow_ms": 500.0,
+    "trace_ring": 4096,
+    "flightrec_dir": "",
+    "flightrec_max_bundles": 16,
+    "flightrec_min_interval_s": 2.0,
     "serving_cache_dir": "",
     "paged_flash": "auto",
     "gemm_double_buffer": "auto",

@@ -45,6 +45,9 @@ from random import Random
 
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..observability import flightrec as _flightrec
+from ..observability import tracing as _tracing
+from ..observability.tracing import NULL_SPAN, TRACE_HEADER
 from ..resilience.retry import DeadlineExceeded, FatalError, RetryPolicy
 from .health import Replica
 
@@ -176,9 +179,7 @@ class Router:
             name, url,
             breaker=CircuitBreaker(
                 name=name,
-                on_transition=lambda n, old, new: self._m_breaker.inc(
-                    replica=n, to=new
-                ),
+                on_transition=self._on_breaker,
                 **self.breaker_opts,
             ),
             down_after=self.down_after,
@@ -188,6 +189,14 @@ class Router:
         rep.probe()  # first look now, not a poll interval later
         self._refresh_acks()
         return rep
+
+    def _on_breaker(self, name, old, new):
+        self._m_breaker.inc(replica=name, to=new)
+        # a breaker flip is exactly the moment worth a black-box dump: the
+        # recent span ring holds the failed attempts that tripped it
+        _flightrec.trigger(
+            "breaker_transition", replica=name, from_state=old, to_state=new
+        )
 
     def deregister(self, name):
         with self._lock:
@@ -289,16 +298,19 @@ class Router:
         return None
 
     # ---- one attempt ------------------------------------------------------
-    def _send(self, rep, path, body, content_type, timeout_s, holder=None):
+    def _send(self, rep, path, body, content_type, timeout_s, holder=None,
+              trace_header=None):
         """One upstream HTTP exchange. `holder.conn` exposes the live
         connection so a hedging loser can be cancelled by closing it."""
         conn = http.client.HTTPConnection(rep.host, rep.port,
                                           timeout=timeout_s)
         if holder is not None:
             holder.conn = conn
+        headers = {"Content-Type": content_type}
+        if trace_header:
+            headers[TRACE_HEADER] = trace_header
         try:
-            conn.request("POST", path, body=body,
-                         headers={"Content-Type": content_type})
+            conn.request("POST", path, body=body, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
             return (resp.status, data,
@@ -308,26 +320,36 @@ class Router:
             conn.close()
 
     def _attempt_one(self, rep, path, body, content_type, timeout_s,
-                     holder=None, cancelled=None):
+                     holder=None, cancelled=None, span=NULL_SPAN):
         """Send to one replica, folding the outcome into its breaker and
         latency EWMA. Returns (status, body, ctype) for any < 500 status;
-        raises (retryably) otherwise. A cancelled hedge records nothing."""
+        raises (retryably) otherwise. A cancelled hedge records nothing.
+        The attempt span ends BEFORE the breaker sees the failure, so a
+        breaker-transition flight-recorder bundle contains it."""
+        span.tag(replica=rep.name, breaker=rep.breaker.state,
+                 inflight=rep.inflight)
         rep.begin_request()
         t0 = time.perf_counter()
         try:
             status, data, ctype, retry_after = self._send(
-                rep, path, body, content_type, timeout_s, holder
+                rep, path, body, content_type, timeout_s, holder,
+                trace_header=span.header(),
             )
         except Exception as e:
-            if cancelled is None or not cancelled.is_set():
-                rep.record_failure(e)
+            if cancelled is not None and cancelled.is_set():
+                span.tag(cancelled=True).end()
+                raise
+            span.error(e).end()
+            rep.record_failure(e)
             raise
         finally:
             rep.end_request()
         if status >= 500:
             err = UpstreamError(status, data, ctype, retry_after)
+            span.tag(code=status).error(err).end()
             rep.record_failure(err)
             raise err
+        span.tag(code=status).end()
         rep.record_success((time.perf_counter() - t0) * 1e3)
         return status, data, ctype
 
@@ -341,7 +363,8 @@ class Router:
                 return p95 / 1e3
         return self.hedge_delay_ms / 1e3
 
-    def _attempt_hedged(self, path, body, content_type, tried, timeout_s):
+    def _attempt_hedged(self, path, body, content_type, tried, timeout_s,
+                        parent_span=NULL_SPAN):
         """One (possibly hedged) attempt: primary now, a second replica if
         the primary is still silent after the hedge delay; first reply wins,
         the loser's connection is closed without a breaker penalty."""
@@ -353,13 +376,16 @@ class Router:
         cancelled = threading.Event()
         holders = []
 
-        def run(rep):
+        def run(rep, hedge_leg=False):
             holder = type("H", (), {"conn": None})()
             holders.append(holder)
+            span = parent_span.child(
+                "router.attempt", hedge=hedge_leg
+            )
             try:
                 results.put((rep, self._attempt_one(
                     rep, path, body, content_type, timeout_s,
-                    holder=holder, cancelled=cancelled,
+                    holder=holder, cancelled=cancelled, span=span,
                 ), None))
             except Exception as e:
                 results.put((rep, None, e))
@@ -375,7 +401,12 @@ class Router:
             if hedge is not None:
                 tried.add(hedge.name)
                 self._m_hedges.inc(event="launched")
-                threading.Thread(target=run, args=(hedge,),
+                # hedges are rare and diagnostic gold: exempt the whole
+                # trace from OK-trace sampling
+                parent_span.force_keep().event(
+                    "hedge_launched", replica=hedge.name
+                )
+                threading.Thread(target=run, args=(hedge, True),
                                  daemon=True).start()
                 outstanding += 1
 
@@ -395,6 +426,7 @@ class Router:
                                 pass
                     if rep is not primary:
                         self._m_hedges.inc(event="won")
+                        parent_span.event("hedge_won", replica=rep.name)
                     return ok
                 last_err = err
             got = []
@@ -417,11 +449,16 @@ class Router:
 
     # ---- routing ----------------------------------------------------------
     def route(self, path, body, content_type="application/json",
-              deadline_s=None):
+              deadline_s=None, parent=None):
         """Route one POST. Returns (status, body bytes, content type) — the
         winning replica's reply, or a router-synthesized 503/504 after the
-        deadline/budget/replicas are exhausted."""
+        deadline/budget/replicas are exhausted. `parent` (a Span or an
+        X-Fleet-Trace header value) roots this request's trace; the root
+        span records every attempt/hedge/backoff as child spans."""
         kind = "generate" if path.endswith(":generate") else "predict"
+        span = _tracing.tracer().start_span(
+            "router.request", parent=parent, kind=kind, path=path
+        )
         t0 = time.monotonic()
         total = float(deadline_s or self.total_deadline_s)
         hard_deadline = t0 + total
@@ -433,8 +470,18 @@ class Router:
             if attempts[0] > 0:
                 if not self._budget.take():
                     self._m_budget_denied.inc()
+                    span.event(
+                        "retry_denied",
+                        budget_tokens=round(self._budget.tokens, 2),
+                    )
                     raise FatalError("fleet retry budget exhausted")
                 self._m_retries.inc(kind=kind)
+                # retry-budget spend, per Dapper log entry: how much of the
+                # fleet's amplification headroom this request consumed
+                span.event(
+                    "retry", attempt=attempts[0],
+                    budget_tokens=round(self._budget.tokens, 2),
+                )
             attempts[0] += 1
             remaining = hard_deadline - time.monotonic()
             if remaining <= 0:
@@ -442,13 +489,17 @@ class Router:
             timeout_s = min(self.attempt_timeout_s, max(remaining, 0.05))
             if kind == "predict" and self.hedge_enabled:
                 return self._attempt_hedged(
-                    path, body, content_type, tried, timeout_s
+                    path, body, content_type, tried, timeout_s,
+                    parent_span=span,
                 )
             rep = self._pick(tried)
             if rep is None:
                 raise NoReplicaAvailable("no routable replica")
             tried.add(rep.name)
-            return self._attempt_one(rep, path, body, content_type, timeout_s)
+            return self._attempt_one(
+                rep, path, body, content_type, timeout_s,
+                span=span.child("router.attempt", attempt=attempts[0]),
+            )
 
         policy = self._retry_template.with_deadline(total)
         try:
@@ -474,6 +525,15 @@ class Router:
             ).encode(), "application/json"
         self._m_requests.inc(kind=kind, code=str(status))
         self._h_latency.observe((time.monotonic() - t0) * 1e3)
+        span.tag(code=status, attempts=attempts[0])
+        span.end("ok" if status < 500 else "error")
+        if status >= 500:
+            # the router gave up on a client request — dump the black box
+            # (span ring now includes this request's failed attempts)
+            _flightrec.trigger(
+                "router_5xx", code=status, path=path,
+                attempts=attempts[0], trace=span.trace_id,
+            )
         return status, data, ctype
 
     # ---- stats ------------------------------------------------------------
@@ -516,10 +576,15 @@ class Router:
             def log_message(self, fmt, *args):
                 pass
 
-            def _reply(self, code, body, content_type="application/json"):
+            def _reply(self, code, body, content_type="application/json",
+                       trace=None):
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                if trace:
+                    # the trace id rides back to the client: "my request
+                    # was slow" becomes a greppable span tree
+                    self.send_header(TRACE_HEADER, trace)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -571,13 +636,28 @@ class Router:
                         )
                         return
                     deadline = self.headers.get("X-Fleet-Deadline-S")
-                    status, data, ctype = router.route(
-                        self.path, body,
-                        self.headers.get("Content-Type",
-                                         "application/json"),
-                        deadline_s=float(deadline) if deadline else None,
+                    # adopt the client's trace context when it sent one;
+                    # route() opens the root span either way
+                    span = _tracing.tracer().start_span(
+                        "router.http", parent=self.headers.get(TRACE_HEADER),
+                        path=self.path,
                     )
-                    self._reply(status, data, content_type=ctype)
+                    try:
+                        status, data, ctype = router.route(
+                            self.path, body,
+                            self.headers.get("Content-Type",
+                                             "application/json"),
+                            deadline_s=float(deadline) if deadline else None,
+                            parent=span,
+                        )
+                    except Exception:
+                        span.end("error")
+                        raise
+                    span.tag(code=status).end(
+                        "ok" if status < 500 else "error"
+                    )
+                    self._reply(status, data, content_type=ctype,
+                                trace=span.header())
                 except Exception as e:
                     self._reply_json(500, {"error": repr(e)})
 

@@ -622,6 +622,15 @@ class Program:
                     )
                 nb.vars[name] = nv
             for op in blk.ops:
+                if for_test and (
+                    int(op.attrs.get(OpRole.OP_ROLE_KEY, OpRole.Forward))
+                    & (OpRole.Backward | OpRole.Optimize | OpRole.LRSched)
+                ):
+                    # reference clone(for_test) prunes the backward/optimizer/
+                    # lr-schedule ops (inference_optimize); without this the
+                    # "test" program still trains — an sgd step runs on every
+                    # inference call
+                    continue
                 attrs = {}
                 for k, val in op.attrs.items():
                     if isinstance(val, Block):

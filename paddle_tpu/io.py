@@ -290,7 +290,10 @@ def load_vars(
             arr = np.load(os.path.join(dirname, name + ".npy"))
             if _stored_dtype(dirname, name, meta) == "bfloat16":
                 arr = jnp.asarray(arr, dtype=jnp.bfloat16)
-        scope.set_var(name, jnp.asarray(arr))
+        # jnp.array (copy), not asarray: a zero-copy wrap of the loaded numpy
+        # buffer corrupts same-sized params once the donating step jit runs
+        # (see resilience/elastic.py Supervisor._overlay)
+        scope.set_var(name, jnp.array(arr))
 
 
 def load_params(executor, dirname, main_program=None, filename=None):

@@ -15,12 +15,23 @@ deployment is operated with:
 - opprof:    op-LEVEL attribution — per-op device-time/FLOPs profile
              (op_profile records, tools/op_profile.py), FLAGS_tensor_stats
              on-device output statistics, and FLAGS_nan_provenance
-             first-bad-op localization when a NaN guard trips.
+             first-bad-op localization when a NaN guard trips;
+- tracing:   Dapper-style per-request distributed tracing across
+             router -> replica -> batcher/scheduler -> engine, with tail
+             sampling and per-process JSONL span shards (FLAGS_trace_dir);
+- flightrec: dump-on-trigger anomaly bundles — recent spans + metrics +
+             the triggering event, written atomically on a 5xx, breaker
+             transition, NaN-guard trip, watchdog stall or staleness
+             throttle (FLAGS_flightrec_dir).
 
-Live view: `python tools/monitor.py <telemetry_dir>`.
+Live view: `python tools/monitor.py <telemetry_dir>`; traces render via
+`python tools/trace_view.py <trace_dir>` and
+`python tools/timeline.py --trace_path <trace_dir>`.
 """
 
-from . import export, opprof, registry, stepstats  # noqa: F401
+from . import export, flightrec, opprof, registry, stepstats, tracing  # noqa: F401
+from .flightrec import FlightRecorder
+from .tracing import NULL_SPAN, Span, Tracer
 from .registry import Counter, Gauge, Histogram, MetricRegistry, default_registry
 from .stepstats import (
     StepStats,
@@ -45,4 +56,10 @@ __all__ = [
     "stepstats",
     "export",
     "opprof",
+    "tracing",
+    "flightrec",
+    "NULL_SPAN",
+    "Span",
+    "Tracer",
+    "FlightRecorder",
 ]

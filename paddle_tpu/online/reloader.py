@@ -32,6 +32,7 @@ import warnings
 
 import numpy as np
 
+from ..observability import tracing as _tracing
 from ..resilience import async_ckpt
 from . import publisher as _publisher
 from . import staleness as _staleness
@@ -185,8 +186,16 @@ class HotReloader:
             raise IOError("no loadable version in %s" % self.repo)
         step, arrays, info = loaded
         st = dict(info.get("stamp") or pointer.get("stamp") or {})
-        for engine in self.engines.values():
-            engine.set_params(arrays, version=step, stamp=st)
+        for name, engine in self.engines.items():
+            # force-kept root span: hot swaps are rare, operator-relevant
+            # events — every one lands in the trace shards regardless of
+            # the sampling rate, so a latency blip can be lined up with
+            # the param swap that caused it
+            with _tracing.tracer().start_span(
+                "reloader.swap", kind="base", engine=name, version=step,
+                arrays=len(arrays),
+            ).force_keep():
+                engine.set_params(arrays, version=step, stamp=st)
         self.applied_version = int(step)
         self.applied_base = info["base_step"]
         self.applied_stamp = st
@@ -202,7 +211,7 @@ class HotReloader:
         table_names = [
             n for n, m in manifest["arrays"].items() if m["kind"] == "rows"
         ]
-        for engine in self.engines.values():
+        for name, engine in self.engines.items():
             seed = {}
             for n in table_names:
                 cur = engine.scope.vars.get(n)
@@ -213,7 +222,11 @@ class HotReloader:
                 n: updated[n] for n in mf["arrays"] if n in updated
             }
             st = dict(mf.get("stamp") or {})
-            engine.set_params(updates, version=step, stamp=st)
+            with _tracing.tracer().start_span(
+                "reloader.swap", kind="delta", engine=name, version=step,
+                arrays=len(updates),
+            ).force_keep():
+                engine.set_params(updates, version=step, stamp=st)
             self.applied_stamp = st
         self.applied_version = int(step)
         self.applied_base = manifest["base_step"]

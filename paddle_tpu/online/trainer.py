@@ -146,6 +146,9 @@ class OnlineTrainer:
         """Publish the serve set now unless the staleness throttle says the
         fleet is too far behind. Returns the committed pointer or None."""
         if not force_base and not self.publisher.should_publish(self.sup.step):
+            from ..observability import flightrec as _flightrec
+
+            _flightrec.trigger("staleness_throttle", step=self.sup.step)
             return None
         return self.publish(force_base=force_base)
 

@@ -312,7 +312,14 @@ def device_op_profile(log_dir, hlo_text=None, print_table=True):
     in stop_profiler's table shape; prints the same report format."""
     mapping = _hlo_op_map(hlo_text) if hlo_text else {}
     table = {}
-    for name, (count, total, mn, mx) in device_instr_events(log_dir).items():
+    try:
+        events = device_instr_events(log_dir)
+    except AttributeError:
+        # jaxlib without jax.profiler.ProfileData (e.g. the CPU test
+        # backend's build): no device plane to aggregate — degrade to an
+        # empty table as documented; mfu_audit keeps the loud failure
+        events = {}
+    for name, (count, total, mn, mx) in events.items():
         key = mapping.get(name)
         if key is None:
             # strip SSA suffix then retry, else group by HLO opcode

@@ -892,7 +892,12 @@ class AsyncCheckpointer:
         snap = {}
         for n, a in arrays.items():
             try:
-                snap[n] = np.asarray(a)
+                # np.array on top of the __array__ view: on the CPU backend
+                # np.asarray of a jax array is ZERO-COPY, and the background
+                # writer would otherwise serialize memory that the next
+                # donated step overwrites in place (on TPU the device->host
+                # transfer always copies, which masked this).
+                snap[n] = np.array(np.asarray(a))
             except Exception as e:  # pragma: no cover - multi-process arrays
                 raise RuntimeError(
                     "cannot host-snapshot %r for the elastic checkpoint "

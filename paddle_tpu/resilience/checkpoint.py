@@ -168,7 +168,8 @@ def snapshot_persistables(program, scope=None):
     """Host-side name->array snapshot of the program's persistable state
     (params, optimizer accumulators, lr) — the checkpointable set. Gradient
     staging names (`*@GRAD` etc.) are transient and skipped, like
-    save_persistables. np.asarray copies to host NOW, so a later donated
+    save_persistables. Copied to host NOW (np.array, not the zero-copy
+    np.asarray view the CPU backend hands back), so a later donated
     in-place step cannot mutate the snapshot."""
     from ..executor import global_scope
     from ..io import _is_persistable
@@ -180,7 +181,7 @@ def snapshot_persistables(program, scope=None):
             continue
         val = scope.find_var(v.name)
         if val is not None:
-            out[v.name] = np.asarray(val)
+            out[v.name] = np.array(np.asarray(val))
     return out
 
 
@@ -208,6 +209,7 @@ def resume_or_init(exe, startup_program, root, scope=None, program=None):
         allowed = {v.name for v in program.list_vars()}
     for name, arr in arrays.items():
         if allowed is None or name in allowed:
-            scope.set_var(name, jnp.asarray(arr))
+            # copy, not zero-copy wrap — see resilience/elastic.py _overlay
+            scope.set_var(name, jnp.array(arr))
     health.incr("resumed_from_checkpoint")
     return step

@@ -131,6 +131,9 @@ class _Watchdog:
             if time.monotonic() - self._beat > self.deadline:
                 self._stalled = True
                 health.incr("watchdog_stalls")
+                from ..observability import flightrec as _flightrec
+
+                _flightrec.trigger("watchdog_stall", deadline_s=self.deadline)
                 try:
                     _registry().counter(
                         "resilience/watchdog_stalls",
@@ -398,7 +401,11 @@ class Supervisor:
             allowed = {v.name for v in self.program.list_vars()}
         for name, arr in arrays.items():
             if allowed is None or name in allowed:
-                scope.set_var(name, jnp.asarray(arr))
+                # jnp.array (copy), NOT jnp.asarray: asarray zero-copy wraps
+                # the loaded numpy buffer on the CPU backend, and handing an
+                # externally-backed buffer to the donating step jit corrupts
+                # same-sized parameters (two outputs land in one buffer)
+                scope.set_var(name, jnp.array(arr))
 
     def _emergency(self, why):
         """Hang/deadline path: persist what we have, then surface a typed
@@ -493,7 +500,8 @@ def resume_or_init(exe, startup_program, root, scope=None, program=None):
         cursor = {}
     for name, arr in arrays.items():
         if allowed is None or name in allowed:
-            scope.set_var(name, jnp.asarray(arr))
+            # copy, not zero-copy wrap — see Supervisor._overlay
+            scope.set_var(name, jnp.array(arr))
     health.incr("resumed_from_checkpoint")
     try:
         _registry().counter(

@@ -39,6 +39,9 @@ import time
 
 import numpy as np
 
+from ..observability import tracing as _tracing
+from ..observability.tracing import NULL_SPAN
+
 __all__ = [
     "ContinuousBatcher",
     "ServingFuture",
@@ -105,13 +108,17 @@ class ServingFuture:
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "future", "t_submit")
+    __slots__ = ("feed", "rows", "future", "t_submit", "span")
 
-    def __init__(self, feed, rows):
+    def __init__(self, feed, rows, span=NULL_SPAN):
         self.feed = feed
         self.rows = rows
         self.future = ServingFuture()
         self.t_submit = time.perf_counter()
+        # the request's lifecycle span (queued -> admitted -> dispatched ->
+        # completed events); NULL_SPAN when tracing is off — zero per-
+        # request allocation on the disabled path
+        self.span = span
 
 
 class ContinuousBatcher:
@@ -157,11 +164,12 @@ class ContinuousBatcher:
         self._worker.start()
 
     # ---- client side ------------------------------------------------------
-    def submit(self, feed):
+    def submit(self, feed, parent=None):
         """Enqueue one request (dict name->array or list zipped with the
         engine's feed_names); returns a ServingFuture. Raises QueueFullError
         when admission would exceed max_queue_rows, ShutdownError after
-        close()."""
+        close(). `parent` (a Span or trace header) parents the request's
+        lifecycle span when tracing is on."""
         if isinstance(feed, (list, tuple)):
             feed = dict(zip(self.engine.feed_names, feed))
         feed = {k: np.asarray(v) for k, v in feed.items()}
@@ -188,13 +196,18 @@ class ContinuousBatcher:
                 "request rows %d exceed the largest bucket %d; split the "
                 "request" % (n, self.engine.max_batch)
             )
-        req = _Request(feed, n)
+        req = _Request(feed, n, span=_tracing.tracer().start_span(
+            "serving.request", parent=parent, model=self.engine.name, rows=n,
+        ))
+        req.span.event("queued")
         with self._cond:
             if not self._alive or self._draining:
                 self._m_requests.inc(outcome="shutdown")
+                req.span.tag(outcome="shutdown").end("error")
                 raise ShutdownError("batcher is shut down")
             if self._queued_rows + n > self.max_queue_rows:
                 self._m_requests.inc(outcome="rejected")
+                req.span.tag(outcome="rejected").end("error")
                 raise QueueFullError(
                     "queue full (%d rows queued, limit %d)"
                     % (self._queued_rows, self.max_queue_rows),
@@ -209,6 +222,7 @@ class ContinuousBatcher:
                 est_wait = (self._queued_rows + n) / self._drain_rate
                 if est_wait > self.timeout:
                     self._m_requests.inc(outcome="rejected")
+                    req.span.tag(outcome="shed").end("error")
                     raise QueueFullError(
                         "queue drain estimate %.0f ms exceeds request "
                         "timeout %.0f ms (%d rows queued at %.0f rows/s)"
@@ -294,6 +308,7 @@ class ContinuousBatcher:
         for req in batch:
             if now - req.t_submit > self.timeout:
                 self._m_requests.inc(outcome="timeout")
+                req.span.tag(outcome="timeout").end("error")
                 with self._cond:
                     hint = self._retry_after_locked()
                 req.future._set_error(
@@ -309,6 +324,9 @@ class ContinuousBatcher:
             return
         for req in live:
             self._m_queue_ms.observe((now - req.t_submit) * 1e3)
+            req.span.event(
+                "admitted", queue_ms=round((now - req.t_submit) * 1e3, 3)
+            )
         # requests may disagree on dynamic trailing dims (sequence lengths);
         # np.concatenate across mixed trailing shapes raises and would fail
         # the whole batch, so pack and execute one same-trailing-shape group
@@ -336,14 +354,29 @@ class ContinuousBatcher:
             for n in self.engine.feed_names
         }
         self._batches_dispatched += 1
+        total_rows = sum(r.rows for r in live)
+        # one batch span per engine call, parented on the first request of
+        # the group (FIFO head); co-batched requests cross-link to it via a
+        # "dispatched" event so the chrome-trace view shows the sharing
+        bspan = live[0].span.child(
+            "serving.batch", requests=len(live), rows=total_rows,
+        )
+        if bspan:
+            for req in live[1:]:
+                req.span.event("dispatched", batch_span=bspan.span_id)
         t_run = time.perf_counter()
         try:
-            outs = self.engine.run(packed)
+            # activate: the engine opens its execute span under this parent
+            # without the engine API taking a span argument
+            with _tracing.tracer().activate(bspan):
+                outs = self.engine.run(packed)
         except Exception as e:
+            bspan.error(e).end()
             # a fresh exception per future: the same instance re-raised from
             # several caller threads would share (and mutate) one traceback
             for req in live:
                 self._m_requests.inc(outcome="error")
+                req.span.tag(outcome="error").end("error")
                 err = RuntimeError("engine failed: %s" % (repr(e),))
                 err.__cause__ = e
                 req.future._set_error(err)
@@ -360,14 +393,6 @@ class ContinuousBatcher:
         # THIS (dispatcher) thread, where the engine recorded it
         served = getattr(self.engine, "last_served_version", None)
         version = served() if callable(served) else None
-        if self._batches_dispatched % 32 == 0:
-            # periodic telemetry snapshot (flag-gated inside stepstats):
-            # serving has no training step to ride, so the batcher is the
-            # interval clock that lands serving/* metrics in the JSONL
-            # shards tools/monitor.py reads
-            from ..observability import stepstats as _stepstats
-
-            _stepstats.maybe_flush()
         lo = 0
         total = sum(r.rows for r in live)
         for req in live:
@@ -378,10 +403,24 @@ class ContinuousBatcher:
                 for o in outs
             ]
             lo += req.rows
-            self._m_latency_ms.observe((done - req.t_submit) * 1e3)
-            self._m_requests.inc(outcome="ok")
             req.future.model_version = version
             req.future._set_result(part)
+        # bookkeeping AFTER answering the futures: span ends (and the root
+        # end's segment serialization) and metric updates stay off the
+        # client's measured request latency
+        bspan.tag(model_version=version).end()
+        for req in live:
+            self._m_latency_ms.observe((done - req.t_submit) * 1e3)
+            self._m_requests.inc(outcome="ok")
+            req.span.tag(outcome="ok", model_version=version).end()
+        if self._batches_dispatched % 32 == 0:
+            # periodic telemetry snapshot (flag-gated inside stepstats):
+            # serving has no training step to ride, so the batcher is the
+            # interval clock that lands serving/* metrics in the JSONL
+            # shards tools/monitor.py reads
+            from ..observability import stepstats as _stepstats
+
+            _stepstats.maybe_flush()
 
     # ---- lifecycle --------------------------------------------------------
     def close(self, drain=True, timeout=30.0):
@@ -392,6 +431,7 @@ class ContinuousBatcher:
             if not drain:
                 for req in self._queue:
                     self._m_requests.inc(outcome="shutdown")
+                    req.span.tag(outcome="shutdown").end("error")
                     req.future._set_error(ShutdownError("batcher closed"))
                 self._queued_rows = 0
                 self._queue = []

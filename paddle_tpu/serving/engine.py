@@ -52,6 +52,7 @@ import numpy as np
 
 from .. import io as _io
 from ..executor import Executor, Scope, aot_serve_lowering, scope_guard
+from ..observability import tracing as _tracing
 from . import compile_cache as _cc
 
 __all__ = ["ServingEngine", "DEFAULT_BATCH_BUCKETS"]
@@ -190,6 +191,7 @@ class ServingEngine:
         self.cache = _cc.CompileCache(cache_dir) if cache_dir else None
 
         self._variants = {}
+        self._variant_tags = {}  # id(compiled fn) -> trace display string
         self._build_lock = threading.Lock()
         self.traces = 0  # variants traced+compiled (not served from cache)
         self.cache_hits = 0  # variants deserialized from the compile cache
@@ -505,11 +507,31 @@ class ServingEngine:
         # on one coherent version and reports it faithfully
         with self._swap_lock:
             ro, mut, ver = self._ro, self._mut, self.model_version
+        # execute span under the caller's activated span (the batcher's
+        # serving.batch); truthiness-gated so the tracing-off path never
+        # builds the variant-key string
+        span = _tracing.current()
+        if span:
+            # the variant display string is a pure function of the compiled
+            # variant: build it once per variant, not per request
+            vtag = self._variant_tags.get(id(fn))
+            if vtag is None:
+                vtag = ",".join(
+                    "%s:%s:%s" % (nm, "x".join(map(str, s.shape)), s.dtype)
+                    for nm, s in sorted(avals.items())
+                )
+                self._variant_tags[id(fn)] = vtag
+            span = span.child(
+                "engine.execute", variant=vtag, bucket=bucket, rows=n,
+                precision=self.precision, model_version=ver,
+            )
         t0 = time.perf_counter()
         outs = fn(padded, ro, mut)
         self._served_tls.version = ver
         outs = [np.asarray(o) for o in outs]
-        self._m_device_ms.observe((time.perf_counter() - t0) * 1e3)
+        device_ms = (time.perf_counter() - t0) * 1e3
+        span.tag(device_ms=round(device_ms, 3)).end()
+        self._m_device_ms.observe(device_ms)
         self._m_rows.inc(n)
         self._m_padded.inc(bucket - n)
         self._m_fill.observe(n / float(bucket))

@@ -60,6 +60,8 @@ import time
 import numpy as np
 
 from ..executor import Scope, aot_serve_lowering, scope_guard
+from ..observability import tracing as _tracing
+from ..observability.tracing import NULL_SPAN
 from .batcher import (
     ContinuousBatcher,
     QueueFullError,
@@ -161,7 +163,7 @@ class _SlotRun:
 
     __slots__ = ("req", "slot", "table", "tokens", "next_pos", "rng",
                  "pf_pos", "done", "finish_reason", "future", "t_submit",
-                 "t_first")
+                 "t_first", "span")
 
     def __init__(self, req, slot, table, rng):
         self.req = req
@@ -176,6 +178,7 @@ class _SlotRun:
         self.future = None
         self.t_submit = None
         self.t_first = None
+        self.span = NULL_SPAN
 
     def result(self):
         return GenResult(list(self.tokens), self.finish_reason,
@@ -612,17 +615,29 @@ class GenerationEngine:
         n_real = min(c, remaining)
         tokens = np.zeros((1, c, 1), np.int64)
         tokens[0, :n_real, 0] = req.prompt[start:start + n_real]
+        span = _tracing.current()
+        if span:
+            span = span.child(
+                "engine.prefill", chunk=c, start=start, rows=n_real,
+                kv_dtype=self.kv_dtype, model_version=self.model_version,
+            )
         t0 = time.perf_counter()
-        (logits,) = self._call(
-            self._variant("prefill:%d" % c),
-            {
-                "gen_tokens": tokens,
-                "gen_start": np.array([start], np.int64),
-                "gen_last": np.array([n_real - 1], np.int64),
-                "gen_pages": run.table,
-            },
-        )
-        self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+        try:
+            (logits,) = self._call(
+                self._variant("prefill:%d" % c),
+                {
+                    "gen_tokens": tokens,
+                    "gen_start": np.array([start], np.int64),
+                    "gen_last": np.array([n_real - 1], np.int64),
+                    "gen_pages": run.table,
+                },
+            )
+        except Exception as e:
+            span.error(e).end()
+            raise
+        prefill_ms = (time.perf_counter() - t0) * 1e3
+        span.tag(device_ms=round(prefill_ms, 3)).end()
+        self._m_prefill_ms.observe(prefill_ms)
         self._m_chunks.inc()
         run.pf_pos = start + n_real
         if run.pf_pos < L:
@@ -671,11 +686,23 @@ class GenerationEngine:
                 raise ValueError("decode_step on a finished run")
             tokens[run.slot, 0] = run.tokens[-1]
             positions[run.slot, 0] = run.next_pos
+        span = _tracing.current()
+        if span:
+            span = span.child(
+                "engine.decode", slots=len(runs),
+                kv_dtype=self.kv_dtype, model_version=self.model_version,
+            )
         t0 = time.perf_counter()
-        (logits,) = self._call(self._variant("decode"), feeds)
+        try:
+            (logits,) = self._call(self._variant("decode"), feeds)
+        except Exception as e:
+            span.error(e).end()
+            raise
         logits = np.asarray(logits)
         self.last_logits = logits  # parity surface, see prefill_step()
-        self._m_step_ms.observe((time.perf_counter() - t0) * 1e3)
+        step_ms = (time.perf_counter() - t0) * 1e3
+        span.tag(device_ms=round(step_ms, 3)).end()
+        self._m_step_ms.observe(step_ms)
         self._m_steps.inc()
         for run in runs:
             run.next_pos += 1
@@ -781,12 +808,13 @@ class GenerationEngine:
 
 
 class _Pending:
-    __slots__ = ("req", "future", "t_submit")
+    __slots__ = ("req", "future", "t_submit", "span")
 
-    def __init__(self, req):
+    def __init__(self, req, span=NULL_SPAN):
         self.req = req
         self.future = ServingFuture()
         self.t_submit = time.perf_counter()
+        self.span = span
 
 
 class GenerationScheduler(ContinuousBatcher):
@@ -835,9 +863,10 @@ class GenerationScheduler(ContinuousBatcher):
 
     # ---- client side ------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_id=None, temperature=None,
-               top_k=None, seed=None):
+               top_k=None, seed=None, parent=None):
         """Enqueue one generation request; returns a ServingFuture resolving
-        to a GenResult."""
+        to a GenResult. `parent` optionally links the request's trace span
+        under a caller span (or an X-Fleet-Trace header value)."""
         req = GenRequest(
             prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
             temperature=temperature, top_k=top_k, seed=seed,
@@ -847,17 +876,23 @@ class GenerationScheduler(ContinuousBatcher):
                 "prompt of %d tokens exceeds max_prompt_len %d"
                 % (len(req.prompt), self.engine.max_prompt_len)
             )
-        pending = _Pending(req)
+        pending = _Pending(req, span=_tracing.tracer().start_span(
+            "serving.genrequest", parent=parent, model=self.engine.name,
+            prompt_len=len(req.prompt), max_new=req.max_new_tokens,
+        ))
         with self._cond:
             if not self._alive or self._draining:
                 self._m_requests.inc(outcome="shutdown")
+                pending.span.tag(outcome="shutdown").end("error")
                 raise ShutdownError("scheduler is shut down")
             if self._queued_rows + 1 > self.max_queue_rows:
                 self._m_requests.inc(outcome="rejected")
+                pending.span.tag(outcome="rejected").end("error")
                 raise QueueFullError(
                     "queue full (%d requests queued, limit %d)"
                     % (self._queued_rows, self.max_queue_rows)
                 )
+            pending.span.event("queued", depth=self._queued_rows)
             self._queue.append(pending)
             self._queued_rows += 1
             self._m_depth.set(self._queued_rows)
@@ -910,6 +945,7 @@ class GenerationScheduler(ContinuousBatcher):
                 self._queue.pop(0)
                 self._queued_rows -= 1
                 self._m_requests.inc(outcome="timeout")
+                nxt.span.tag(outcome="timeout").end("error")
                 nxt.future._set_error(RequestTimeout(
                     "queued %.0f ms > timeout %.0f ms"
                     % ((time.perf_counter() - nxt.t_submit) * 1e3,
@@ -932,25 +968,31 @@ class GenerationScheduler(ContinuousBatcher):
     def _step(self, admits):
         eng = self.engine
         for pending in admits:
-            self._m_queue_ms.observe(
-                (time.perf_counter() - pending.t_submit) * 1e3
-            )
+            queue_ms = (time.perf_counter() - pending.t_submit) * 1e3
+            self._m_queue_ms.observe(queue_ms)
             try:
                 run = eng.admit(pending.req)
             except PoolExhausted as e:
                 # capacity raced away (shouldn't happen single-threaded,
                 # but never drop a request on the floor)
                 self._m_requests.inc(outcome="error")
+                pending.span.error(e).tag(outcome="error").end()
                 pending.future._set_error(e)
                 continue
             except Exception as e:
                 self._m_requests.inc(outcome="error")
+                pending.span.error(e).tag(outcome="error").end()
                 err = RuntimeError("admit failed: %s" % (repr(e),))
                 err.__cause__ = e
                 pending.future._set_error(err)
                 continue
             run.future = pending.future
             run.t_submit = pending.t_submit
+            run.span = pending.span
+            run.span.tag(
+                prefix_hit=run.pf_pos > 0, prefix_tokens=run.pf_pos,
+                kv_dtype=eng.kv_dtype,
+            ).event("admitted", slot=run.slot, queue_ms=round(queue_ms, 3))
             self._prefills.append(run)
 
         # advance prefill chunk-by-chunk: normally one chunk per step (its
@@ -969,10 +1011,12 @@ class GenerationScheduler(ContinuousBatcher):
                            key=lambda r: len(r.req.prompt) - r.pf_pos)
             for run in order[:n_chunks]:
                 try:
-                    finished = eng.prefill_step(run)
+                    with _tracing.tracer().activate(run.span):
+                        finished = eng.prefill_step(run)
                 except Exception as e:
                     self._prefills.remove(run)
                     self._m_requests.inc(outcome="error")
+                    run.span.error(e).tag(outcome="error").end()
                     err = RuntimeError("prefill failed: %s" % (repr(e),))
                     err.__cause__ = e
                     run.future._set_error(err)
@@ -981,7 +1025,9 @@ class GenerationScheduler(ContinuousBatcher):
                 if finished:
                     self._prefills.remove(run)
                     run.t_first = time.perf_counter()
-                    self._m_ttft_ms.observe((run.t_first - run.t_submit) * 1e3)
+                    ttft_ms = (run.t_first - run.t_submit) * 1e3
+                    self._m_ttft_ms.observe(ttft_ms)
+                    run.span.event("first_token", ttft_ms=round(ttft_ms, 3))
                     if run.done:
                         self._retire(run)
                     else:
@@ -991,10 +1037,14 @@ class GenerationScheduler(ContinuousBatcher):
         if live:
             t0 = time.perf_counter()
             try:
-                eng.decode_step(live)
+                # the decode step is shared across slots; its engine.decode
+                # span hangs off one representative request's trace
+                with _tracing.tracer().activate(live[0].span):
+                    eng.decode_step(live)
             except Exception as e:
                 for run in live:
                     self._m_requests.inc(outcome="error")
+                    run.span.error(e).tag(outcome="error").end()
                     err = RuntimeError("decode failed: %s" % (repr(e),))
                     err.__cause__ = e
                     run.future._set_error(err)
@@ -1012,11 +1062,18 @@ class GenerationScheduler(ContinuousBatcher):
         self.engine.finish(run)
         self._m_requests.inc(outcome="ok")
         self._m_latency_ms.observe((time.perf_counter() - run.t_submit) * 1e3)
+        run.span.tag(
+            outcome="ok", finish_reason=run.finish_reason,
+            tokens=len(run.tokens),
+            decode_steps=max(0, len(run.tokens) - 1),
+            model_version=self.engine.model_version,
+        ).end()
         run.future._set_result(run.result())
 
     def _fail_runs_locked(self):
         for run in list(self._runs.values()) + self._prefills:
             self._m_requests.inc(outcome="shutdown")
+            run.span.tag(outcome="shutdown").end("error")
             run.future._set_error(ShutdownError("scheduler closed"))
             self.engine.finish(run)
         self._runs.clear()

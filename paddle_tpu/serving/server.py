@@ -55,6 +55,9 @@ import numpy as np
 
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..observability import flightrec as _flightrec
+from ..observability import tracing as _tracing
+from ..observability.tracing import NULL_SPAN, TRACE_HEADER
 from ..resilience import faults as _faults
 from .batcher import ContinuousBatcher, QueueFullError, RequestTimeout
 from .engine import ServingEngine
@@ -146,8 +149,14 @@ class ModelServer:
                 pass
 
             def _reply(self, code, body, content_type="application/json",
-                       retry_after=None):
+                       retry_after=None, trace=None):
                 server._m_http.inc(code=str(code))
+                if code >= 500:
+                    # a replica-side 5xx is a flight-recorder trigger: the
+                    # span ring at this instant holds the request's story
+                    _flightrec.trigger(
+                        "http_5xx", code=code, path=self.path, trace=trace
+                    )
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
@@ -155,6 +164,8 @@ class ModelServer:
                     retry_after = 1
                 if retry_after is not None:
                     self.send_header("Retry-After", str(int(retry_after)))
+                if trace:
+                    self.send_header(TRACE_HEADER, trace)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -188,6 +199,14 @@ class ModelServer:
                     self._reply_json(500, {"error": repr(e)})
 
             def do_POST(self):
+                # the server span adopts the router's trace context before
+                # the fault hooks run, so even a request that dies to an
+                # injected fault leaves its span in this replica's shard
+                span = _tracing.tracer().start_span(
+                    "server.request",
+                    parent=self.headers.get(TRACE_HEADER),
+                    path=self.path,
+                )
                 try:
                     # serving-side fault hooks (docs/resilience.md): a
                     # replica dying mid-request, a half-open connection, a
@@ -195,6 +214,7 @@ class ModelServer:
                     # router's failover/retry/breaker paths soak against
                     _faults.kill_self("replica_kill")
                     if _faults.fires("conn_reset"):
+                        span.tag(fault="conn_reset").end("error")
                         self.close_connection = True
                         self.connection.close()
                         return
@@ -205,10 +225,15 @@ class ModelServer:
                         self.rfile.read(
                             int(self.headers.get("Content-Length", 0))
                         ),
+                        parent=span,
                     )
+                    span.tag(code=code)
                     self._reply(code, body, content_type=ctype,
-                                retry_after=retry_after)
+                                retry_after=retry_after,
+                                trace=span.header())
+                    span.end("ok" if code < 500 else "error")
                 except Exception as e:
+                    span.error(e).end()
                     self._reply_json(500, {"error": repr(e)})
 
         self._httpd = ThreadingHTTPServer((self.host, self._port), Handler)
@@ -307,13 +332,14 @@ class ModelServer:
             out["version_stamp"] = dict(stamp)
         return 200, out
 
-    def _predict(self, path, content_type, body):
+    def _predict(self, path, content_type, body, parent=NULL_SPAN):
         """(status, reply bytes, content type, retry-after hint) for one
         predict/generate POST. retry_after is None except on 503/504, where
         it is derived from the batcher's measured queue drain rate."""
         if path.startswith(PREDICT_PREFIX) and path.endswith(":generate"):
             return self._generate(
-                path[len(PREDICT_PREFIX):-len(":generate")], body
+                path[len(PREDICT_PREFIX):-len(":generate")], body,
+                parent=parent,
             )
         if not (path.startswith(PREDICT_PREFIX) and path.endswith(":predict")):
             return 404, json.dumps({"error": "no route %s" % path}).encode(), \
@@ -351,7 +377,7 @@ class ModelServer:
 
         t0 = time.perf_counter()
         try:
-            future = hosted.batcher.submit(feed)
+            future = hosted.batcher.submit(feed, parent=parent)
         except QueueFullError as e:
             return 503, json.dumps({"error": str(e)}).encode(), \
                 "application/json", self._retry_after(hosted, e)
@@ -406,7 +432,7 @@ class ModelServer:
         hint = getattr(hosted.batcher, "retry_after_hint", None)
         return hint() if callable(hint) else 1
 
-    def _generate(self, name, body):
+    def _generate(self, name, body, parent=NULL_SPAN):
         """(status, reply bytes, content type, retry-after hint) for one
         :generate POST."""
         hosted = self._models.get(name)
@@ -435,7 +461,7 @@ class ModelServer:
 
         t0 = time.perf_counter()
         try:
-            future = hosted.batcher.submit(prompt, **kw)
+            future = hosted.batcher.submit(prompt, parent=parent, **kw)
         except QueueFullError as e:
             return 503, json.dumps({"error": str(e)}).encode(), \
                 "application/json", self._retry_after(hosted, e)

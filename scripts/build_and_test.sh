@@ -729,6 +729,33 @@ print("fleet smoke ok: %d requests, 0 5xx, served 100%%, failover p99 "
          rec["slow_response_breaker_opens"]))
 PY
 
+echo "== tracing + flight recorder smoke (docs/observability.md) =="
+# distributed request tracing end to end: serving p99 with tracing on vs
+# off, then a 3-replica chaos round (conn_reset faults + SIGKILL) with
+# every process exporting spans into one shared trace dir. Asserts (inside
+# run_tracing_bench + re-checked here): served_fraction 1.0, at least one
+# failover trace whose spans come from >= 3 OS processes with a failed
+# attempt AND the successful retry, a flight-recorder bundle whose span
+# ring shows that failover, and both tools/timeline.py --trace_path and
+# tools/trace_view.py rendering the shards
+JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+sys.path.insert(0, ".")
+from bench import run_tracing_bench
+rec = run_tracing_bench(smoke=True)
+assert rec["served_fraction"] == 1.0, rec
+assert rec["failover_trace_processes"] >= 3, rec
+assert rec["bundles"] >= 1 and rec["bundle_shows_failover"], rec
+assert rec["timeline_events"] >= rec["spans"], rec
+print("tracing smoke ok: %d requests served 100%%, %d traces / %d spans, "
+      "failover trace %s across %d processes, %d bundle(s) [%s], "
+      "p99 on/off %.2f/%.2f ms"
+      % (rec["requests"], rec["traces"], rec["spans"],
+         rec["failover_trace"], rec["failover_trace_processes"],
+         rec["bundles"], ",".join(rec["bundle_reasons"]),
+         rec["p99_ms_tracing_on"], rec["p99_ms_tracing_off"]))
+PY
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

@@ -14,6 +14,19 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Persistent XLA compilation cache: the tier-1 lane spends most of its wall
+# clock recompiling the same programs every run (and every subprocess-spawning
+# test recompiles them again in each child). Env vars rather than
+# jax.config.update so spawned children (test_dist_subprocess, test_multihost)
+# inherit the cache too. Set BEFORE jax initialises; respect an explicit
+# caller-provided dir.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 if os.environ.get("PADDLE_OPTEST_PLACE", "").lower() != "tpu":
     from paddle_tpu.platform_setup import force_virtual_cpu_devices
 

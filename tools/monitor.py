@@ -436,6 +436,38 @@ def _fleet_summary(metrics):
     return out
 
 
+def _tracing_summary(metrics):
+    """Request-tracing + flight-recorder health from a snapshot's metric
+    dump: span throughput by status, the tail-sampling keep/drop split
+    (trace/... from observability.tracing) and anomaly bundles written or
+    rate-limited away (flightrec/... from observability.flightrec)."""
+
+    def labelled(name):
+        return (metrics.get(name) or {}).get("values") or {}
+
+    def by_label(name, key):
+        out = {}
+        for label, v in labelled(name).items():
+            if label.startswith(key + "="):
+                out[label.split("=", 1)[1]] = v
+        return out
+
+    spans = by_label("trace/spans", "status")
+    segments = by_label("trace/segments", "decision")
+    bundles = by_label("flightrec/bundles", "reason")
+    suppressed = labelled("flightrec/suppressed")
+    if not spans and not segments and not bundles:
+        return {}
+    return {
+        "spans_ok": spans.get("ok", 0),
+        "spans_error": spans.get("error", 0),
+        "segments_kept": segments.get("kept", 0),
+        "segments_dropped": segments.get("dropped", 0),
+        "bundles": bundles,
+        "bundles_suppressed": sum(suppressed.values()),
+    }
+
+
 def summarize(records, window=200):
     """Aggregate the record stream into the monitor's display fields.
 
@@ -473,6 +505,7 @@ def summarize(records, window=200):
         "passes": {},
         "online": {},
         "fleet": {},
+        "tracing": {},
     }
 
     if opprofs:
@@ -557,6 +590,7 @@ def summarize(records, window=200):
         summary["passes"] = _passes_summary(metrics)
         summary["online"] = _online_summary(metrics)
         summary["fleet"] = _fleet_summary(metrics)
+        summary["tracing"] = _tracing_summary(metrics)
         summary["health"] = dict(last.get("health", {}))
         memrec = last.get("mem", {})
         if memrec.get("mem_peak_bytes"):
@@ -846,6 +880,29 @@ def render(summary):
                 _fmt(flt.get("replicas_total"), "{:.0f}"),
             ),
         ))
+    trc = summary.get("tracing") or {}
+    if trc:
+        rows.append((
+            "trace/spans",
+            "%s ok / %s error, segments %s kept / %s dropped" % (
+                _fmt(trc.get("spans_ok"), "{:.0f}", "0"),
+                _fmt(trc.get("spans_error"), "{:.0f}", "0"),
+                _fmt(trc.get("segments_kept"), "{:.0f}", "0"),
+                _fmt(trc.get("segments_dropped"), "{:.0f}", "0"),
+            ),
+        ))
+        if trc.get("bundles") or trc.get("bundles_suppressed"):
+            per_reason = " ".join(
+                "%s:%d" % (r, int(v))
+                for r, v in sorted((trc.get("bundles") or {}).items())
+            ) or "-"
+            rows.append((
+                "trace/flightrec",
+                "bundles %s (%s rate-limited)" % (
+                    per_reason,
+                    _fmt(trc.get("bundles_suppressed"), "{:.0f}", "0"),
+                ),
+            ))
     passes = summary.get("passes") or {}
     for pname, p in sorted((passes.get("passes") or {}).items()):
         before = p.get("ops_before")

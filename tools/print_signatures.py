@@ -47,6 +47,7 @@ MODULES = [
     "paddle_tpu.data",
     "paddle_tpu.embedding",
     "paddle_tpu.online",
+    "paddle_tpu.observability",
     "paddle_tpu.resilience",
     "paddle_tpu.contrib",
     "paddle_tpu.contrib.memory_usage_calc",

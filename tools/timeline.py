@@ -12,12 +12,19 @@ memory high-water ride as counters under the same trace, so span events and
 the step-level health of the run line up on one time axis. The same
 name=path,... form merges counters from multiple trainers.
 
+--trace_path takes a FLAGS_trace_dir directory (or one trace-*.jsonl shard)
+of distributed request spans (observability/tracing.py) and lays them out as
+"ph": "X" lanes — one chrome pid per (host, process), one lane per thread —
+so a request's router -> replica -> batcher -> engine hops read as nested
+bars across processes. Span tags/events ride in args for the tooltip.
+
 Usage:
   python tools/timeline.py --profile_path /tmp/profile --timeline_path /tmp/timeline.json
   python tools/timeline.py --profile_path trainer0=/tmp/p0,trainer1=/tmp/p1 ...
   python tools/timeline.py --profile_path /tmp/profile \
       --telemetry_path /tmp/telem/telemetry-host0.jsonl \
       --timeline_path /tmp/timeline.json
+  python tools/timeline.py --trace_path /tmp/traces --timeline_path /tmp/timeline.json
 Then open chrome://tracing and load the output.
 """
 
@@ -142,7 +149,57 @@ def _op_profile_events(records, pid):
     return out, meta
 
 
-def convert(profile_path, timeline_path, telemetry_path=None):
+def _trace_span_events(spans, pid_base):
+    """Distributed request spans (observability/tracing.py shards) →
+    chrome-trace "X" lanes: one chrome pid per (host, os pid), one lane per
+    thread. Span starts are epoch seconds normalized to the earliest span
+    so the fleet's clocks share the trace's zero (they already share wall
+    time — the spans were stamped with time.time())."""
+    spans = [s for s in spans if s.get("kind") == "span" and "ts" in s]
+    if not spans:
+        return [], []
+    t0 = min(s["ts"] for s in spans)
+    procs = {}  # (host, pid) -> chrome pid
+    out, meta = [], []
+    for s in sorted(spans, key=lambda s: s["ts"]):
+        key = (s.get("host", "?"), s.get("pid", 0))
+        cpid = procs.get(key)
+        if cpid is None:
+            cpid = procs[key] = pid_base + len(procs)
+            meta.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": cpid,
+                    "args": {"name": "%s:p%s" % key},
+                }
+            )
+        args = {
+            "trace": s.get("trace"),
+            "span": s.get("span"),
+            "parent": s.get("parent"),
+            "status": s.get("status"),
+        }
+        args.update(s.get("tags") or {})
+        if s.get("events"):
+            args["events"] = s["events"]
+        out.append(
+            {
+                "name": s.get("name", "?"),
+                "cat": "trace",
+                "ph": "X",
+                "pid": cpid,
+                "tid": int(s.get("tid", 0)) % 100000,
+                "ts": (s["ts"] - t0) * 1e6,
+                "dur": max(float(s.get("dur_ms", 0.0)), 0.001) * 1e3,
+                "args": args,
+            }
+        )
+    return out, meta
+
+
+def convert(profile_path, timeline_path, telemetry_path=None,
+            trace_path=None):
     trace_events = []
     metadata = []
     pid = 0
@@ -199,6 +256,20 @@ def convert(profile_path, timeline_path, telemetry_path=None):
                 )
                 metadata.append(op_meta)
                 trace_events.extend(op_events)
+        pid += 2 * len(named)
+    if trace_path:
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from paddle_tpu.observability import tracing as _tracing
+
+        span_events, span_meta = _trace_span_events(
+            _tracing.load_spans(trace_path), pid
+        )
+        metadata.extend(span_meta)
+        trace_events.extend(span_events)
     with open(timeline_path, "w") as f:
         json.dump({"traceEvents": metadata + trace_events}, f)
     return len(trace_events)
@@ -212,9 +283,14 @@ if __name__ == "__main__":
     ap.add_argument("--telemetry_path", default="",
                     help="telemetry JSONL file(s) (name=path,... to merge); "
                          "emitted as chrome-trace counter tracks")
+    ap.add_argument("--trace_path", default="",
+                    help="FLAGS_trace_dir directory (or one trace-*.jsonl "
+                         "shard) of request spans; emitted as per-process "
+                         "span lanes")
     args = ap.parse_args()
-    if not args.profile_path and not args.telemetry_path:
-        ap.error("need --profile_path and/or --telemetry_path")
+    if not (args.profile_path or args.telemetry_path or args.trace_path):
+        ap.error("need --profile_path, --telemetry_path and/or --trace_path")
     n = convert(args.profile_path, args.timeline_path,
-                telemetry_path=args.telemetry_path or None)
+                telemetry_path=args.telemetry_path or None,
+                trace_path=args.trace_path or None)
     print("wrote %d events to %s" % (n, args.timeline_path))

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Terminal viewer for distributed request traces (FLAGS_trace_dir shards).
+
+Two modes over the same span shards (observability/tracing.py), mirroring
+what the reference stack reads off its Jaeger UI:
+
+- default: a top-k table of the slowest traces — root name, duration,
+  span/process counts, status — the "what should I look at" ranking;
+- --trace <id>: the full span tree of one trace, siblings in start order,
+  with the critical path (the chain of last-finishing spans from the root)
+  marked `*` — the "where did the time go" drilldown. Works across
+  processes: spans from every shard in the directory join one tree.
+
+Usage:
+  python tools/trace_view.py /tmp/traces                 # top-k slowest
+  python tools/trace_view.py /tmp/traces --top 20
+  python tools/trace_view.py /tmp/traces --errors        # error traces only
+  python tools/trace_view.py /tmp/traces --trace a1b2... # one trace's tree
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.observability import tracing as _tracing  # noqa: E402
+
+
+def group_traces(spans):
+    """trace_id -> list of span records, span-kind records only."""
+    traces = {}
+    for s in spans:
+        if s.get("kind") != "span" or not s.get("trace"):
+            continue
+        traces.setdefault(s["trace"], []).append(s)
+    return traces
+
+
+def trace_summary(trace_id, spans):
+    by_id = {s["span"]: s for s in spans}
+    roots = [s for s in spans if not s.get("parent")
+             or s["parent"] not in by_id]
+    # duration: first start to last end across the whole trace — a root
+    # whose children outlive it (async hand-off) still counts fully
+    t0 = min(s["ts"] for s in spans)
+    t1 = max(s["ts"] + s.get("dur_ms", 0.0) / 1e3 for s in spans)
+    root = min(roots, key=lambda s: s["ts"]) if roots else spans[0]
+    return {
+        "trace": trace_id,
+        "root": root.get("name", "?"),
+        "dur_ms": (t1 - t0) * 1e3,
+        "spans": len(spans),
+        "procs": len({(s.get("host"), s.get("pid")) for s in spans}),
+        "errors": sum(1 for s in spans if s.get("status") == "error"),
+        "ts": t0,
+    }
+
+
+def critical_path(spans):
+    """Span ids on the chain of last-finishing spans from the earliest
+    root: at each node descend into the child whose end time is latest.
+    That chain is what bounded the trace's wall time."""
+    by_id = {s["span"]: s for s in spans}
+    children = {}
+    for s in spans:
+        p = s.get("parent")
+        if p in by_id:
+            children.setdefault(p, []).append(s)
+    roots = [s for s in spans if s.get("parent") not in by_id]
+    if not roots:
+        return set()
+    node = min(roots, key=lambda s: s["ts"])
+    path = {node["span"]}
+    while True:
+        kids = children.get(node["span"])
+        if not kids:
+            return path
+        node = max(kids, key=lambda s: s["ts"] + s.get("dur_ms", 0.0) / 1e3)
+        path.add(node["span"])
+
+
+def _fmt_tags(s):
+    tags = s.get("tags") or {}
+    return " ".join("%s=%s" % (k, tags[k]) for k in sorted(tags))
+
+
+def render_trace(trace_id, spans, out=sys.stdout):
+    by_id = {s["span"]: s for s in spans}
+    children = {}
+    roots = []
+    for s in spans:
+        p = s.get("parent")
+        if p in by_id:
+            children.setdefault(p, []).append(s)
+        else:
+            roots.append(s)
+    crit = critical_path(spans)
+    t0 = min(s["ts"] for s in spans)
+    out.write("trace %s  (%d spans, %d processes)\n" % (
+        trace_id, len(spans),
+        len({(s.get("host"), s.get("pid")) for s in spans})))
+
+    def walk(s, depth):
+        mark = "*" if s["span"] in crit else " "
+        status = "" if s.get("status") == "ok" else " [%s]" % s.get("status")
+        out.write("%s %s+%7.1fms %8.1fms  %s%s  (%s:p%s)  %s\n" % (
+            mark, "  " * depth, (s["ts"] - t0) * 1e3,
+            s.get("dur_ms", 0.0), s.get("name", "?"), status,
+            s.get("host", "?"), s.get("pid", "?"), _fmt_tags(s)))
+        for ev in s.get("events") or []:
+            out.write("  %s  . %s %s\n" % (
+                "  " * depth, ev.get("name"),
+                " ".join("%s=%s" % (k, v) for k, v in sorted(ev.items())
+                         if k not in ("name", "ts"))))
+        for c in sorted(children.get(s["span"], ()), key=lambda x: x["ts"]):
+            walk(c, depth + 1)
+
+    for r in sorted(roots, key=lambda s: s["ts"]):
+        walk(r, 0)
+    out.write("* = critical path (chain of last-finishing spans)\n")
+
+
+def render_top(traces, top=10, errors_only=False, out=sys.stdout):
+    rows = [trace_summary(tid, sp) for tid, sp in traces.items()]
+    if errors_only:
+        rows = [r for r in rows if r["errors"]]
+    rows.sort(key=lambda r: -r["dur_ms"])
+    out.write("%-16s %-24s %10s %6s %6s %6s\n" % (
+        "trace", "root", "dur_ms", "spans", "procs", "errs"))
+    for r in rows[:top]:
+        out.write("%-16s %-24s %10.1f %6d %6d %6d\n" % (
+            r["trace"], r["root"][:24], r["dur_ms"], r["spans"],
+            r["procs"], r["errors"]))
+    out.write("%d traces total%s\n" % (
+        len(rows), ", errors only" if errors_only else ""))
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir",
+                    help="FLAGS_trace_dir directory or one trace-*.jsonl shard")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many slowest traces to list")
+    ap.add_argument("--errors", action="store_true",
+                    help="list only traces containing an error span")
+    ap.add_argument("--trace", default="",
+                    help="render one trace id's span tree (prefix match)")
+    args = ap.parse_args(argv)
+    traces = group_traces(_tracing.load_spans(args.trace_dir))
+    if not traces:
+        print("no spans under %s" % args.trace_dir)
+        return 1
+    if args.trace:
+        hits = [t for t in traces if t.startswith(args.trace)]
+        if not hits:
+            print("no trace matching %r" % args.trace)
+            return 1
+        for t in sorted(hits):
+            render_trace(t, traces[t])
+        return 0
+    render_top(traces, top=args.top, errors_only=args.errors)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
