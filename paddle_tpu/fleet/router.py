@@ -110,7 +110,10 @@ class Router:
                  retry_budget_ratio=0.2, retry_budget_max=50.0,
                  hedge=True, hedge_delay_ms=75.0, hedge_after_observations=20,
                  probe_interval_s=0.5, down_after=3,
-                 repo=None, repo_model=None, breaker_opts=None, seed=0):
+                 repo=None, repo_model=None, breaker_opts=None, seed=0,
+                 fleet_metrics=False, scrape_interval_s=2.0,
+                 slos=None, sentinels=None, alert_rules=None,
+                 alerts_path=None):
         self.host = host
         self._port = port
         self.attempt_timeout_s = float(attempt_timeout_s)
@@ -140,6 +143,16 @@ class Router:
         self._http_thread = None
         self._probe_stop = threading.Event()
         self._probe_thread = None
+        # fleet-wide observability (PR 20) — entirely off unless asked for:
+        # no scrape loop, no SLO evaluation, no extra request-path work
+        self.fleet_metrics = bool(fleet_metrics)
+        self.scrape_interval_s = float(scrape_interval_s)
+        self._slos = list(slos or [])
+        self._sentinels = list(sentinels or [])
+        self._alert_rules = alert_rules
+        self._alerts_path = alerts_path
+        self._aggregator = None
+        self._alert_engine = None
 
         from ..observability import registry as _registry
 
@@ -602,6 +615,30 @@ class Router:
                         })
                     elif self.path == "/fleet":
                         self._reply_json(200, router.stats())
+                    elif self.path == "/fleet/metrics":
+                        agg = router._aggregator
+                        if agg is None:
+                            self._reply_json(503, {
+                                "error": "fleet metrics disabled "
+                                         "(Router(fleet_metrics=True))",
+                            })
+                        else:
+                            self._reply(
+                                200, agg.metrics_text().encode(),
+                                content_type="text/plain; version=0.0.4",
+                            )
+                    elif self.path == "/fleet/stats":
+                        agg = router._aggregator
+                        if agg is None:
+                            self._reply_json(503, {
+                                "error": "fleet metrics disabled "
+                                         "(Router(fleet_metrics=True))",
+                            })
+                        else:
+                            st = agg.stats()
+                            if router._alert_engine is not None:
+                                st["slo"] = router._alert_engine.stats()
+                            self._reply_json(200, st)
                     elif self.path == "/metrics":
                         self._reply(
                             200, router._registry.to_prometheus().encode(),
@@ -672,7 +709,50 @@ class Router:
             target=self._probe_loop, name="fleet-prober", daemon=True
         )
         self._probe_thread.start()
+        self._start_fleet_observability()
         return self._httpd.server_address[1]
+
+    def _scrape_targets(self):
+        with self._lock:
+            return {name: rep.url for name, rep in self._replicas.items()}
+
+    def _start_fleet_observability(self):
+        """Fleet aggregator + SLO engine, only when asked for. The scrape
+        loop pulls every replica's /metrics plus this router's own
+        registry; the alert engine evaluates after each scrape."""
+        if not (self.fleet_metrics or self._slos or self._sentinels):
+            return
+        from ..observability.aggregate import FleetAggregator
+
+        self._aggregator = FleetAggregator(
+            targets=self._scrape_targets,
+            local_registry=self._registry,
+            local_name="router",
+            interval_s=self.scrape_interval_s,
+            timeout_s=min(self.attempt_timeout_s, 2.0),
+        )
+        if self._slos or self._sentinels:
+            from ..observability.slo import DEFAULT_RULES, AlertEngine
+
+            self._alert_engine = AlertEngine(
+                slos=self._slos,
+                history=self._aggregator,
+                rules=self._alert_rules or DEFAULT_RULES,
+                registry=self._registry,
+                out_path=self._alerts_path,
+            )
+            for s in self._sentinels:
+                self._alert_engine.add_sentinel(s)
+            self._aggregator.add_listener(self._alert_engine.on_snapshot)
+        self._aggregator.start()
+
+    @property
+    def aggregator(self):
+        return self._aggregator
+
+    @property
+    def alert_engine(self):
+        return self._alert_engine
 
     def _admin(self, path, body):
         """POST /fleet/register|deregister|drain handlers."""
@@ -705,6 +785,10 @@ class Router:
         return "http://%s:%d" % (self.host, self.port)
 
     def stop(self):
+        agg, self._aggregator = self._aggregator, None
+        if agg is not None:
+            agg.stop()
+        self._alert_engine = None
         self._probe_stop.set()
         t, self._probe_thread = self._probe_thread, None
         if t is not None:

@@ -21,16 +21,38 @@ deployment is operated with:
              sampling and per-process JSONL span shards (FLAGS_trace_dir);
 - flightrec: dump-on-trigger anomaly bundles — recent spans + metrics +
              the triggering event, written atomically on a 5xx, breaker
-             transition, NaN-guard trip, watchdog stall or staleness
-             throttle (FLAGS_flightrec_dir).
+             transition, NaN-guard trip, watchdog stall, staleness
+             throttle or SLO alert (FLAGS_flightrec_dir);
+- promparse: Prometheus exposition text -> registry-shaped snapshots,
+             the exact inverse of registry.render_prometheus;
+- aggregate: FleetAggregator — scrapes every replica's /metrics plus the
+             router's registry, merges counters by sum / gauges per-replica
+             / histograms bucket-wise (exact fleet p50/p99 on the shared
+             grid), serves GET /fleet/metrics + /fleet/stats;
+- slo:       declarative SLO objects + AlertEngine (SRE-workbook
+             multi-window multi-burn-rate rules) + drift sentinels (EWMA
+             latency drift, post-warmup retrace, goodput vs roofline).
 
-Live view: `python tools/monitor.py <telemetry_dir>`; traces render via
+Live view: `python tools/monitor.py <telemetry_dir>` (add `--watch N
+--fleet_url <router>` for a refreshing fleet dashboard); traces render via
 `python tools/trace_view.py <trace_dir>` and
-`python tools/timeline.py --trace_path <trace_dir>`.
+`python tools/timeline.py --trace_path <trace_dir> --alerts_path <jsonl>`.
 """
 
-from . import export, flightrec, opprof, registry, stepstats, tracing  # noqa: F401
+from . import (  # noqa: F401
+    aggregate,
+    export,
+    flightrec,
+    opprof,
+    promparse,
+    registry,
+    slo,
+    stepstats,
+    tracing,
+)
+from .aggregate import FleetAggregator
 from .flightrec import FlightRecorder
+from .slo import SLO, AlertEngine
 from .tracing import NULL_SPAN, Span, Tracer
 from .registry import Counter, Gauge, Histogram, MetricRegistry, default_registry
 from .stepstats import (
@@ -58,8 +80,14 @@ __all__ = [
     "opprof",
     "tracing",
     "flightrec",
+    "promparse",
+    "aggregate",
+    "slo",
     "NULL_SPAN",
     "Span",
     "Tracer",
     "FlightRecorder",
+    "FleetAggregator",
+    "SLO",
+    "AlertEngine",
 ]

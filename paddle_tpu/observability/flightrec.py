@@ -27,6 +27,9 @@ Trigger sites (each passes reason-specific context):
                           elastic.py)
 - ``staleness_throttle``  the online trainer refused to publish because the
                           fleet lagged too far behind (online/trainer.py)
+- ``slo_alert``           an SLO burn-rate alert or drift sentinel fired
+                          (observability/slo.py) — info carries the
+                          offending window's merged series
 
 The module-level ``trigger(reason, **info)`` is the only call sites use; it
 is a near-free no-op when FLAGS_flightrec_dir is unset and must NEVER raise

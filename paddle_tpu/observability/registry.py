@@ -35,6 +35,7 @@ __all__ = [
     "Histogram",
     "MetricRegistry",
     "default_registry",
+    "render_prometheus",
     "DEFAULT_MS_BUCKETS",
 ]
 
@@ -203,12 +204,104 @@ def _render_labels(key):
     return ",".join("%s=%s" % (k, v) for k, v in key)
 
 
+def _label_pairs(labels):
+    """Rendered label string -> [[k, v], ...]. A piece without '=' is the
+    tail of a comma-holding label VALUE split apart by the join — rejoin it
+    instead of 500ing every /metrics scrape."""
+    pairs = []
+    for p in labels.split(","):
+        if "=" in p:
+            pairs.append(p.split("=", 1))
+        elif pairs:
+            pairs[-1][1] += "," + p
+    return pairs
+
+
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
 
 def _prom_name(name):
     n = _PROM_BAD.sub("_", name)
     return ("_" + n) if n[:1].isdigit() else n
+
+
+def _escape_label(v):
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _fmt_value(v):
+    """Full-precision sample value: ints stay integral, floats render via
+    repr (shortest round-trip form), non-finite uses the Prometheus
+    spellings. %g would drop digits and break promparse exactness."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    return repr(v)
+
+
+def render_prometheus(snapshot, helps=None):
+    """Registry-shaped snapshot dict -> Prometheus text exposition (0.0.4).
+
+    Spec-conformant for real scrapers — `# HELP`/`# TYPE` lines, cumulative
+    `le`-labelled `_bucket` series ending in `+Inf`, `_sum`/`_count` per
+    histogram, escaped label values — plus two LOSSLESS extras that ride as
+    legal comment / untyped-sample lines so observability.promparse can
+    invert the text exactly back into the snapshot:
+
+      - ``# NAME <prom_name> <registry_name>`` maps each sanitized sample
+        family back to its registry name (slashes survive the round trip);
+      - ``<name>_min`` / ``<name>_max`` samples carry the histogram extremes
+        the standard exposition drops (percentile() clamps against max, and
+        the fleet aggregator needs them for exact merged percentiles).
+
+    Used by MetricRegistry.to_prometheus and by aggregate.FleetAggregator
+    for the merged `GET /fleet/metrics` view.
+    """
+    helps = helps or {}
+    lines = []
+    for name, rec in sorted(snapshot.items()):
+        pname = _prom_name(name)
+        if helps.get(name):
+            lines.append("# HELP %s %s" % (
+                pname,
+                str(helps[name]).replace("\\", "\\\\").replace("\n", "\\n"),
+            ))
+        lines.append("# TYPE %s %s" % (pname, rec["kind"]))
+        lines.append("# NAME %s %s" % (pname, name))
+        if rec["kind"] in ("counter", "gauge"):
+            for labels, v in sorted(rec["values"].items()):
+                if labels:
+                    rendered = ",".join(
+                        '%s="%s"' % (k, _escape_label(val))
+                        for k, val in _label_pairs(labels)
+                    )
+                    lines.append("%s{%s} %s" % (pname, rendered, _fmt_value(v)))
+                else:
+                    lines.append("%s %s" % (pname, _fmt_value(v)))
+        else:  # histogram
+            cum = 0
+            for ub, c in zip(rec["buckets"], rec["counts"]):
+                cum += c
+                lines.append(
+                    '%s_bucket{le="%s"} %d' % (pname, _fmt_value(float(ub)), cum)
+                )
+            cum += rec["counts"][-1]
+            lines.append('%s_bucket{le="+Inf"} %d' % (pname, cum))
+            lines.append("%s_sum %s" % (pname, _fmt_value(rec["sum"])))
+            lines.append("%s_count %d" % (pname, rec["count"]))
+            if rec.get("min") is not None:
+                lines.append("%s_min %s" % (pname, _fmt_value(rec["min"])))
+            if rec.get("max") is not None:
+                lines.append("%s_max %s" % (pname, _fmt_value(rec["max"])))
+    return "\n".join(lines) + "\n"
 
 
 class MetricRegistry:
@@ -272,44 +365,12 @@ class MetricRegistry:
 
     def to_prometheus(self):
         """Prometheus text exposition of the whole registry (export.py writes
-        this to the flag-gated scrape file)."""
-        lines = []
+        this to the flag-gated scrape file; promparse.parse inverts it
+        exactly — see render_prometheus)."""
         snap = self.snapshot()
         with self._lock:
             helps = {n: m.help for n, m in self._metrics.items()}
-        for name, rec in snap.items():
-            pname = _prom_name(name)
-            if helps.get(name):
-                lines.append("# HELP %s %s" % (pname, helps[name]))
-            lines.append("# TYPE %s %s" % (pname, rec["kind"]))
-            if rec["kind"] in ("counter", "gauge"):
-                for labels, v in sorted(rec["values"].items()):
-                    if labels:
-                        # a piece without "=" is the tail of a comma-holding
-                        # label VALUE split apart above — rejoin it instead
-                        # of 500ing every /metrics scrape
-                        pairs = []
-                        for p in labels.split(","):
-                            if "=" in p:
-                                pairs.append(p.split("=", 1))
-                            elif pairs:
-                                pairs[-1][1] += "," + p
-                        rendered = ",".join(
-                            '%s="%s"' % (k, val) for k, val in pairs
-                        )
-                        lines.append("%s{%s} %g" % (pname, rendered, v))
-                    else:
-                        lines.append("%s %g" % (pname, v))
-            else:  # histogram
-                cum = 0
-                for ub, c in zip(rec["buckets"], rec["counts"]):
-                    cum += c
-                    lines.append('%s_bucket{le="%g"} %d' % (pname, ub, cum))
-                cum += rec["counts"][-1]
-                lines.append('%s_bucket{le="+Inf"} %d' % (pname, cum))
-                lines.append("%s_sum %g" % (pname, rec["sum"]))
-                lines.append("%s_count %d" % (pname, rec["count"]))
-        return "\n".join(lines) + "\n"
+        return render_prometheus(snap, helps=helps)
 
 
 _default = MetricRegistry()

@@ -54,11 +54,13 @@ class StepStats:
     __slots__ = (
         "step", "ts", "wall_ms", "n_steps", "feed_stall_ms", "cache_hit",
         "nan_trip", "pp", "n_micro", "schedule", "loss", "training",
+        "items", "item_unit",
     )
 
     def __init__(self, step, ts, wall_ms, n_steps=1, feed_stall_ms=0.0,
                  cache_hit=True, nan_trip=False, pp=None, n_micro=None,
-                 schedule=None, loss=None, training=True):
+                 schedule=None, loss=None, training=True, items=0,
+                 item_unit="img"):
         self.step = step
         self.ts = ts
         self.wall_ms = wall_ms
@@ -71,6 +73,8 @@ class StepStats:
         self.schedule = schedule
         self.loss = loss
         self.training = training
+        self.items = items
+        self.item_unit = item_unit
 
     def to_dict(self):
         d = {
@@ -90,6 +94,9 @@ class StepStats:
             d["schedule"] = self.schedule
         if self.loss is not None:
             d["loss"] = self.loss
+        if self.items:
+            d["items"] = self.items
+            d["item_unit"] = self.item_unit
         return d
 
 
@@ -142,6 +149,10 @@ class StepStatsCollector:
                 "executor compile-cache misses (trace+compile paid)"),
             "nan_trips": self.registry.counter(
                 "nan_guard/trips", "NaN/Inf step-guard activations"),
+            "items": self.registry.counter(
+                "goodput/items_total",
+                "rows/images/tokens processed, by unit (slo.GoodputSentinel "
+                "divides the windowed delta by wall time for MFU-online)"),
         }
 
     # ---- hook API -----------------------------------------------------
@@ -154,9 +165,12 @@ class StepStatsCollector:
 
     def record_step(self, wall_ms, n_steps=1, cache_hit=True, nan_trip=False,
                     pp=None, n_micro=None, schedule=None, loss=None,
-                    training=True):
+                    training=True, items=0, item_unit="img"):
         """One executor run. `n_steps` > 1 for multi-step (steps_per_run)
-        calls: counters advance by k, per-step time is wall/k."""
+        calls: counters advance by k, per-step time is wall/k. `items` is
+        the number of rows/images/tokens the run processed — it feeds the
+        `goodput/items_total` counter the slo.GoodputSentinel divides by
+        wall time for the live MFU-online gauge."""
         now = time.time()
         with self._lock:
             stall = self._pending_stall_ms
@@ -166,12 +180,15 @@ class StepStatsCollector:
         st = StepStats(
             step, now, wall_ms, n_steps=n_steps, feed_stall_ms=stall,
             cache_hit=cache_hit, nan_trip=nan_trip, pp=pp, n_micro=n_micro,
-            schedule=schedule, loss=loss, training=training,
+            schedule=schedule, loss=loss, training=training, items=items,
+            item_unit=item_unit,
         )
         per_step_ms = wall_ms / max(n_steps, 1)
         if training:
             self._m["steps"].inc(n_steps)
             self._m["step_ms"].observe(per_step_ms)
+        if items:
+            self._m["items"].inc(items, unit=item_unit)
         self._m["cache_hits" if cache_hit else "cache_misses"].inc()
         if nan_trip:
             self._m["nan_trips"].inc()

@@ -756,6 +756,37 @@ print("tracing smoke ok: %d requests served 100%%, %d traces / %d spans, "
          rec["p99_ms_tracing_on"], rec["p99_ms_tracing_off"]))
 PY
 
+echo "== fleet SLO engine smoke (docs/observability.md) =="
+# the fleet-wide SLO plane end to end: Prometheus exposition round-trip
+# (parse(to_prometheus()) == snapshot(), bit for bit) and fleet p99 from
+# merged buckets bit-equal to the pooled-observation p99; a steady-state
+# round behind Router(fleet_metrics=True) with ZERO false alerts; a
+# slow_response chaos round whose fast-burn latency page fires, leaves an
+# slo_alert flight-recorder bundle carrying the offending window's merged
+# series, and resolves after the fault clears; plus the EWMA drift
+# sentinel staying quiet on a stationary stream
+JAX_PLATFORMS=cpu python - <<'PY'
+import sys
+sys.path.insert(0, ".")
+from bench import run_slo_bench
+rec = run_slo_bench(smoke=True)
+assert rec["roundtrip_exact"] and rec["merged_p99_bit_equal"], rec
+assert rec["steady"]["alerts_fired"] == 0, rec["steady"]
+assert rec["chaos"]["fired"] and rec["chaos"]["fired_after_s"] < 60, \
+    rec["chaos"]
+assert rec["chaos"]["resolved"] and rec["chaos"]["slo_alert_bundle"], \
+    rec["chaos"]
+assert rec["drift"]["stationary_false_positives"] == 0, rec["drift"]
+print("slo smoke ok: round-trip exact, merged p99 bit-equal, steady round "
+      "0 false alerts (goodput %.2fx roofline), chaos page fired %.1fs in "
+      "/ resolved %.1fs after clear (bundle %s), scrape+eval p99 on/off "
+      "%.2f/%.2f ms"
+      % (rec["steady"]["goodput_vs_roofline"],
+         rec["chaos"]["fired_after_s"], rec["chaos"]["resolved_after_s"],
+         rec["chaos"]["slo_alert_bundle"],
+         rec["p99_ms_slo_on"], rec["p99_ms_slo_off"]))
+PY
+
 echo "== API diff gate =="
 python tools/print_signatures.py > /tmp/API.spec.current
 diff -u paddle_tpu/API.spec /tmp/API.spec.current \

@@ -122,10 +122,16 @@ def test_prometheus_text_parses():
     h.observe(99)
     text = reg.to_prometheus()
     sample = re.compile(
-        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+$|^# (HELP|TYPE) .+$"
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? (-?[0-9.eE+-]+|[+-]?Inf|NaN)$"
+        r"|^# (HELP|TYPE|NAME) .+$"
     )
     for line in text.strip().splitlines():
         assert sample.match(line), line
+    # the exposition is exactly invertible (promparse is the inverse; the
+    # full round-trip contract lives in test_slo.py)
+    from paddle_tpu.observability import promparse
+
+    assert promparse.parse(text) == reg.snapshot()
     # cumulative buckets + +Inf + sum/count for histograms
     assert 'step_ms_bucket{le="+Inf"} 2' in text
     assert "step_ms_count 2" in text

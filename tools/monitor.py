@@ -14,6 +14,15 @@ Usage:
     python tools/monitor.py --dir /path/to/telemetry            # follow
     python tools/monitor.py --dir /path/to/telemetry --once     # one shot
     python tools/monitor.py --dir /path/to/telemetry --window 500
+    python tools/monitor.py --dir /path/to/telemetry --watch 2  # clear+redraw
+    python tools/monitor.py --fleet_url http://router:port --watch 2
+
+``--fleet_url`` points at a fleet router started with fleet_metrics=True
+and renders the fleet-wide section from its ``GET /fleet/stats`` rollup:
+per-replica scrape health, the merged (exact, bucket-wise) request
+latency percentiles, SLO burn-rate alerts and goodput-vs-roofline gauges.
+``--watch N`` clears the screen and re-renders every N seconds, so both
+the telemetry table and the fleet section work as a live dashboard.
 
 No dependency on paddle_tpu (pure stdlib) so it can run on a machine that
 only has the telemetry files.
@@ -25,6 +34,7 @@ import json
 import os
 import sys
 import time
+import urllib.request
 
 SHARD_GLOB = "telemetry-host*.jsonl*"
 
@@ -934,9 +944,93 @@ def render(summary):
     return "\n".join(lines)
 
 
+def fetch_fleet_stats(fleet_url, timeout_s=2.0):
+    """GET <fleet_url>/fleet/stats -> parsed JSON, or an {"error": ...}
+    record so a router restart only blanks the section, not the monitor."""
+    url = fleet_url.rstrip("/") + "/fleet/stats"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+    except Exception as e:
+        return {"error": repr(e)}
+
+
+def render_fleet(stats):
+    """The /fleet/stats rollup -> the fleet-wide dashboard section."""
+    lines = ["=== fleet (merged across replicas) ==="]
+    if stats.get("error"):
+        lines.append("  (unreachable: %s)" % stats["error"])
+        return "\n".join(lines)
+    rows = []
+    targets = stats.get("targets") or {}
+    up = sorted(n for n, t in targets.items() if t.get("ok"))
+    down = sorted(n for n, t in targets.items() if not t.get("ok"))
+    rows.append((
+        "scrape",
+        "%d/%d targets up%s" % (
+            len(up), len(targets),
+            (" (down: %s)" % ", ".join(down)) if down else "",
+        ),
+    ))
+    hists = stats.get("histograms") or {}
+    for name in ("fleet/request_ms", "serving/latency_ms"):
+        h = hists.get(name)
+        if h and h.get("count"):
+            rows.append((
+                name,
+                "n %s, p50 %s ms, p90 %s ms, p99 %s ms (exact, merged "
+                "buckets)" % (
+                    _fmt(h.get("count"), "{:.0f}"),
+                    _fmt(h.get("p50")), _fmt(h.get("p90")),
+                    _fmt(h.get("p99")),
+                ),
+            ))
+    counters = stats.get("counters") or {}
+    req = counters.get("fleet/requests") or {}
+    if req.get("total"):
+        rows.append(("fleet/requests", _fmt(req["total"], "{:.0f}")))
+    gauges = stats.get("gauges") or {}
+    gp = gauges.get("slo/goodput_vs_roofline")
+    if gp:
+        rows.append((
+            "goodput vs roofline",
+            "%s (min %s across series)" % (
+                _fmt(gp.get("mean"), "{:.3f}"), _fmt(gp.get("min"), "{:.3f}"),
+            ),
+        ))
+    slo = stats.get("slo") or {}
+    firing = slo.get("firing") or []
+    if slo:
+        rows.append((
+            "slo",
+            "%d objectives, %d sentinels, %d alerts FIRING, %s transitions"
+            % (
+                len(slo.get("slos") or []),
+                len(slo.get("sentinels") or []),
+                len(firing),
+                _fmt(slo.get("events_total"), "{:.0f}", "0"),
+            ),
+        ))
+        for ev in firing:
+            rows.append((
+                "  ALERT " + str(ev.get("name")),
+                "%s since %s (burn %s / %s)" % (
+                    ev.get("severity"),
+                    time.strftime("%H:%M:%S",
+                                  time.localtime(ev.get("ts", 0))),
+                    _fmt(ev.get("burn_short"), "{:.1f}"),
+                    _fmt(ev.get("burn_long"), "{:.1f}"),
+                ),
+            ))
+    width = max(len(k) for k, _ in rows)
+    for key, val in rows:
+        lines.append("  %-*s  %s" % (width, key, val))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--dir", required=True, help="FLAGS_telemetry_dir path")
+    ap.add_argument("--dir", default="", help="FLAGS_telemetry_dir path")
     ap.add_argument("--once", action="store_true", help="print once and exit")
     ap.add_argument(
         "--window", type=int, default=200,
@@ -946,18 +1040,38 @@ def main(argv=None):
         "--interval", type=float, default=2.0,
         help="refresh period in seconds when following",
     )
+    ap.add_argument(
+        "--watch", type=float, default=0.0, metavar="N",
+        help="clear the screen and re-render every N seconds "
+             "(live-dashboard mode; implies following)",
+    )
+    ap.add_argument(
+        "--fleet_url", default="",
+        help="fleet router base URL (Router(fleet_metrics=True)); renders "
+             "the merged /fleet/stats section",
+    )
     args = ap.parse_args(argv)
+    if not (args.dir or args.fleet_url):
+        ap.error("need --dir and/or --fleet_url")
+    interval = args.watch if args.watch > 0 else args.interval
 
     while True:
-        records = load_records(args.dir)
-        if not records:
-            print("(no telemetry records yet in %s)" % args.dir)
-        else:
-            print(render(summarize(records, window=args.window)))
+        blocks = []
+        if args.dir:
+            records = load_records(args.dir)
+            if not records:
+                blocks.append("(no telemetry records yet in %s)" % args.dir)
+            else:
+                blocks.append(render(summarize(records, window=args.window)))
+        if args.fleet_url:
+            blocks.append(render_fleet(fetch_fleet_stats(args.fleet_url)))
+        if args.watch > 0 and not args.once:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        print("\n\n".join(blocks))
         if args.once:
             return 0
         sys.stdout.flush()
-        time.sleep(args.interval)
+        time.sleep(interval)
 
 
 if __name__ == "__main__":

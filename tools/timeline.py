@@ -18,13 +18,20 @@ of distributed request spans (observability/tracing.py) and lays them out as
 so a request's router -> replica -> batcher -> engine hops read as nested
 bars across processes. Span tags/events ride in args for the tooltip.
 
+--alerts_path takes the AlertEngine's JSONL event stream
+(observability/slo.py, AlertEngine(out_path=...)) and merges each
+fire->resolve pair as one bar on a dedicated "slo alerts" track — an alert
+window is visually alignable with the request spans inside it. Unresolved
+alerts extend to the stream's last timestamp.
+
 Usage:
   python tools/timeline.py --profile_path /tmp/profile --timeline_path /tmp/timeline.json
   python tools/timeline.py --profile_path trainer0=/tmp/p0,trainer1=/tmp/p1 ...
   python tools/timeline.py --profile_path /tmp/profile \
       --telemetry_path /tmp/telem/telemetry-host0.jsonl \
       --timeline_path /tmp/timeline.json
-  python tools/timeline.py --trace_path /tmp/traces --timeline_path /tmp/timeline.json
+  python tools/timeline.py --trace_path /tmp/traces \
+      --alerts_path /tmp/alerts.jsonl --timeline_path /tmp/timeline.json
 Then open chrome://tracing and load the output.
 """
 
@@ -198,8 +205,63 @@ def _trace_span_events(spans, pid_base):
     return out, meta
 
 
+def _alert_events(records, pid, t0=None):
+    """AlertEngine JSONL records -> one chrome-trace "X" bar per
+    fire->resolve pair, on a dedicated pid ("slo alerts" track) with one
+    lane per alert name. `t0` aligns the track with the span track's zero
+    when both are drawn (they share wall-clock stamps)."""
+    alerts = [r for r in records
+              if r.get("kind") == "alert" and "ts" in r]
+    if not alerts:
+        return [], []
+    alerts.sort(key=lambda r: r["ts"])
+    if t0 is None:
+        t0 = alerts[0]["ts"]
+    t_end = alerts[-1]["ts"]
+    lanes = {}   # alert name -> tid
+    open_ev = {}  # (name, severity) -> fired record
+    out = []
+
+    def bar(fired, end_ts, resolved):
+        name = str(fired.get("name", "?"))
+        tid = lanes.setdefault(name, len(lanes))
+        args = {k: v for k, v in fired.items()
+                if k not in ("kind", "ts", "series")}
+        args["resolved"] = resolved
+        out.append(
+            {
+                "name": "%s [%s]" % (name, fired.get("severity", "?")),
+                "cat": "slo_alert",
+                "ph": "X",
+                "pid": pid,
+                "tid": tid,
+                "ts": (fired["ts"] - t0) * 1e6,
+                "dur": max((end_ts - fired["ts"]), 0.001) * 1e6,
+                "args": args,
+            }
+        )
+
+    for r in alerts:
+        key = (r.get("name"), r.get("severity"))
+        if r.get("event") == "fired":
+            open_ev[key] = r
+        elif r.get("event") == "resolved" and key in open_ev:
+            bar(open_ev.pop(key), r["ts"], True)
+    for fired in open_ev.values():  # never resolved: extend to stream end
+        bar(fired, t_end, False)
+    meta = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "args": {"name": "slo alerts"},
+        }
+    ]
+    return out, meta
+
+
 def convert(profile_path, timeline_path, telemetry_path=None,
-            trace_path=None):
+            trace_path=None, alerts_path=None):
     trace_events = []
     metadata = []
     pid = 0
@@ -257,6 +319,7 @@ def convert(profile_path, timeline_path, telemetry_path=None,
                 metadata.append(op_meta)
                 trace_events.extend(op_events)
         pid += 2 * len(named)
+    span_t0 = None
     if trace_path:
         import os
         import sys
@@ -265,11 +328,21 @@ def convert(profile_path, timeline_path, telemetry_path=None,
             os.path.abspath(__file__))))
         from paddle_tpu.observability import tracing as _tracing
 
-        span_events, span_meta = _trace_span_events(
-            _tracing.load_spans(trace_path), pid
-        )
+        spans = _tracing.load_spans(trace_path)
+        stamps = [s["ts"] for s in spans
+                  if s.get("kind") == "span" and "ts" in s]
+        if stamps:
+            span_t0 = min(stamps)
+        span_events, span_meta = _trace_span_events(spans, pid)
         metadata.extend(span_meta)
         trace_events.extend(span_events)
+        pid += 1000  # span lanes allocate pids dynamically; jump clear
+    if alerts_path:
+        alert_events, alert_meta = _alert_events(
+            _read_jsonl(alerts_path), pid, t0=span_t0
+        )
+        metadata.extend(alert_meta)
+        trace_events.extend(alert_events)
     with open(timeline_path, "w") as f:
         json.dump({"traceEvents": metadata + trace_events}, f)
     return len(trace_events)
@@ -287,10 +360,17 @@ if __name__ == "__main__":
                     help="FLAGS_trace_dir directory (or one trace-*.jsonl "
                          "shard) of request spans; emitted as per-process "
                          "span lanes")
+    ap.add_argument("--alerts_path", default="",
+                    help="AlertEngine JSONL event stream (slo.py "
+                         "out_path); fire/resolve pairs emitted as an "
+                         "'slo alerts' track")
     args = ap.parse_args()
-    if not (args.profile_path or args.telemetry_path or args.trace_path):
-        ap.error("need --profile_path, --telemetry_path and/or --trace_path")
+    if not (args.profile_path or args.telemetry_path or args.trace_path
+            or args.alerts_path):
+        ap.error("need --profile_path, --telemetry_path, --trace_path "
+                 "and/or --alerts_path")
     n = convert(args.profile_path, args.timeline_path,
                 telemetry_path=args.telemetry_path or None,
-                trace_path=args.trace_path or None)
+                trace_path=args.trace_path or None,
+                alerts_path=args.alerts_path or None)
     print("wrote %d events to %s" % (n, args.timeline_path))
